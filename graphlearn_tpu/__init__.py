@@ -1,0 +1,11 @@
+"""graphlearn_tpu: a TPU-native graph learning framework.
+
+Brand-new JAX/XLA/Pallas re-design with the capabilities of
+GraphLearn-for-PyTorch (reference at /root/reference; see SURVEY.md):
+accelerator-resident graph sampling, a sharded HBM feature store with
+hot-vertex caching, graph partitioning, distributed sampling + feature
+collection over ICI/DCN collectives, and PyG-compatible dataset/loader APIs.
+"""
+from . import data, ops, typing, utils
+
+__version__ = '0.1.0'

@@ -1,0 +1,119 @@
+"""Incremental subgraph induction (dedup + relabel) with fixed shapes.
+
+TPU-native replacement for the reference Inducer
+(/root/reference/graphlearn_torch/csrc/cuda/inducer.cu): the CUDA version
+keeps a device hash table alive across hops so every node sampled within a
+batch gets one globally-unique local index, and emits relabeled COO rows/cols
+per hop. Here the persistent state is a fixed-capacity node buffer plus a
+sorted view of it; per-hop dedup is sort-based (ops.unique) and membership
+against earlier hops is a binary search on the sorted view. Everything is
+jittable: capacities are static, counts are traced scalars.
+
+State invariants:
+  nodes[:num_nodes]   — global ids, position == local index (seeds first).
+  sorted_vals         — ascending sort of nodes with INT_MAX padding.
+  sorted_pos          — sorted_vals[i] == nodes[sorted_pos[i]].
+"""
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .unique import FILL, masked_unique, searchsorted_membership
+
+
+class InducerState(NamedTuple):
+  nodes: jax.Array        # [cap] global ids, FILL-padded
+  num_nodes: jax.Array    # scalar int32
+  sorted_vals: jax.Array  # [cap] ascending, INT_MAX-padded
+  sorted_pos: jax.Array   # [cap] position of sorted_vals in nodes
+
+
+def _sort_view(nodes: jax.Array):
+  big = jnp.iinfo(nodes.dtype).max
+  keys = jnp.where(nodes == FILL, big, nodes)
+  order = jnp.argsort(keys)
+  return keys[order], order.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=('capacity',))
+def init_node(seeds: jax.Array, seed_mask: jax.Array, capacity: int):
+  """Start a batch: dedup seeds into local indices 0..n-1.
+
+  Reference: CUDAInducer::InitNode (inducer.cu:75-93). Returns
+  (state, uniq_seeds [B], uniq_mask [B]) — uniq_seeds[i] has local index i.
+  """
+  b = seeds.shape[0]
+  uniq, count, _ = masked_unique(seeds, seed_mask, size=b)
+  nodes = jnp.full((capacity,), FILL, dtype=seeds.dtype)
+  nodes = nodes.at[:b].set(uniq)
+  sorted_vals, sorted_pos = _sort_view(nodes)
+  state = InducerState(nodes, count.astype(jnp.int32), sorted_vals,
+                       sorted_pos)
+  return state, uniq, jnp.arange(b) < count
+
+
+@jax.jit
+def induce_next(state: InducerState, src_idx: jax.Array, nbrs: jax.Array,
+                nbr_mask: jax.Array):
+  """Absorb one hop of sampled neighbors.
+
+  Reference: CUDAInducer::InduceNext (inducer.cu:95-165).
+
+  Args:
+    state: inducer state from init_node / previous induce_next.
+    src_idx: [F] local indices of the frontier nodes the hop sampled from.
+    nbrs: [F, K] sampled neighbor global ids (FILL-padded).
+    nbr_mask: [F, K] validity.
+
+  Returns (new_state, out) where out has:
+    rows, cols: [F*K] relabeled COO (row = src local idx, col = nbr local
+      idx), -1 where invalid; edge order matches ``nbrs.reshape(-1)`` so the
+    caller can gather edge ids in the same order.
+    edge_mask: [F*K]
+    frontier, frontier_idx, frontier_mask: [F*K] newly-added unique nodes
+      (global ids / local indices) — the next hop's seeds.
+    num_new: scalar count of newly-added nodes.
+  """
+  f, k = nbrs.shape
+  flat = nbrs.reshape(-1)
+  flat_mask = nbr_mask.reshape(-1)
+  size = f * k
+
+  uniq, ucnt, inv = masked_unique(flat, flat_mask, size=size)
+  uniq_valid = jnp.arange(size) < ucnt
+
+  found, pos = searchsorted_membership(state.sorted_vals, uniq)
+  found = found & uniq_valid
+  existing_idx = state.sorted_pos[pos]
+
+  new_mask = uniq_valid & (~found)
+  new_rank = (jnp.cumsum(new_mask) - 1).astype(jnp.int32)
+  new_idx = state.num_nodes + new_rank
+  num_new = jnp.sum(new_mask).astype(jnp.int32)
+
+  uniq_local = jnp.where(found, existing_idx, new_idx)
+  uniq_local = jnp.where(uniq_valid, uniq_local, -1)
+
+  nodes = state.nodes.at[jnp.where(new_mask, new_idx, state.nodes.shape[0])
+                         ].set(uniq, mode='drop')
+  sorted_vals, sorted_pos = _sort_view(nodes)
+  new_state = InducerState(nodes, state.num_nodes + num_new, sorted_vals,
+                           sorted_pos)
+
+  rows = jnp.repeat(src_idx.astype(jnp.int32), k)
+  cols = jnp.where(flat_mask, uniq_local[jnp.clip(inv, 0, size - 1)], -1)
+  rows = jnp.where(flat_mask, rows, -1)
+
+  slot = jnp.where(new_mask, new_rank, size)
+  frontier = jnp.full((size,), FILL, dtype=flat.dtype
+                      ).at[slot].set(uniq, mode='drop')
+  frontier_idx = jnp.full((size,), -1, dtype=jnp.int32
+                          ).at[slot].set(new_idx, mode='drop')
+  frontier_mask = jnp.arange(size) < num_new
+
+  out = dict(rows=rows, cols=cols, edge_mask=flat_mask, frontier=frontier,
+             frontier_idx=frontier_idx, frontier_mask=frontier_mask,
+             num_new=num_new)
+  return new_state, out

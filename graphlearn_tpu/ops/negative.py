@@ -1,0 +1,67 @@
+"""Fixed-shape random negative edge sampling.
+
+TPU-native replacement for the reference negative samplers
+(/root/reference/graphlearn_torch/csrc/cuda/random_negative_sampler.cu and
+csrc/cpu/random_negative_sampler.cc): draw candidate (row, col) pairs, reject
+pairs present in the CSR via binary search, and keep the first ``num_samples``
+survivors. The CUDA version loops trials with thrust compaction and a D2H
+count; here all ``trials * num_samples`` candidates are drawn and tested in
+one fixed-shape pass, and compaction is an argsort — no host sync.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .neighbor import edge_in_csr
+
+
+def sort_csr_segments(indptr: np.ndarray, indices: np.ndarray):
+  """Host-side: sort ``indices`` within each row segment (binary-search
+  membership requires sorted rows). Returns (sorted_indices, perm) where
+  ``perm`` maps sorted edge positions back to original CSR positions."""
+  indptr = np.asarray(indptr)
+  indices = np.asarray(indices)
+  rows = np.repeat(np.arange(indptr.shape[0] - 1),
+                   np.diff(indptr))
+  perm = np.lexsort((indices, rows))
+  return indices[perm], perm
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('num_samples', 'trials', 'padding'))
+def random_negative_sample(indptr, sorted_indices, num_src, num_dst,
+                           num_samples: int, key, trials: int = 5,
+                           padding: bool = False):
+  """Sample (row, col) pairs absent from the CSR.
+
+  Args:
+    indptr/sorted_indices: CSR with row-sorted indices
+      (:func:`sort_csr_segments`).
+    num_src/num_dst: id ranges for rows/cols.
+    num_samples: number of pairs wanted (static).
+    trials: candidate multiplier; ``trials * num_samples`` candidates are
+      tested (reference semantics: retry up to ``trials_num`` rounds,
+      random_negative_sampler.cu).
+    padding: non-strict mode — pad any shortfall with random (possibly
+      positive) pairs so the output is always full (reference ``padding``
+      flag).
+
+  Returns (rows [num_samples], cols [num_samples], mask [num_samples]).
+  """
+  total = num_samples * trials
+  kr, kc = jax.random.split(key)
+  rows = jax.random.randint(kr, (total,), 0, num_src, dtype=jnp.int32)
+  cols = jax.random.randint(kc, (total,), 0, num_dst, dtype=jnp.int32)
+  is_edge = edge_in_csr(indptr, sorted_indices, rows, cols)
+  valid = ~is_edge
+  # Stable partition: valid candidates first, in draw order.
+  order = jnp.argsort(jnp.where(valid, 0, 1), stable=True)
+  take = order[:num_samples]
+  out_rows = rows[take]
+  out_cols = cols[take]
+  out_mask = valid[take]
+  if padding:
+    out_mask = jnp.ones_like(out_mask)
+  return out_rows, out_cols, out_mask

@@ -1,0 +1,140 @@
+"""Fixed-shape neighbor sampling over an HBM-resident CSR.
+
+TPU-native replacement for the reference CUDA sampler
+(/root/reference/graphlearn_torch/csrc/cuda/random_sampler.cu). The CUDA path
+computes exact per-seed neighbor counts, a prefix sum, a D2H sync, and a
+variable-size output (random_sampler.cu:267-307); on TPU that sync and dynamic
+shape would break jit, so sampling emits a dense ``[B, K]`` buffer with a
+validity mask:
+
+  deg <= K: take all neighbors in order (mask pads the tail) — matches the
+            reference's "keep all" branch.
+  deg >  K: K uniform draws with replacement (matches the reference CPU
+            sampler semantics, csrc/cpu/random_sampler.cc:24-47; the CUDA
+            reservoir's without-replacement guarantee is relaxed — tests, like
+            the reference's, assert membership/caps, not exact multisets).
+
+Weighted sampling follows the reference CPU weighted sampler's CDF + binary
+search (csrc/cpu/weighted_sampler.cc:147-193) but over a precomputed per-row
+cumulative-weight array so the per-draw work is a fixed 32-step bisection.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .unique import FILL
+
+
+@functools.partial(jax.jit, static_argnames=('k',))
+def uniform_sample(indptr, indices, seeds, seed_mask, k: int, key):
+  """Sample up to ``k`` neighbors per seed.
+
+  Args:
+    indptr:  [N+1] CSR row pointer (int32/int64, device-resident).
+    indices: [E] neighbor ids.
+    seeds:   [B] seed ids (padded entries arbitrary where ``seed_mask`` False).
+    seed_mask: [B] bool validity.
+    k: fanout (static).
+    key: jax PRNG key.
+
+  Returns:
+    nbrs:  [B, K] neighbor ids, FILL where invalid.
+    epos:  [B, K] position into the CSR ``indices`` array of each sampled
+           edge (valid where mask; use to gather edge ids/weights).
+    mask:  [B, K] bool validity.
+  """
+  b = seeds.shape[0]
+  safe_seeds = jnp.where(seed_mask, seeds, 0)
+  start = indptr[safe_seeds]
+  deg = indptr[safe_seeds + 1] - start
+  u = jax.random.uniform(key, (b, k))
+  rand_off = jnp.floor(u * deg[:, None].astype(u.dtype)).astype(jnp.int32)
+  rand_off = jnp.minimum(rand_off, jnp.maximum(deg[:, None] - 1, 0))
+  seq_off = jnp.arange(k, dtype=jnp.int32)[None, :]
+  offsets = jnp.where(deg[:, None] > k, rand_off, seq_off)
+  mask = seed_mask[:, None] & (offsets < deg[:, None])
+  epos = start[:, None] + offsets
+  safe_epos = jnp.where(mask, epos, 0)
+  nbrs = jnp.where(mask, indices[safe_epos], FILL)
+  return nbrs, jnp.where(mask, epos, 0), mask
+
+
+def build_row_cumsum(indptr, weights):
+  """Host/device precompute for weighted sampling: per-edge cumulative weight
+  restarting at each row (so ``cum[indptr[r]:indptr[r+1]]`` is the row CDF)."""
+  cum = jnp.cumsum(weights)
+  row_base = jnp.concatenate([jnp.zeros((1,), cum.dtype), cum])[indptr[:-1]]
+  n = indptr.shape[0] - 1
+  counts = indptr[1:] - indptr[:-1]
+  base_per_edge = jnp.repeat(row_base, counts,
+                             total_repeat_length=weights.shape[0])
+  return cum - base_per_edge
+
+
+@functools.partial(jax.jit, static_argnames=('k',))
+def weighted_sample(indptr, indices, row_cumsum, seeds, seed_mask, k: int,
+                    key):
+  """Edge-weight-biased sampling with replacement via inverse-CDF bisection.
+
+  ``row_cumsum`` comes from :func:`build_row_cumsum`. Same output contract as
+  :func:`uniform_sample`. Rows with degree <= k keep all neighbors (parity
+  with the uniform path and the reference's keep-all branch).
+  """
+  b = seeds.shape[0]
+  safe_seeds = jnp.where(seed_mask, seeds, 0)
+  start = indptr[safe_seeds]
+  end = indptr[safe_seeds + 1]
+  deg = end - start
+  total = row_cumsum[jnp.maximum(end - 1, 0)]
+  total = jnp.where(deg > 0, total, 1.0)
+  u = jax.random.uniform(key, (b, k)) * total[:, None]
+
+  # Vectorized bisection for the first edge position with cum >= u within
+  # [start, end). 32 steps cover any degree < 2^32.
+  lo = jnp.broadcast_to(start[:, None], (b, k))
+  hi = jnp.broadcast_to(end[:, None], (b, k))
+
+  def body(_, carry):
+    lo, hi = carry
+    mid = (lo + hi) // 2
+    go_right = row_cumsum[jnp.clip(mid, 0, row_cumsum.shape[0] - 1)] < u
+    lo = jnp.where(go_right, mid + 1, lo)
+    hi = jnp.where(go_right, hi, mid)
+    return lo, hi
+
+  lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+  wpos = jnp.minimum(lo, jnp.maximum(end[:, None] - 1, 0))
+
+  seq_off = jnp.arange(k, dtype=start.dtype)[None, :]
+  epos = jnp.where(deg[:, None] > k, wpos, start[:, None] + seq_off)
+  mask = seed_mask[:, None] & (
+      jnp.where(deg[:, None] > k, 0, seq_off) < deg[:, None])
+  safe_epos = jnp.where(mask, epos, 0)
+  nbrs = jnp.where(mask, indices[safe_epos], FILL)
+  return nbrs, jnp.where(mask, epos, 0), mask
+
+
+def edge_in_csr(indptr, indices, rows, cols):
+  """Vectorized membership test: is (rows[i], cols[i]) an edge?
+
+  Replacement for the reference's per-trial device binary search
+  (csrc/cuda/random_negative_sampler.cu EdgeInCSR). Requires ``indices``
+  sorted within each row segment (see ops.negative.sort_csr_segments).
+  """
+  start = indptr[rows]
+  end = indptr[rows + 1]
+  lo, hi = start, end
+
+  def body(_, carry):
+    lo, hi = carry
+    mid = (lo + hi) // 2
+    v = indices[jnp.clip(mid, 0, indices.shape[0] - 1)]
+    go_right = v < cols
+    lo = jnp.where(go_right, mid + 1, lo)
+    hi = jnp.where(go_right, hi, mid)
+    return lo, hi
+
+  lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+  pos = jnp.clip(lo, 0, indices.shape[0] - 1)
+  return (lo < end) & (indices[pos] == cols)

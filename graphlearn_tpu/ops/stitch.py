@@ -1,0 +1,34 @@
+"""Stitch partial sampling results back into seed order.
+
+TPU-native counterpart of the reference stitch kernels
+(/root/reference/graphlearn_torch/csrc/cuda/stitch_sample_results.cu): in the
+distributed sampler each partition returns neighbors for the subset of seeds
+it owns plus the positions of those seeds in the original request; stitching
+is a pure fixed-shape scatter.
+"""
+import jax.numpy as jnp
+
+
+def stitch_rows(index_list, rows_list, mask_list, out_len: int):
+  """Scatter per-partition row-blocks into the original seed order.
+
+  Args:
+    index_list: list of [Bp] positions into the output (padded entries may be
+      arbitrary where the corresponding mask row is all-False).
+    rows_list: list of [Bp, K] payloads.
+    mask_list: list of [Bp, K] validity masks.
+    out_len: number of output rows (static).
+
+  Returns (out [out_len, K], out_mask [out_len, K]).
+  """
+  k = rows_list[0].shape[1]
+  dtype = rows_list[0].dtype
+  out = jnp.zeros((out_len, k), dtype=dtype)
+  out_mask = jnp.zeros((out_len, k), dtype=bool)
+  for idx, rows, mask in zip(index_list, rows_list, mask_list):
+    row_valid = mask.any(axis=1)
+    slot = jnp.where(row_valid, idx, out_len)
+    out = out.at[slot].set(jnp.where(mask, rows, out.dtype.type(0)),
+                           mode='drop')
+    out_mask = out_mask.at[slot].set(mask, mode='drop')
+  return out, out_mask

@@ -1,0 +1,55 @@
+"""Induced-subgraph extraction with fixed shapes.
+
+TPU-native replacement for the reference SubGraphOp
+(/root/reference/graphlearn_torch/csrc/cuda/subgraph_op.cu): given a node
+set, keep every edge whose endpoints are both in the set, relabeled to local
+indices. The CUDA version slices CSR rows exactly and masks columns with a
+device hash table; here rows are scanned up to a static ``max_degree`` cap and
+set-membership is a binary search over the deduped (sorted) node set.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .unique import FILL, masked_unique
+
+
+@functools.partial(jax.jit, static_argnames=('max_degree',))
+def node_subgraph(indptr, indices, srcs, src_mask, max_degree: int):
+  """Extract the subgraph induced by ``srcs[src_mask]``.
+
+  Returns dict with:
+    nodes: [B] deduped node set (ascending, FILL-padded); local index == pos.
+    num_nodes: scalar.
+    rows, cols: [B * max_degree] relabeled COO, -1 where invalid.
+    epos: [B * max_degree] CSR edge positions (for edge-id gather).
+    edge_mask: [B * max_degree].
+  """
+  b = srcs.shape[0]
+  nodes, num_nodes, _ = masked_unique(srcs, src_mask, size=b)
+  node_valid = jnp.arange(b) < num_nodes
+
+  safe_nodes = jnp.where(node_valid, nodes, 0)
+  start = indptr[safe_nodes]
+  deg = indptr[safe_nodes + 1] - start
+  off = jnp.arange(max_degree, dtype=start.dtype)[None, :]
+  in_row = node_valid[:, None] & (off < deg[:, None])
+  epos = jnp.where(in_row, start[:, None] + off, 0)
+  nbr = jnp.where(in_row, indices[epos], FILL)
+
+  # Membership + relabel: ``nodes`` is ascending over [0, num_nodes) but
+  # FILL(-1)-padded at the tail, which would break searchsorted's ordering
+  # requirement — remap padding to int-max for the search keys.
+  big = jnp.iinfo(nodes.dtype).max
+  skeys = jnp.where(node_valid, nodes, big)
+  pos = jnp.clip(jnp.searchsorted(skeys, nbr), 0, b - 1)
+  member = in_row & (skeys[pos] == nbr)
+
+  rows = jnp.where(member, jnp.broadcast_to(
+      jnp.arange(b, dtype=jnp.int32)[:, None], (b, max_degree)), -1)
+  cols = jnp.where(member, pos.astype(jnp.int32), -1)
+  return dict(nodes=nodes, num_nodes=num_nodes,
+              rows=rows.reshape(-1), cols=cols.reshape(-1),
+              epos=jnp.where(member, epos, 0).reshape(-1),
+              edge_mask=member.reshape(-1))

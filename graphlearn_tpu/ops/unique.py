@@ -1,0 +1,53 @@
+"""Masked, fixed-shape unique/dedup primitives.
+
+TPU-native replacement for the reference's GPU open-addressing hash table
+(/root/reference/graphlearn_torch/include/hash_table.cuh): XLA has no atomics
+for a device hash table, and dynamic output sizes break jit, so dedup is
+sort-based over fixed-size buffers with validity masks. All functions are
+jittable with static ``size``.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+FILL = -1  # sentinel for invalid/padded ids (all real ids are >= 0)
+
+
+@functools.partial(jax.jit, static_argnames=('size',))
+def masked_unique(ids: jax.Array, mask: jax.Array, size: int):
+  """Deduplicate ``ids[mask]`` into a fixed-size buffer.
+
+  Returns:
+    uniq:    [size] unique values in ascending order, FILL-padded.
+    count:   scalar number of valid uniques.
+    inverse: [N] index into ``uniq`` for each input position (-1 where masked).
+  """
+  n = ids.shape[0]
+  assert size >= 1
+  big = jnp.iinfo(ids.dtype).max
+  x = jnp.where(mask, ids, big)
+  order = jnp.argsort(x)
+  xs = x[order]
+  is_first = jnp.concatenate(
+      [jnp.ones((1,), dtype=bool), xs[1:] != xs[:-1]])
+  valid = xs != big
+  is_new = is_first & valid
+  uidx = jnp.cumsum(is_new) - 1          # unique slot of each sorted element
+  count = jnp.sum(is_new)
+  uniq = jnp.full((size,), FILL, dtype=ids.dtype)
+  uniq = uniq.at[jnp.where(is_new, uidx, size)].set(xs, mode='drop')
+  inverse = jnp.zeros((n,), dtype=jnp.int32)
+  inverse = inverse.at[order].set(uidx.astype(jnp.int32))
+  inverse = jnp.where(mask, inverse, -1)
+  return uniq, count, inverse
+
+
+def searchsorted_membership(sorted_vals: jax.Array, queries: jax.Array):
+  """Membership of ``queries`` in ascending ``sorted_vals`` (may contain
+  int-max padding at the tail). Returns (found, pos) where ``pos`` indexes
+  ``sorted_vals`` (clamped)."""
+  pos = jnp.searchsorted(sorted_vals, queries)
+  pos = jnp.clip(pos, 0, sorted_vals.shape[0] - 1)
+  found = sorted_vals[pos] == queries
+  return found, pos

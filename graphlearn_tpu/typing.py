@@ -1,0 +1,82 @@
+"""Core type aliases for graphlearn_tpu.
+
+TPU-native re-design of the reference's typing module
+(/root/reference/graphlearn_torch/python/typing.py). Node/edge typing and
+partition-book semantics are kept API-compatible; tensors are numpy (host) or
+jax.Array (device) instead of torch.Tensor.
+"""
+from typing import Dict, List, NamedTuple, Optional, Tuple, Union
+
+import numpy as np
+
+# A node type in a heterogeneous graph, e.g. 'paper'.
+NodeType = str
+
+# An edge type triplet (src_node_type, relation, dst_node_type).
+EdgeType = Tuple[str, str, str]
+
+# Prefix marking the reverse direction of an edge type
+# (reference: typing.py:39-46).
+REVERSE_PREFIX = 'rev_'
+
+# String join token for edge types (reference: typing.py:32).
+EDGE_TYPE_STR_SPLIT = '__'
+
+
+def as_str(type_: Union[NodeType, EdgeType]) -> str:
+  """Canonical string form of a node or edge type."""
+  if isinstance(type_, NodeType):
+    return type_
+  if isinstance(type_, (list, tuple)) and len(type_) == 3:
+    return EDGE_TYPE_STR_SPLIT.join(type_)
+  return ''
+
+
+def to_edge_type(type_str: str) -> EdgeType:
+  parts = type_str.split(EDGE_TYPE_STR_SPLIT)
+  if len(parts) != 3:
+    raise ValueError(f'invalid edge type string: {type_str!r}')
+  return tuple(parts)
+
+
+def reverse_edge_type(etype: EdgeType) -> EdgeType:
+  """Reverse of an edge type: flips endpoints and toggles the 'rev_' prefix."""
+  src, rel, dst = etype
+  if src != dst:
+    if rel.startswith(REVERSE_PREFIX):
+      rel = rel[len(REVERSE_PREFIX):]
+    else:
+      rel = REVERSE_PREFIX + rel
+  return (dst, rel, src)
+
+
+# A partition book maps a global node/edge id to the partition index that owns
+# it (reference: typing.py:78-82). Host-side it is a numpy int array; on device
+# it may be a jax.Array.
+PartitionBook = np.ndarray
+HeteroNodePartitionDict = Dict[NodeType, PartitionBook]
+HeteroEdgePartitionDict = Dict[EdgeType, PartitionBook]
+
+
+class GraphPartitionData(NamedTuple):
+  """Edge-index data of a single graph partition (reference: typing.py:53-58)."""
+  edge_index: np.ndarray          # [2, E_local] (row, col) in global ids
+  eids: np.ndarray                # [E_local] global edge ids
+  weights: Optional[np.ndarray] = None  # [E_local] edge weights
+
+
+class FeaturePartitionData(NamedTuple):
+  """Feature data of a single partition (reference: typing.py:60-68)."""
+  feats: Optional[np.ndarray]        # [n_local, F]
+  ids: Optional[np.ndarray]          # [n_local] global ids
+  cache_feats: Optional[np.ndarray]  # [n_cache, F] hot-cache rows
+  cache_ids: Optional[np.ndarray]    # [n_cache] global ids of cached rows
+
+
+HeteroGraphPartitionDict = Dict[EdgeType, GraphPartitionData]
+HeteroFeaturePartitionDict = Dict[Union[NodeType, EdgeType], FeaturePartitionData]
+
+# Seeds / fanout aliases (reference: typing.py:84-91).
+InputNodes = Union[np.ndarray, Tuple[NodeType, np.ndarray]]
+InputEdges = Union[np.ndarray, Tuple[EdgeType, np.ndarray]]
+NumNeighbors = Union[List[int], Dict[EdgeType, List[int]]]

@@ -1,0 +1,6 @@
+from .common import count_dict, get_free_port, merge_dict
+from .mixin import CastMixin
+from .tensor import convert_to_array, id2idx, squeeze_dict
+from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, csr_to_csc, ind2ptr,
+                   ptr2ind)
+from .units import format_size, parse_size

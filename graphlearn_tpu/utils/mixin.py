@@ -1,0 +1,18 @@
+"""CastMixin: permissive construction from tuples/dicts (reference utils/mixin.py)."""
+from typing import Any
+
+
+class CastMixin:
+  @classmethod
+  def cast(cls, *args, **kwargs) -> Any:
+    if len(args) == 1 and len(kwargs) == 0:
+      elem = args[0]
+      if elem is None:
+        return None
+      if isinstance(elem, CastMixin):
+        return elem
+      if isinstance(elem, (tuple, list)):
+        return cls(*elem)
+      if isinstance(elem, dict):
+        return cls(**elem)
+    return cls(*args, **kwargs)

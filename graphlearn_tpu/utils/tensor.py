@@ -1,0 +1,41 @@
+"""Small tensor helpers shared across the framework.
+
+TPU-native counterpart of /root/reference/graphlearn_torch/python/utils/tensor.py.
+"""
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+
+
+def id2idx(ids: np.ndarray, max_id: Optional[int] = None) -> np.ndarray:
+  """Dense inverse map: out[ids[i]] = i (reference: utils/tensor.py:30-39).
+
+  Positions not present in ``ids`` map to 0; callers mask by membership.
+  """
+  ids = np.asarray(ids)
+  if max_id is None:
+    max_id = int(ids.max(initial=-1)) + 1
+  out = np.zeros(max_id, dtype=np.int64)
+  out[ids] = np.arange(ids.shape[0], dtype=np.int64)
+  return out
+
+
+def convert_to_array(data: Any, dtype=None) -> Any:
+  """Recursively convert python/list/torch data to numpy arrays."""
+  if data is None:
+    return None
+  if isinstance(data, dict):
+    return {k: convert_to_array(v, dtype) for k, v in data.items()}
+  if hasattr(data, 'detach'):  # torch.Tensor without importing torch
+    data = data.detach().cpu().numpy()
+  arr = np.asarray(data)
+  if dtype is not None:
+    arr = arr.astype(dtype, copy=False)
+  return arr
+
+
+def squeeze_dict(data: Union[Dict, Any]) -> Any:
+  """Unwrap single-entry dicts (mirrors reference utils squeeze semantics)."""
+  if isinstance(data, dict) and len(data) == 1:
+    return next(iter(data.values()))
+  return data

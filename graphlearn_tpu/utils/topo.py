@@ -1,0 +1,78 @@
+"""Host-side graph layout conversions (COO/CSR/CSC).
+
+TPU-native counterpart of the reference's conversion helpers
+(/root/reference/graphlearn_torch/python/utils/topo.py). Pure numpy — graph
+construction happens on host; device transfer is owned by data.graph.Graph.
+"""
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def ptr2ind(indptr: np.ndarray) -> np.ndarray:
+  """Expand a CSR row-pointer into per-edge row ids."""
+  n = indptr.shape[0] - 1
+  counts = np.diff(indptr)
+  return np.repeat(np.arange(n, dtype=indptr.dtype), counts)
+
+
+def ind2ptr(rows: np.ndarray, num_rows: int) -> np.ndarray:
+  """Build a CSR row-pointer from *sorted* per-edge row ids."""
+  counts = np.bincount(rows, minlength=num_rows)
+  indptr = np.zeros(num_rows + 1, dtype=np.int64)
+  np.cumsum(counts, out=indptr[1:])
+  return indptr
+
+
+def coo_to_csr(
+    row: np.ndarray,
+    col: np.ndarray,
+    num_nodes: Optional[int] = None,
+    edge_ids: Optional[np.ndarray] = None,
+    edge_weights: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[np.ndarray]]:
+  """COO -> CSR. Returns (indptr, indices, edge_ids, edge_weights).
+
+  If ``edge_ids`` is None, it is assigned as the original COO position so that
+  edge features/weights indexed by input order remain addressable.
+  """
+  row = np.asarray(row)
+  col = np.asarray(col)
+  if num_nodes is None:
+    num_nodes = int(max(row.max(initial=-1), col.max(initial=-1))) + 1
+  if edge_ids is None:
+    edge_ids = np.arange(row.shape[0], dtype=np.int64)
+  order = np.argsort(row, kind='stable')
+  sorted_row = row[order]
+  indices = col[order]
+  eids = np.asarray(edge_ids)[order]
+  weights = None if edge_weights is None else np.asarray(edge_weights)[order]
+  indptr = ind2ptr(sorted_row, num_nodes)
+  return indptr, indices, eids, weights
+
+
+def coo_to_csc(
+    row: np.ndarray,
+    col: np.ndarray,
+    num_nodes: Optional[int] = None,
+    edge_ids: Optional[np.ndarray] = None,
+    edge_weights: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[np.ndarray]]:
+  """COO -> CSC (i.e. CSR over the transposed graph)."""
+  return coo_to_csr(col, row, num_nodes, edge_ids, edge_weights)
+
+
+def csr_to_coo(
+    indptr: np.ndarray, indices: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+  return ptr2ind(indptr), indices
+
+
+def csr_to_csc(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    edge_ids: Optional[np.ndarray] = None,
+    edge_weights: Optional[np.ndarray] = None,
+):
+  row, col = csr_to_coo(indptr, indices)
+  return coo_to_csr(col, row, indptr.shape[0] - 1, edge_ids, edge_weights)

@@ -1,0 +1,22 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node is simulated
+on one machine; here multi-chip is simulated with
+``--xla_force_host_platform_device_count`` so sharding/collective paths are
+exercised without TPU hardware. Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (
+      _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+  return np.random.default_rng(0)

@@ -1,0 +1,247 @@
+"""Kernel-level golden tests on tiny hand-built CSRs.
+
+Mirrors the reference C++ kernel tests (test/cpp/test_random_sampler.cu,
+test_inducer.cu, test_subgraph.cu, test_random_negative_sampler.cu,
+test_hash_table.cu): structure assertions (degree caps, membership, reindex
+consistency), not exact samples, since sampling is seeded-random.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from graphlearn_tpu import ops
+from graphlearn_tpu.data import Topology
+
+
+def chain_star_topo():
+  """4-node graph: 0->{1,2,3}, 1->{2}, 2->{3}, 3->{}."""
+  row = np.array([0, 0, 0, 1, 2])
+  col = np.array([1, 2, 3, 2, 3])
+  return Topology(np.stack([row, col]), num_nodes=4)
+
+
+def dev(topo):
+  return jnp.asarray(topo.indptr.astype(np.int32)), jnp.asarray(topo.indices)
+
+
+# ---------------------------------------------------------------- unique
+
+def test_masked_unique():
+  ids = jnp.array([5, 3, 5, 7, 3, 9], dtype=jnp.int32)
+  mask = jnp.array([True, True, True, True, True, False])
+  uniq, count, inv = ops.masked_unique(ids, mask, size=6)
+  assert int(count) == 3
+  assert uniq[:3].tolist() == [3, 5, 7]
+  assert uniq[3:].tolist() == [ops.FILL] * 3
+  # inverse maps each valid position to its unique slot
+  np.testing.assert_array_equal(np.asarray(uniq)[np.asarray(inv[:5])],
+                                np.asarray(ids[:5]))
+  assert int(inv[5]) == -1
+
+
+def test_masked_unique_all_masked():
+  ids = jnp.array([1, 2], dtype=jnp.int32)
+  uniq, count, inv = ops.masked_unique(ids, jnp.zeros(2, bool), size=2)
+  assert int(count) == 0
+  assert uniq.tolist() == [ops.FILL, ops.FILL]
+  assert inv.tolist() == [-1, -1]
+
+
+# ---------------------------------------------------------------- sampling
+
+def test_uniform_sample_structure():
+  topo = chain_star_topo()
+  indptr, indices = dev(topo)
+  seeds = jnp.array([0, 3, 2], dtype=jnp.int32)
+  mask = jnp.ones(3, bool)
+  nbrs, epos, m = ops.uniform_sample(indptr, indices, seeds, mask, 2,
+                                     jax.random.PRNGKey(0))
+  assert nbrs.shape == (3, 2)
+  # seed 0 has deg 3 > k=2: both valid, members of {1,2,3}
+  assert bool(m[0].all())
+  assert set(np.asarray(nbrs[0]).tolist()) <= {1, 2, 3}
+  # seed 3 has deg 0: nothing valid
+  assert not bool(m[1].any())
+  assert nbrs[1].tolist() == [ops.FILL] * 2
+  # seed 2 has deg 1 <= k: exactly neighbor 3, in order
+  assert m[2].tolist() == [True, False]
+  assert int(nbrs[2, 0]) == 3
+  # epos points at real CSR slots
+  assert int(indices[epos[2, 0]]) == 3
+
+
+def test_uniform_sample_deg_le_k_keeps_all():
+  topo = chain_star_topo()
+  indptr, indices = dev(topo)
+  seeds = jnp.array([0], dtype=jnp.int32)
+  nbrs, _, m = ops.uniform_sample(indptr, indices, seeds, jnp.ones(1, bool),
+                                  5, jax.random.PRNGKey(1))
+  assert m[0].tolist() == [True, True, True, False, False]
+  assert set(np.asarray(nbrs[0, :3]).tolist()) == {1, 2, 3}
+
+
+def test_uniform_sample_masked_seed():
+  topo = chain_star_topo()
+  indptr, indices = dev(topo)
+  seeds = jnp.array([0, 0], dtype=jnp.int32)
+  mask = jnp.array([True, False])
+  _, _, m = ops.uniform_sample(indptr, indices, seeds, mask, 2,
+                               jax.random.PRNGKey(2))
+  assert not bool(m[1].any())
+
+
+def test_weighted_sample_bias():
+  # node 0 -> {1 (w=100), 2 (w=1)}: draws should overwhelmingly pick 1.
+  row = np.array([0, 0])
+  col = np.array([1, 2])
+  topo = Topology(np.stack([row, col]), num_nodes=3,
+                  edge_weights=np.array([100.0, 1.0], np.float32))
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  indices = jnp.asarray(topo.indices)
+  cum = ops.build_row_cumsum(indptr, jnp.asarray(topo.edge_weights))
+  seeds = jnp.zeros((64,), jnp.int32)
+  nbrs, _, m = ops.weighted_sample(indptr, indices, cum, seeds,
+                                   jnp.ones(64, bool), 1,
+                                   jax.random.PRNGKey(3))
+  assert bool(m.all())
+  picks = np.asarray(nbrs).reshape(-1)
+  assert (picks == 1).mean() > 0.9
+
+
+def test_weighted_sample_keep_all_when_small_degree():
+  row = np.array([0, 0])
+  col = np.array([1, 2])
+  topo = Topology(np.stack([row, col]), num_nodes=3,
+                  edge_weights=np.array([1.0, 9.0], np.float32))
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  cum = ops.build_row_cumsum(indptr, jnp.asarray(topo.edge_weights))
+  nbrs, _, m = ops.weighted_sample(indptr, jnp.asarray(topo.indices), cum,
+                                   jnp.zeros(1, jnp.int32),
+                                   jnp.ones(1, bool), 4,
+                                   jax.random.PRNGKey(4))
+  assert m[0].tolist() == [True, True, False, False]
+  assert set(np.asarray(nbrs[0, :2]).tolist()) == {1, 2}
+
+
+# ---------------------------------------------------------------- membership
+
+def test_edge_in_csr():
+  topo = chain_star_topo()
+  sorted_idx, _ = ops.sort_csr_segments(topo.indptr, topo.indices)
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  rows = jnp.array([0, 0, 1, 3, 2], dtype=jnp.int32)
+  cols = jnp.array([1, 0, 2, 0, 3], dtype=jnp.int32)
+  hit = ops.edge_in_csr(indptr, jnp.asarray(sorted_idx), rows, cols)
+  assert hit.tolist() == [True, False, True, False, True]
+
+
+def test_negative_sample():
+  topo = chain_star_topo()
+  sorted_idx, _ = ops.sort_csr_segments(topo.indptr, topo.indices)
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  rows, cols, mask = ops.random_negative_sample(
+      indptr, jnp.asarray(sorted_idx), 4, 4, 8, jax.random.PRNGKey(5),
+      trials=8)
+  rows, cols, mask = map(np.asarray, (rows, cols, mask))
+  edge_set = set(zip(*chain_star_topo().to_coo()))
+  edge_set = {(int(r), int(c)) for r, c in zip(*topo.to_coo())}
+  for r, c, m in zip(rows, cols, mask):
+    if m:
+      assert (r, c) not in edge_set
+
+
+def test_negative_sample_padding_fills():
+  # complete digraph on 2 nodes incl self loops -> no negatives exist
+  row = np.array([0, 0, 1, 1])
+  col = np.array([0, 1, 0, 1])
+  topo = Topology(np.stack([row, col]), num_nodes=2)
+  sorted_idx, _ = ops.sort_csr_segments(topo.indptr, topo.indices)
+  indptr = jnp.asarray(topo.indptr.astype(np.int32))
+  _, _, mask = ops.random_negative_sample(
+      indptr, jnp.asarray(sorted_idx), 2, 2, 4, jax.random.PRNGKey(6),
+      trials=2, padding=True)
+  assert bool(np.asarray(mask).all())
+
+
+# ---------------------------------------------------------------- inducer
+
+def test_inducer_two_hops():
+  topo = chain_star_topo()
+  indptr, indices = dev(topo)
+  seeds = jnp.array([0, 0, 1], dtype=jnp.int32)  # dup seed exercises dedup
+  state, uniq_seeds, seed_mask = ops.init_node(seeds, jnp.ones(3, bool),
+                                               capacity=32)
+  assert int(state.num_nodes) == 2
+  assert uniq_seeds[:2].tolist() == [0, 1]
+
+  # hop 1 from frontier [0, 1] (local idx 0, 1)
+  frontier = uniq_seeds
+  nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier, seed_mask,
+                                     3, jax.random.PRNGKey(7))
+  src_idx = jnp.arange(3, dtype=jnp.int32)
+  state, out = ops.induce_next(state, src_idx, nbrs, m)
+
+  nodes = np.asarray(state.nodes)
+  n = int(state.num_nodes)
+  # local ids are consistent: nodes[row] -> nodes[col] must be a real edge
+  rows, cols, em = (np.asarray(out['rows']), np.asarray(out['cols']),
+                    np.asarray(out['edge_mask']))
+  edge_set = {(int(r), int(c)) for r, c in zip(*topo.to_coo())}
+  for r, c, valid in zip(rows, cols, em):
+    if valid:
+      assert (nodes[r], nodes[c]) in edge_set
+  # frontier contains only newly added nodes, matching num_new
+  fmask = np.asarray(out['frontier_mask'])
+  fr = np.asarray(out['frontier'])[fmask]
+  assert len(fr) == int(out['num_new'])
+  assert set(fr.tolist()).isdisjoint({0, 1})
+  # every frontier node appears in the node buffer at its frontier_idx
+  fidx = np.asarray(out['frontier_idx'])[fmask]
+  np.testing.assert_array_equal(nodes[fidx], fr)
+  # no duplicates in node buffer
+  assert len(set(nodes[:n].tolist())) == n
+
+  # hop 2: sampling from hop-1 frontier keeps global dedup
+  state2, out2 = ops.induce_next(
+      state, out['frontier_idx'],
+      *ops.uniform_sample(indptr, indices, out['frontier'],
+                          out['frontier_mask'], 2,
+                          jax.random.PRNGKey(8))[::2])
+  n2 = int(state2.num_nodes)
+  nodes2 = np.asarray(state2.nodes)
+  assert len(set(nodes2[:n2].tolist())) == n2
+
+
+# ---------------------------------------------------------------- subgraph
+
+def test_node_subgraph():
+  topo = chain_star_topo()
+  indptr, indices = dev(topo)
+  srcs = jnp.array([0, 2, 3, 0], dtype=jnp.int32)  # set {0, 2, 3}
+  out = ops.node_subgraph(indptr, indices, srcs, jnp.ones(4, bool),
+                          max_degree=4)
+  assert int(out['num_nodes']) == 3
+  nodes = np.asarray(out['nodes'])[:3]
+  assert nodes.tolist() == [0, 2, 3]
+  rows = np.asarray(out['rows'])
+  cols = np.asarray(out['cols'])
+  em = np.asarray(out['edge_mask'])
+  got = {(nodes[r], nodes[c]) for r, c, v in zip(rows, cols, em) if v}
+  # induced edges among {0,2,3}: 0->2, 0->3, 2->3
+  assert got == {(0, 2), (0, 3), (2, 3)}
+
+
+# ---------------------------------------------------------------- stitch
+
+def test_stitch_rows():
+  idx0 = jnp.array([2, 0], dtype=jnp.int32)
+  rows0 = jnp.array([[10, 11], [20, ops.FILL]], dtype=jnp.int32)
+  m0 = jnp.array([[True, True], [True, False]])
+  idx1 = jnp.array([1], dtype=jnp.int32)
+  rows1 = jnp.array([[30, 31]], dtype=jnp.int32)
+  m1 = jnp.array([[True, True]])
+  out, om = ops.stitch_rows([idx0, idx1], [rows0, rows1], [m0, m1], 3)
+  assert out[2].tolist() == [10, 11]
+  assert out[0, 0].tolist() == 20
+  assert om[0].tolist() == [True, False]
+  assert out[1].tolist() == [30, 31]

@@ -1,0 +1,77 @@
+"""Topology/Graph storage tests (mirrors reference test/python/test_graph.py)."""
+import numpy as np
+import pytest
+
+from graphlearn_tpu.data import Graph, Topology
+from graphlearn_tpu.utils import coo_to_csr, ind2ptr, ptr2ind
+
+
+def tiny_coo():
+  # 0->1, 0->2, 1->2, 2->0, 2->3, 3->3(self)
+  row = np.array([0, 0, 1, 2, 2, 3])
+  col = np.array([1, 2, 2, 0, 3, 3])
+  return row, col
+
+
+def test_coo_to_csr_roundtrip():
+  row, col = tiny_coo()
+  indptr, indices, eids, _ = coo_to_csr(row, col, 4)
+  assert indptr.tolist() == [0, 2, 3, 5, 6]
+  assert ptr2ind(indptr).tolist() == row.tolist()
+  np.testing.assert_array_equal(ind2ptr(row, 4), indptr)
+  # edge ids address the original COO position
+  np.testing.assert_array_equal(col[eids], indices)
+
+
+def test_topology_csr_layout():
+  row, col = tiny_coo()
+  topo = Topology(np.stack([row, col]), layout='CSR')
+  assert topo.num_nodes == 4
+  assert topo.num_edges == 6
+  assert topo.degrees.tolist() == [2, 1, 2, 1]
+  assert topo.degree(np.array([2, 0])).tolist() == [2, 2]
+  assert topo.max_degree == 2
+  r, c = topo.to_coo()
+  assert sorted(zip(r.tolist(), c.tolist())) == sorted(
+      zip(row.tolist(), col.tolist()))
+
+
+def test_topology_csc_layout():
+  row, col = tiny_coo()
+  topo = Topology(np.stack([row, col]), layout='CSC')
+  # grouped by dst: in-degrees
+  assert topo.degrees.tolist() == [1, 1, 2, 2]
+  r, c = topo.to_coo()
+  assert sorted(zip(r.tolist(), c.tolist())) == sorted(
+      zip(row.tolist(), col.tolist()))
+
+
+def test_topology_from_csr_input():
+  row, col = tiny_coo()
+  indptr, indices, _, _ = coo_to_csr(row, col, 4)
+  topo = Topology((indptr, indices), input_layout='CSR', layout='CSR')
+  np.testing.assert_array_equal(topo.indptr, indptr)
+  np.testing.assert_array_equal(topo.indices, indices)
+
+
+def test_topology_weights_follow_edges():
+  row, col = tiny_coo()
+  w = np.arange(6, dtype=np.float32) + 1.0
+  topo = Topology(np.stack([row, col]), edge_weights=w, layout='CSR')
+  # weight of edge (2->0) is w[3]=4.0; row 2 starts at indptr[2]
+  s = topo.indptr[2]
+  seg = topo.indices[s:s + 2].tolist()
+  wseg = topo.edge_weights[s:s + 2].tolist()
+  assert dict(zip(seg, wseg)) == {0: 4.0, 3: 5.0}
+
+
+@pytest.mark.parametrize('mode', ['CPU', 'HBM', 'ZERO_COPY'])
+def test_graph_modes(mode):
+  row, col = tiny_coo()
+  topo = Topology(np.stack([row, col]))
+  g = Graph(topo, mode=mode)
+  assert g.num_nodes == 4
+  assert g.num_edges == 6
+  np.testing.assert_array_equal(np.asarray(g.indptr), topo.indptr)
+  np.testing.assert_array_equal(np.asarray(g.indices), topo.indices)
+  assert g.degree([0, 3]).tolist() == [2, 1]
