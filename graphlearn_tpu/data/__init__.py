@@ -1,1 +1,5 @@
+from .dataset import Dataset
+from .feature import DeviceGroup, Feature
 from .graph import Graph, Topology
+from .reorder import sort_by_in_degree
+from .unified_tensor import UnifiedTensor

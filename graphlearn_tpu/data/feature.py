@@ -1,0 +1,135 @@
+"""Feature store: hot rows in HBM, cold rows on host.
+
+TPU-native re-design of /root/reference/graphlearn_torch/python/data/feature.py.
+The reference splits rows by ``split_ratio`` into a GPU part (replicated per
+NVLink ``DeviceGroup``, sharded within the group via UnifiedTensor p2p) and a
+pinned-CPU zero-copy part. On TPU the split maps to: hot prefix resident in
+device HBM (optionally sharded across a mesh axis — replication/sharding is
+XLA's job, so ``DeviceGroup`` is a thin shard-placement descriptor), cold tail
+in host RAM gathered per batch. ``id2index`` carries the hotness reorder
+(data/reorder.py) exactly like the reference (feature.py:147-153).
+"""
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .unified_tensor import UnifiedTensor
+
+
+class DeviceGroup:
+  """A group of devices that jointly hold one replica of the hot rows.
+
+  Reference: data/feature.py:31-44 (NVLink p2p groups). On TPU the group is
+  a set of mesh devices the hot table is sharded over; the gather resolves
+  the shard through XLA instead of p2p pointers.
+  """
+
+  def __init__(self, group_id: int, device_list: Sequence):
+    self.group_id = group_id
+    self.device_list = list(device_list)
+
+  @property
+  def size(self):
+    return len(self.device_list)
+
+
+class Feature:
+  """2-D feature store with hot/cold split (reference: data/feature.py:47-279).
+
+  Args:
+    feature_array: [N, F] host rows (already reordered if ``id2index`` given).
+    split_ratio: fraction of rows kept in HBM (0 = all host, 1 = all HBM).
+    device_group_list: optional DeviceGroups for sharded HBM placement.
+    device: explicit device for the hot part (default: default device).
+    with_device: False forces a pure-host store (reference ``with_gpu``).
+    id2index: optional [N] old-id -> row map from the hotness reorder.
+    dtype: optional storage dtype (e.g. jnp.bfloat16 to halve HBM).
+  """
+
+  def __init__(
+      self,
+      feature_array: np.ndarray,
+      split_ratio: float = 0.0,
+      device_group_list: Optional[List[DeviceGroup]] = None,
+      device=None,
+      with_device: bool = True,
+      id2index: Optional[np.ndarray] = None,
+      dtype=None,
+  ):
+    self.feature_array = np.asarray(feature_array)
+    self.split_ratio = float(split_ratio)
+    self.device_group_list = device_group_list
+    self.device = device
+    self.with_device = with_device
+    self._id2index = id2index
+    self.dtype = dtype
+    self._unified = None
+    self._id2index_dev = None
+
+  def lazy_init(self):
+    if self._unified is not None:
+      return
+    n = self.feature_array.shape[0]
+    if not self.with_device:
+      hot = 0
+    else:
+      hot = int(n * self.split_ratio)
+    ut = UnifiedTensor(device=self.device, dtype=self.dtype)
+    ut.init_from(self.feature_array[:hot] if hot else None,
+                 self.feature_array[hot:] if hot < n else None)
+    self._unified = ut
+    if self._id2index is not None:
+      import jax
+      self._id2index_dev = jax.device_put(self._id2index, self.device)
+
+  @property
+  def id2index(self):
+    return self._id2index
+
+  @property
+  def unified(self) -> UnifiedTensor:
+    self.lazy_init()
+    return self._unified
+
+  def __getitem__(self, ids):
+    """Gather rows for global node ids (applies id2index remap).
+
+    Reference: Feature.__getitem__ (feature.py:140-153).
+    """
+    import jax.numpy as jnp
+    self.lazy_init()
+    ids = jnp.asarray(ids)
+    if self._id2index_dev is not None:
+      ids = jnp.take(self._id2index_dev, ids, axis=0)
+    return self._unified[ids]
+
+  def cpu_get(self, ids) -> np.ndarray:
+    """Pure-host gather (used by remote feature serving where the result is
+    immediately serialized; reference Feature.cpu_get via feature.py:122-132
+    local_get path)."""
+    ids = np.asarray(ids)
+    if self._id2index is not None:
+      ids = self._id2index[ids]
+    return self.feature_array[ids]
+
+  @property
+  def shape(self):
+    return self.feature_array.shape
+
+  @property
+  def size(self) -> int:
+    return int(self.feature_array.shape[0])
+
+  def __len__(self):
+    return self.size
+
+  def share_ipc(self):
+    """Hand host arrays to another consumer (reference feature.py:240-257's
+    CUDA-IPC re-init collapses to host-array handoff on TPU)."""
+    return (self.feature_array, self.split_ratio, self.device,
+            self.with_device, self._id2index, self.dtype)
+
+  @classmethod
+  def from_ipc_handle(cls, handle):
+    arr, split_ratio, device, with_device, id2index, dtype = handle
+    return cls(arr, split_ratio, None, device, with_device, id2index, dtype)

@@ -1,0 +1,49 @@
+"""Hotness reorder of feature rows by in-degree.
+
+TPU-native port of /root/reference/graphlearn_torch/python/data/reorder.py:
+rows are permuted so the hottest (highest in-degree) vertices come first,
+which lets the feature store keep a prefix of rows in HBM and the tail on
+host. Returns the permuted features plus the old-id -> new-row map
+(``id2index``) that lookups must apply.
+"""
+from typing import Tuple
+
+import numpy as np
+
+
+def sort_by_in_degree(
+    feature: np.ndarray,
+    split_ratio: float,
+    topology,
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Reorder ``feature`` rows hot-first by in-degree.
+
+  Reference semantics (reorder.py:19-36): only the hot prefix (fraction
+  ``split_ratio``) needs to be degree-sorted; the reference partially
+  shuffles within the split for load balance — here the full descending
+  sort is kept (deterministic, and shard balance on TPU comes from XLA's
+  row-sharding instead).
+
+  Args:
+    feature: [N, F] rows indexed by node id.
+    split_ratio: fraction of rows that will live on device.
+    topology: ``Topology`` whose in-degrees define hotness. If its layout is
+      CSC, ``degrees`` are in-degrees already; if CSR, in-degrees are
+      computed from the column indices.
+
+  Returns:
+    (reordered [N, F], id2index [N]) with reordered[id2index[v]] ==
+    feature[v].
+  """
+  n = feature.shape[0]
+  if topology.layout == 'CSC':
+    in_deg = np.zeros((n,), dtype=np.int64)
+    d = topology.degrees
+    in_deg[:d.shape[0]] = d
+  else:
+    in_deg = np.bincount(topology.indices, minlength=n).astype(np.int64)
+  del split_ratio  # full sort; ratio only matters to the caller's split
+  order = np.argsort(-in_deg, kind='stable')  # hot first
+  id2index = np.empty((n,), dtype=np.int64)
+  id2index[order] = np.arange(n, dtype=np.int64)
+  return feature[order], id2index

@@ -1,0 +1,110 @@
+"""Unified host/device feature table.
+
+TPU-native re-design of the reference's UnifiedTensor
+(/root/reference/graphlearn_torch/csrc/cuda/unified_tensor.cu and
+python/data/unified_tensor.py): there, a virtual 2-D tensor spans shards on
+several p2p GPUs plus a pinned-CPU zero-copy shard, and a warp-per-row gather
+kernel resolves the owning device by binary search over an offset table.
+
+On TPU there is no UVA: device reads cannot page host memory. The equivalent
+split is *hot rows resident in HBM* (optionally sharded over a mesh axis —
+XLA's gather resolves the shard, replacing the reference's device binary
+search) and *cold rows in host RAM*, gathered on host and shipped once per
+batch. The row order is [device rows 0..H) | host rows H..N), matching the
+reference's offset-table layout with a single device "group".
+"""
+from typing import Optional
+
+import numpy as np
+
+
+class UnifiedTensor:
+  """A virtual [N, F] tensor = device part (rows [0, H)) + host part [H, N).
+
+  Reference parity: UnifiedTensor::InitFrom / AppendCPUTensor /
+  AppendSharedTensor / operator[] (unified_tensor.cu:168-338). The device
+  part plays the role of the GPU shards; the host part replaces the
+  pinned-CPU zero-copy shard.
+  """
+
+  def __init__(self, device=None, dtype=None):
+    self.device = device
+    self.dtype = dtype
+    self._device_part = None   # jax.Array [H, F] in HBM
+    self._host_part = None     # np.ndarray [N-H, F] in host RAM
+    self._device_rows = 0
+
+  def init_from(self, device_rows: Optional[np.ndarray],
+                host_rows: Optional[np.ndarray]):
+    """Build from a hot (device) block and a cold (host) block.
+
+    Reference: UnifiedTensor::InitFrom(tensors, devices) +
+    AppendCPUTensor (unified_tensor.cu:202,271).
+    """
+    import jax
+    if device_rows is not None and device_rows.size:
+      arr = np.ascontiguousarray(device_rows)
+      if self.dtype is not None:
+        arr = arr.astype(self.dtype)
+      self._device_part = (jax.device_put(arr, self.device)
+                           if self.device is not None else jax.device_put(arr))
+      self._device_rows = int(arr.shape[0])
+    if host_rows is not None and host_rows.size:
+      arr = np.ascontiguousarray(host_rows)
+      if self.dtype is not None:
+        arr = arr.astype(self.dtype)
+      self._host_part = arr
+    return self
+
+  @property
+  def device_part(self):
+    return self._device_part
+
+  @property
+  def host_part(self):
+    return self._host_part
+
+  @property
+  def shape(self):
+    h = self._device_rows
+    n = h + (self._host_part.shape[0] if self._host_part is not None else 0)
+    f = (self._device_part.shape[1] if self._device_part is not None
+         else self._host_part.shape[1])
+    return (n, f)
+
+  @property
+  def size(self) -> int:
+    return self.shape[0]
+
+  def __getitem__(self, ids):
+    """Gather rows by global row index; returns a device array.
+
+    Hot rows come straight from HBM; cold rows are gathered on host and
+    shipped in one transfer (replacement for the reference's UVA reads
+    inside GatherTensorKernel, unified_tensor.cu:48-81).
+    """
+    import jax
+    import jax.numpy as jnp
+    ids = jnp.asarray(ids)
+    if self._host_part is None:
+      return jnp.take(self._device_part, ids, axis=0)
+    if self._device_part is None:
+      host = np.take(self._host_part, np.asarray(ids) - self._device_rows,
+                     axis=0)
+      return jax.device_put(host, self.device)
+    # Mixed: one device gather + one host gather, then select.
+    ids_np = np.asarray(ids)
+    is_hot = ids_np < self._device_rows
+    host_ids = np.where(is_hot, 0, ids_np - self._device_rows)
+    host_rows = jax.device_put(
+        np.take(self._host_part, host_ids, axis=0), self.device)
+    hot_ids = jnp.where(jnp.asarray(is_hot), ids, 0)
+    dev_rows = jnp.take(self._device_part, hot_ids, axis=0)
+    return jnp.where(jnp.asarray(is_hot)[:, None], dev_rows, host_rows)
+
+  def share_ipc(self):
+    """Single-process-per-host on TPU: sharing = handing over host arrays
+    (reference ShareCUDAIpc, unified_tensor.cu:367-381)."""
+    dev = (np.asarray(self._device_part)
+           if self._device_part is not None else None)
+    return dev, self._host_part, self.device

@@ -1,0 +1,99 @@
+"""Feature store tests, mirroring the reference's
+test/python/test_feature.py + test_unified_tensor.py (with/without degree
+sort, host-only, device-only, mixed split)."""
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+
+
+def make_feat(n=40, f=8):
+  return (np.arange(n, dtype=np.float32)[:, None]
+          * np.ones((1, f), np.float32))
+
+
+@pytest.mark.parametrize('split_ratio', [0.0, 0.4, 1.0])
+def test_feature_lookup(split_ratio):
+  feat = make_feat()
+  store = glt.data.Feature(feat, split_ratio=split_ratio)
+  ids = np.array([0, 5, 39, 17], dtype=np.int32)
+  out = np.asarray(store[ids])
+  np.testing.assert_allclose(out, feat[ids])
+
+
+def test_feature_host_only():
+  feat = make_feat()
+  store = glt.data.Feature(feat, split_ratio=0.8, with_device=False)
+  ids = np.array([3, 2, 1], np.int32)
+  np.testing.assert_allclose(np.asarray(store[ids]), feat[ids])
+  np.testing.assert_allclose(store.cpu_get(ids), feat[ids])
+
+
+def test_feature_with_degree_sort():
+  # Ring graph 0->1->2->...->9->0: every in-degree equal; add extra edges
+  # into node 7 and 3 so they are hottest.
+  row = np.array([0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 0, 1, 2, 4, 5])
+  col = np.array([1, 2, 3, 4, 5, 6, 7, 8, 9, 0, 7, 7, 7, 3, 3])
+  topo = glt.data.Topology(np.stack([row, col]), layout='CSR', num_nodes=10)
+  feat = make_feat(10, 4)
+  reordered, id2index = glt.data.sort_by_in_degree(feat, 0.3, topo)
+  # Hottest first: node 7 (deg 4), then node 3 (deg 3).
+  assert id2index[7] == 0
+  assert id2index[3] == 1
+  np.testing.assert_allclose(reordered[id2index[5]], feat[5])
+
+  store = glt.data.Feature(reordered, split_ratio=0.3, id2index=id2index)
+  ids = np.array([7, 3, 5, 0], np.int32)
+  np.testing.assert_allclose(np.asarray(store[ids]), feat[ids])
+
+
+def test_unified_tensor_mixed():
+  feat = make_feat(20, 4)
+  ut = glt.data.UnifiedTensor().init_from(feat[:8], feat[8:])
+  assert ut.shape == (20, 4)
+  ids = np.array([0, 7, 8, 19, 4, 12], np.int32)
+  np.testing.assert_allclose(np.asarray(ut[ids]), feat[ids])
+
+
+def test_feature_ipc_roundtrip():
+  feat = make_feat(10, 4)
+  store = glt.data.Feature(feat, split_ratio=0.5)
+  clone = glt.data.Feature.from_ipc_handle(store.share_ipc())
+  ids = np.array([9, 0, 4], np.int32)
+  np.testing.assert_allclose(np.asarray(clone[ids]), feat[ids])
+
+
+def test_dataset_homo():
+  row = np.array([0, 0, 1, 2, 3])
+  col = np.array([1, 2, 2, 3, 0])
+  feat = make_feat(4, 4)
+  labels = np.array([0, 1, 0, 1])
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([row, col]), graph_mode='CPU')
+  ds.init_node_features(feat, sort_func=glt.data.sort_by_in_degree,
+                        split_ratio=0.5)
+  ds.init_node_labels(labels)
+  assert not ds.is_hetero
+  assert ds.get_graph().num_edges == 5
+  ids = np.array([2, 0], np.int32)
+  np.testing.assert_allclose(np.asarray(ds.node_features[ids]), feat[ids])
+  np.testing.assert_array_equal(ds.get_node_label(), labels)
+
+
+def test_dataset_hetero():
+  ei = {
+      ('user', 'buys', 'item'): np.array([[0, 1, 2], [0, 0, 1]]),
+      ('item', 'rev_buys', 'user'): np.array([[0, 0, 1], [0, 1, 2]]),
+  }
+  nfeat = {'user': make_feat(3, 4), 'item': make_feat(2, 4)}
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph(ei, graph_mode='CPU',
+                num_nodes={('user', 'buys', 'item'): 3,
+                           ('item', 'rev_buys', 'user'): 2})
+  ds.init_node_features(nfeat)
+  assert ds.is_hetero
+  assert set(ds.get_node_types()) == {'user', 'item'}
+  assert ds.get_graph(('user', 'buys', 'item')).num_edges == 3
+  ids = np.array([1, 0], np.int32)
+  np.testing.assert_allclose(
+      np.asarray(ds.get_node_feature('user')[ids]), nfeat['user'][ids])
