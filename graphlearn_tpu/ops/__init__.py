@@ -1,4 +1,4 @@
-from .induce import InducerState, induce_next, init_node
+from .induce import InducerState, induce_next, init_empty, init_node
 from .negative import random_negative_sample, sort_csr_segments
 from .neighbor import (build_row_cumsum, edge_in_csr, uniform_sample,
                        weighted_sample)

@@ -42,16 +42,28 @@ def init_node(seeds: jax.Array, seed_mask: jax.Array, capacity: int):
   """Start a batch: dedup seeds into local indices 0..n-1.
 
   Reference: CUDAInducer::InitNode (inducer.cu:75-93). Returns
-  (state, uniq_seeds [B], uniq_mask [B]) — uniq_seeds[i] has local index i.
+  (state, uniq_seeds [B], uniq_mask [B], inverse [B]) — uniq_seeds[i] has
+  local index i, and inverse[j] is the local index of input seed j (-1
+  where masked), needed by link sampling to relocate each original seed.
   """
   b = seeds.shape[0]
-  uniq, count, _ = masked_unique(seeds, seed_mask, size=b)
+  uniq, count, inverse = masked_unique(seeds, seed_mask, size=b)
   nodes = jnp.full((capacity,), FILL, dtype=seeds.dtype)
   nodes = nodes.at[:b].set(uniq)
   sorted_vals, sorted_pos = _sort_view(nodes)
   state = InducerState(nodes, count.astype(jnp.int32), sorted_vals,
                        sorted_pos)
-  return state, uniq, jnp.arange(b) < count
+  return state, uniq, jnp.arange(b) < count, inverse
+
+
+@functools.partial(jax.jit, static_argnames=('capacity',))
+def init_empty(capacity: int, dtype=jnp.int32):
+  """An inducer state with no nodes yet (hetero: node types first reached
+  mid-hop; reference lazily keys per-type hash tables, inducer.cu hetero)."""
+  nodes = jnp.full((capacity,), FILL, dtype=dtype)
+  sorted_vals, sorted_pos = _sort_view(nodes)
+  return InducerState(nodes, jnp.asarray(0, jnp.int32), sorted_vals,
+                      sorted_pos)
 
 
 @jax.jit

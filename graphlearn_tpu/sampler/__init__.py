@@ -1,0 +1,6 @@
+from .base import (BaseSampler, EdgeSamplerInput, HeteroSamplerOutput,
+                   NegativeSampling, NeighborOutput, NodeSamplerInput,
+                   RemoteNodePathSamplerInput, RemoteSamplerInput,
+                   SamplerOutput, SamplingConfig, SamplingType)
+from .negative_sampler import RandomNegativeSampler
+from .neighbor_sampler import NeighborSampler

@@ -1,0 +1,260 @@
+"""Sampler input/output dataclasses and the abstract sampler interface.
+
+TPU-native port of /root/reference/graphlearn_torch/python/sampler/base.py.
+API surface is kept (NodeSamplerInput, EdgeSamplerInput, NegativeSampling,
+SamplerOutput, HeteroSamplerOutput, NeighborOutput, SamplingType,
+SamplingConfig, BaseSampler), with one deliberate semantic change: outputs
+are **fixed-shape and mask-padded**. The reference's CUDA samplers emit
+exact-size tensors (requiring a D2H sync per hop); on TPU exact sizes would
+retrigger XLA compilation every batch, so `node`/`row`/`col` are padded to
+static capacities and validity is carried in `node_mask`/`edge_mask` plus
+traced counts. Conversion to exact-size (PyG-style) arrays happens only at
+the host boundary via `.trim()`.
+"""
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..typing import EdgeType, NodeType
+from ..utils import CastMixin
+
+
+class SamplingType(enum.Enum):
+  """Reference: sampler/base.py:329-335."""
+  NODE = 0
+  LINK = 1
+  SUBGRAPH = 2
+  RANDOM_WALK = 3
+
+
+@dataclass
+class SamplingConfig:
+  """Bundle of sampling options (reference: sampler/base.py:338-351)."""
+  sampling_type: SamplingType
+  num_neighbors: Optional[Union[List[int], Dict[EdgeType, List[int]]]]
+  batch_size: int
+  shuffle: bool = False
+  drop_last: bool = False
+  with_edge: bool = False
+  collect_features: bool = False
+  with_neg: bool = False
+  with_weight: bool = False
+  edge_dir: str = 'out'
+  seed: Optional[int] = None
+
+
+@dataclass
+class NodeSamplerInput(CastMixin):
+  """Seed nodes for node-based sampling (reference: sampler/base.py:44-82)."""
+  node: np.ndarray
+  input_type: Optional[NodeType] = None
+
+  def __len__(self):
+    return int(np.asarray(self.node).shape[0])
+
+  def __getitem__(self, index) -> 'NodeSamplerInput':
+    return NodeSamplerInput(np.asarray(self.node)[index], self.input_type)
+
+  def share_memory(self):
+    return self
+
+
+@dataclass
+class NegativeSampling(CastMixin):
+  """Negative sampling config (reference: sampler/base.py:85-145).
+
+  mode: 'binary' (negatives become extra supervision edges with label 0) or
+  'triplet' (per-positive dst negatives for margin losses).
+  amount: ratio of negatives per positive edge.
+  """
+  mode: str = 'binary'
+  amount: Union[int, float] = 1
+
+  def __post_init__(self):
+    if self.mode not in ('binary', 'triplet'):
+      raise ValueError(f'unknown negative sampling mode {self.mode!r}')
+    if self.amount <= 0:
+      raise ValueError('negative sampling amount must be positive')
+
+  def is_binary(self) -> bool:
+    return self.mode == 'binary'
+
+  def is_triplet(self) -> bool:
+    return self.mode == 'triplet'
+
+  def num_negatives(self, num_pos: int) -> int:
+    return int(np.ceil(self.amount * num_pos))
+
+
+@dataclass
+class EdgeSamplerInput(CastMixin):
+  """Seed edges for link-based sampling (reference: sampler/base.py:149-204)."""
+  row: np.ndarray
+  col: np.ndarray
+  label: Optional[np.ndarray] = None
+  input_type: Optional[EdgeType] = None
+  neg_sampling: Optional[NegativeSampling] = None
+
+  def __len__(self):
+    return int(np.asarray(self.row).shape[0])
+
+  def __getitem__(self, index) -> 'EdgeSamplerInput':
+    return EdgeSamplerInput(
+        np.asarray(self.row)[index],
+        np.asarray(self.col)[index],
+        np.asarray(self.label)[index] if self.label is not None else None,
+        self.input_type, self.neg_sampling)
+
+  def share_memory(self):
+    return self
+
+
+@dataclass
+class NeighborOutput(CastMixin):
+  """One hop's raw sampling result (reference: sampler/base.py:305-326).
+
+  The reference packs (nbrs [sum(nbrs_num)], nbrs_num [B], edges); the
+  TPU shape-stable form is dense [B, K] + mask.
+  """
+  nbrs: Any               # [B, K] neighbor ids (FILL-padded)
+  mask: Any               # [B, K] validity
+  edges: Optional[Any] = None   # [B, K] global edge ids
+
+  @property
+  def nbrs_num(self):
+    return self.mask.sum(axis=1)
+
+
+@dataclass
+class SamplerOutput(CastMixin):
+  """Multi-hop subgraph sample (reference: sampler/base.py:207-243).
+
+  node: [cap_n] global node ids, position == local index, FILL-padded.
+  num_nodes: valid prefix length of `node`.
+  row/col: [cap_e] relabeled COO (into `node`), -1 where invalid.
+  edge: optional [cap_e] global edge ids.
+  edge_mask: [cap_e] validity.
+  batch: optional [B] seed ids (link sampling: the per-seed origin).
+  num_sampled_nodes/num_sampled_edges: per-hop counts (traced or numpy).
+  metadata: extra payloads (edge_label_index, labels, features...).
+  """
+  node: Any
+  num_nodes: Any = None
+  row: Any = None
+  col: Any = None
+  edge: Optional[Any] = None
+  edge_mask: Any = None
+  batch: Optional[Any] = None
+  batch_size: Optional[int] = None
+  num_sampled_nodes: Optional[List[Any]] = None
+  num_sampled_edges: Optional[List[Any]] = None
+  input_type: Optional[Union[NodeType, EdgeType]] = None
+  metadata: Dict[str, Any] = field(default_factory=dict)
+  device: Any = None
+
+  def trim(self) -> 'SamplerOutput':
+    """Host-boundary conversion to exact-size numpy arrays (drops padding).
+    Local indices stay valid because padding occupies the tail."""
+    node = np.asarray(self.node)
+    n = int(self.num_nodes) if self.num_nodes is not None else node.shape[0]
+    out = SamplerOutput(node=node[:n], num_nodes=n,
+                        input_type=self.input_type,
+                        batch_size=self.batch_size, metadata=self.metadata)
+    if self.row is not None:
+      row = np.asarray(self.row)
+      col = np.asarray(self.col)
+      mask = (np.asarray(self.edge_mask) if self.edge_mask is not None
+              else (row >= 0))
+      mask = mask & (row >= 0) & (col >= 0)
+      out.row, out.col = row[mask], col[mask]
+      if self.edge is not None:
+        out.edge = np.asarray(self.edge)[mask]
+      out.edge_mask = None
+    if self.batch is not None:
+      out.batch = np.asarray(self.batch)
+    if self.num_sampled_nodes is not None:
+      out.num_sampled_nodes = [int(x) for x in self.num_sampled_nodes]
+    if self.num_sampled_edges is not None:
+      out.num_sampled_edges = [int(x) for x in self.num_sampled_edges]
+    return out
+
+
+@dataclass
+class HeteroSamplerOutput(CastMixin):
+  """Hetero multi-hop sample (reference: sampler/base.py:245-302):
+  per-node-type node buffers and per-edge-type relabeled COO."""
+  node: Dict[NodeType, Any]
+  num_nodes: Dict[NodeType, Any] = None
+  row: Dict[EdgeType, Any] = None
+  col: Dict[EdgeType, Any] = None
+  edge: Optional[Dict[EdgeType, Any]] = None
+  edge_mask: Dict[EdgeType, Any] = None
+  batch: Optional[Dict[NodeType, Any]] = None
+  batch_size: Optional[int] = None
+  num_sampled_nodes: Optional[Dict[NodeType, List[Any]]] = None
+  num_sampled_edges: Optional[Dict[EdgeType, List[Any]]] = None
+  input_type: Optional[Union[NodeType, EdgeType]] = None
+  metadata: Dict[str, Any] = field(default_factory=dict)
+  device: Any = None
+
+  def trim(self) -> 'HeteroSamplerOutput':
+    node, num_nodes = {}, {}
+    for t, buf in self.node.items():
+      buf = np.asarray(buf)
+      n = (int(self.num_nodes[t]) if self.num_nodes is not None
+           else buf.shape[0])
+      node[t], num_nodes[t] = buf[:n], n
+    out = HeteroSamplerOutput(node=node, num_nodes=num_nodes,
+                              input_type=self.input_type,
+                              batch_size=self.batch_size,
+                              metadata=self.metadata)
+    if self.row is not None:
+      out.row, out.col, out.edge = {}, {}, ({} if self.edge else None)
+      for et, row in self.row.items():
+        row = np.asarray(row)
+        col = np.asarray(self.col[et])
+        mask = (np.asarray(self.edge_mask[et]) if self.edge_mask is not None
+                else np.ones_like(row, bool))
+        mask = mask & (row >= 0) & (col >= 0)
+        out.row[et], out.col[et] = row[mask], col[mask]
+        if self.edge is not None and self.edge.get(et) is not None:
+          out.edge[et] = np.asarray(self.edge[et])[mask]
+      out.edge_mask = None
+    if self.batch is not None:
+      out.batch = {t: np.asarray(v) for t, v in self.batch.items()}
+    return out
+
+
+class RemoteSamplerInput(CastMixin):
+  """Server-resident seed source (reference: sampler/base.py:408-420)."""
+
+  def to_input(self):
+    raise NotImplementedError
+
+
+class RemoteNodePathSamplerInput(RemoteSamplerInput):
+  """Seeds loaded from a file path on the server
+  (reference: sampler/base.py:423-435)."""
+
+  def __init__(self, node_path: str, input_type: Optional[NodeType] = None):
+    self.node_path = node_path
+    self.input_type = input_type
+
+  def to_input(self) -> NodeSamplerInput:
+    seeds = np.load(self.node_path)
+    return NodeSamplerInput(seeds, self.input_type)
+
+
+class BaseSampler:
+  """Abstract sampler (reference: sampler/base.py:354-406)."""
+
+  def sample_from_nodes(self, inputs: NodeSamplerInput, **kwargs):
+    raise NotImplementedError
+
+  def sample_from_edges(self, inputs: EdgeSamplerInput, **kwargs):
+    raise NotImplementedError
+
+  def subgraph(self, inputs: NodeSamplerInput, **kwargs):
+    raise NotImplementedError

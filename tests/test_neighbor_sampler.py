@@ -1,0 +1,166 @@
+"""NeighborSampler tests, mirroring the reference's
+test/python/test_neighbor_sampler.py (node/edge seeds x with-edge x
+weighted) and test_hetero_neighbor_sampler.py. Like the reference, tests
+assert structure (membership, degree caps, relabel consistency), not exact
+samples (seeded PRNG differs by design)."""
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.sampler import (EdgeSamplerInput, NegativeSampling,
+                                    NodeSamplerInput)
+
+
+def make_graph(mode='CPU'):
+  # 8-node graph: i -> (i+1)%8, i -> (i+2)%8, plus hub edges 0 -> all.
+  rows, cols = [], []
+  for i in range(8):
+    rows += [i, i]
+    cols += [(i + 1) % 8, (i + 2) % 8]
+  for j in range(1, 8):
+    rows.append(0)
+    cols.append(j)
+  ei = np.stack([np.array(rows), np.array(cols)])
+  topo = glt.data.Topology(ei, num_nodes=8)
+  return glt.data.Graph(topo, mode), topo, ei
+
+
+def adjacency_set(ei):
+  return {(int(r), int(c)) for r, c in zip(ei[0], ei[1])}
+
+
+@pytest.mark.parametrize('with_edge', [False, True])
+def test_sample_from_nodes_homo(with_edge):
+  graph, topo, ei = make_graph()
+  adj = adjacency_set(ei)
+  sampler = glt.sampler.NeighborSampler(graph, [2, 2], with_edge=with_edge,
+                                        seed=42)
+  seeds = np.array([0, 3, 3, 5])
+  out = sampler.sample_from_nodes(NodeSamplerInput(seeds)).trim()
+
+  # Seeds come first and deduped.
+  assert set(out.node[:3].tolist()) == {0, 3, 5}
+  assert len(set(out.node.tolist())) == out.num_nodes
+  # All emitted edges are real edges, in message direction (row -> col means
+  # col sampled row as neighbor, so (col, row) must be a graph edge).
+  for r, c in zip(out.row, out.col):
+    u, v = int(out.node[c]), int(out.node[r])
+    assert (u, v) in adj
+  if with_edge:
+    assert out.edge.shape == out.row.shape
+    # edge ids are original COO input positions (Topology default); each
+    # sampled edge id must decode to (seed, neighbor) of its row/col pair.
+    for e, r, c in zip(out.edge, out.row, out.col):
+      assert ei[0][e] == int(out.node[c])
+      assert ei[1][e] == int(out.node[r])
+
+
+def test_fanout_cap():
+  graph, _, _ = make_graph()
+  sampler = glt.sampler.NeighborSampler(graph, [3], seed=0)
+  out = sampler.sample_from_nodes(NodeSamplerInput(np.array([0])))
+  # node 0 has degree 9 but fanout 3: exactly 3 edges sampled.
+  assert int(np.asarray(out.num_sampled_edges[0])) == 3
+
+
+def test_weighted_sampling_bias():
+  # node 0 -> {1..5}; weight on edge (0,1) dominates.
+  rows = np.zeros(5, np.int64)
+  cols = np.arange(1, 6)
+  w = np.array([100.0, 1e-6, 1e-6, 1e-6, 1e-6], np.float32)
+  topo = glt.data.Topology(np.stack([rows, cols]), edge_weights=w,
+                           num_nodes=6)
+  graph = glt.data.Graph(topo, 'CPU')
+  sampler = glt.sampler.NeighborSampler(graph, [3], with_weight=True,
+                                        seed=1)
+  out = sampler.sample_from_nodes(NodeSamplerInput(np.array([0]))).trim()
+  # With deg=5 > k=3, draws are weight-biased: node 1 must appear.
+  sampled_globals = {int(out.node[r]) for r in out.row}
+  assert 1 in sampled_globals
+
+
+def test_sample_from_edges_binary():
+  graph, _, ei = make_graph()
+  adj = adjacency_set(ei)
+  sampler = glt.sampler.NeighborSampler(graph, [2], seed=3)
+  inputs = EdgeSamplerInput(
+      row=ei[0][:4].copy(), col=ei[1][:4].copy(),
+      neg_sampling=NegativeSampling('binary', 1))
+  out = sampler.sample_from_edges(inputs)
+  eli = np.asarray(out.metadata['edge_label_index'])
+  label = np.asarray(out.metadata['edge_label'])
+  assert eli.shape == (2, 8)
+  assert label[:4].sum() == 4 and label[4:].sum() == 0
+  node = np.asarray(out.node)
+  # positive pairs decode back to the seed edges
+  for j in range(4):
+    u, v = int(node[eli[0, j]]), int(node[eli[1, j]])
+    assert (u, v) in adj
+
+
+def test_sample_from_edges_triplet():
+  graph, _, ei = make_graph()
+  sampler = glt.sampler.NeighborSampler(graph, [2], seed=4)
+  inputs = EdgeSamplerInput(
+      row=ei[0][:3].copy(), col=ei[1][:3].copy(),
+      neg_sampling=NegativeSampling('triplet', 2))
+  out = sampler.sample_from_edges(inputs)
+  assert np.asarray(out.metadata['src_index']).shape == (3,)
+  assert np.asarray(out.metadata['dst_pos_index']).shape == (3,)
+  assert np.asarray(out.metadata['dst_neg_index']).shape == (6,)
+  node = np.asarray(out.node)
+  src = node[np.asarray(out.metadata['src_index'])]
+  np.testing.assert_array_equal(src, ei[0][:3])
+
+
+def test_subgraph():
+  graph, _, ei = make_graph()
+  adj = adjacency_set(ei)
+  sampler = glt.sampler.NeighborSampler(graph, [2], seed=5)
+  out = sampler.subgraph(NodeSamplerInput(np.array([0, 1]))).trim()
+  node = out.node
+  # every edge among collected nodes, relabeled correctly
+  for r, c in zip(out.row, out.col):
+    assert (int(node[r]), int(node[c])) in adj
+  # mapping points each seed at its slot in node
+  mapping = np.asarray(out.metadata['mapping'])
+  assert node[mapping[0]] == 0 and node[mapping[1]] == 1
+
+
+def test_sample_prob():
+  graph, _, _ = make_graph()
+  sampler = glt.sampler.NeighborSampler(graph, [2, 2], seed=6)
+  prob = np.asarray(sampler.sample_prob(np.array([0]), 8))
+  assert prob[0] == 1.0
+  assert (prob >= 0).all() and (prob <= 1).all()
+  # direct neighbors of 0 have positive probability
+  assert prob[1] > 0 and prob[2] > 0
+
+
+def make_hetero():
+  # user(3) -- buys --> item(4); item -- rev_buys --> user
+  ub = np.array([[0, 0, 1, 2, 2], [0, 1, 2, 3, 0]])
+  bu = ub[::-1].copy()
+  graphs = {}
+  t1 = glt.data.Topology(ub, num_nodes=3)
+  t2 = glt.data.Topology(bu, num_nodes=4)
+  graphs[('user', 'buys', 'item')] = glt.data.Graph(t1, 'CPU')
+  graphs[('item', 'rev_buys', 'user')] = glt.data.Graph(t2, 'CPU')
+  return graphs, ub
+
+
+def test_hetero_sample_from_nodes():
+  graphs, ub = make_hetero()
+  adj = {(int(r), int(c)) for r, c in zip(ub[0], ub[1])}
+  sampler = glt.sampler.NeighborSampler(graphs, [2, 2], seed=7)
+  out = sampler.sample_from_nodes(
+      NodeSamplerInput(np.array([0, 1]), input_type='user')).trim()
+  assert 'user' in out.node and 'item' in out.node
+  assert set(np.asarray(out.node['user'][:2]).tolist()) == {0, 1}
+  # 'out' edge_dir: output keys are reversed etypes, row=neighbor col=seed
+  rev = ('item', 'rev_buys', 'user')
+  assert rev in out.row
+  for r, c in zip(out.row[rev], out.col[rev]):
+    item = int(out.node['item'][r])
+    user = int(out.node['user'][c])
+    assert (user, item) in adj

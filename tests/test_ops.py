@@ -169,10 +169,11 @@ def test_inducer_two_hops():
   topo = chain_star_topo()
   indptr, indices = dev(topo)
   seeds = jnp.array([0, 0, 1], dtype=jnp.int32)  # dup seed exercises dedup
-  state, uniq_seeds, seed_mask = ops.init_node(seeds, jnp.ones(3, bool),
-                                               capacity=32)
+  state, uniq_seeds, seed_mask, inv = ops.init_node(seeds, jnp.ones(3, bool),
+                                                    capacity=32)
   assert int(state.num_nodes) == 2
   assert uniq_seeds[:2].tolist() == [0, 1]
+  assert inv.tolist() == [0, 0, 1]  # local index of each original seed
 
   # hop 1 from frontier [0, 1] (local idx 0, 1)
   frontier = uniq_seeds
