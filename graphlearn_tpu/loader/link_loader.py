@@ -1,0 +1,57 @@
+"""Link (edge-seed) loader base.
+
+TPU-native port of /root/reference/graphlearn_torch/python/loader/link_loader.py:
+iterates seed edges, runs link sampling (negatives + node expansion), and
+collates edge_label_index / edge_label (binary) or src/dst_pos/dst_neg
+indices (triplet) into the batch metadata — same contract as the reference's
+deduced edge_label_index (link_loader.py:100-229).
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..data import Dataset
+from ..sampler import BaseSampler, EdgeSamplerInput, NegativeSampling
+from .node_loader import NodeLoader, SeedBatcher
+
+
+class LinkLoader(NodeLoader):
+  """Reference: loader/link_loader.py:35-229."""
+
+  def __init__(self, data: Dataset, link_sampler: BaseSampler,
+               edge_label_index, edge_label=None,
+               neg_sampling: Optional[NegativeSampling] = None,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, to_device=None,
+               seed: Optional[int] = None):
+    if isinstance(edge_label_index, tuple) and len(edge_label_index) == 2 \
+        and isinstance(edge_label_index[0], (tuple, list)) \
+        and len(edge_label_index[0]) == 3:
+      self.edge_type, edge_label_index = edge_label_index
+    else:
+      self.edge_type = None
+    eli = np.asarray(edge_label_index)
+    self.rows, self.cols = eli[0].reshape(-1), eli[1].reshape(-1)
+    self.edge_label = (np.asarray(edge_label).reshape(-1)
+                       if edge_label is not None else None)
+    self.neg_sampling = (NegativeSampling.cast(neg_sampling)
+                         if neg_sampling is not None else None)
+    self.data = data
+    self.sampler = link_sampler
+    self.batch_size = batch_size
+    self.collect_features = collect_features
+    self.to_device = to_device
+    self.input_type = self.edge_type
+    self._batcher = SeedBatcher(len(self.rows), batch_size, shuffle,
+                                drop_last, seed)
+    del with_edge
+
+  def __iter__(self):
+    for idx in self._batcher:
+      inputs = EdgeSamplerInput(
+          row=self.rows[idx], col=self.cols[idx],
+          label=self.edge_label[idx] if self.edge_label is not None else
+          None, input_type=self.edge_type, neg_sampling=self.neg_sampling)
+      out = self.sampler.sample_from_edges(inputs)
+      yield self._collate_fn(out)

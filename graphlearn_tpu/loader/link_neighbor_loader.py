@@ -1,0 +1,31 @@
+"""LinkNeighborLoader: fanout link loader.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/loader/link_neighbor_loader.py.
+"""
+from typing import Optional
+
+from ..data import Dataset
+from ..sampler import NegativeSampling, NeighborSampler
+from .link_loader import LinkLoader
+
+
+class LinkNeighborLoader(LinkLoader):
+  """Reference: loader/link_neighbor_loader.py."""
+
+  def __init__(self, data: Dataset, num_neighbors, edge_label_index,
+               edge_label=None,
+               neg_sampling: Optional[NegativeSampling] = None,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               with_weight: bool = False, strategy: str = 'random',
+               collect_features: bool = True, to_device=None,
+               seed: Optional[int] = None,
+               node_budget: Optional[int] = None):
+    sampler = NeighborSampler(
+        data.graph, num_neighbors, device=to_device, with_edge=with_edge,
+        with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
+        seed=seed, node_budget=node_budget)
+    super().__init__(data, sampler, edge_label_index, edge_label,
+                     neg_sampling, batch_size, shuffle, drop_last,
+                     with_edge, collect_features, to_device, seed)

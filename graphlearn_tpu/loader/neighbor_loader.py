@@ -1,0 +1,30 @@
+"""NeighborLoader: fanout-sampling node loader.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/loader/neighbor_loader.py: builds a
+NeighborSampler from the Dataset and drives NodeLoader with it.
+"""
+from typing import Optional
+
+from ..data import Dataset
+from ..sampler import NeighborSampler
+from .node_loader import NodeLoader
+
+
+class NeighborLoader(NodeLoader):
+  """Reference: loader/neighbor_loader.py:27-113."""
+
+  def __init__(self, data: Dataset, num_neighbors, input_nodes,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               with_weight: bool = False, strategy: str = 'random',
+               collect_features: bool = True, to_device=None,
+               seed: Optional[int] = None,
+               node_budget: Optional[int] = None):
+    sampler = NeighborSampler(
+        data.graph, num_neighbors, device=to_device, with_edge=with_edge,
+        with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
+        seed=seed, node_budget=node_budget)
+    super().__init__(data, sampler, input_nodes, batch_size, shuffle,
+                     drop_last, with_edge, collect_features, to_device,
+                     seed)

@@ -1,0 +1,115 @@
+"""Node-seed loader base.
+
+TPU-native port of /root/reference/graphlearn_torch/python/loader/node_loader.py.
+The reference wraps a torch DataLoader over seed ids and collates each index
+batch through the sampler + feature stores. Here seed batching is plain
+numpy (shuffle/drop_last), every batch is padded to the static
+``batch_size`` so downstream jitted steps compile once, and collation is:
+sample -> HBM/host feature gather -> label gather -> Data.
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..data import Dataset
+from ..sampler import BaseSampler, NodeSamplerInput
+from .transform import to_data, to_hetero_data
+
+
+class SeedBatcher:
+  """Shuffled, batched iteration over seed indices (the torch DataLoader
+  replacement; reference node_loader.py:76)."""
+
+  def __init__(self, num_seeds: int, batch_size: int, shuffle: bool,
+               drop_last: bool, seed: Optional[int] = None):
+    self.num_seeds = num_seeds
+    self.batch_size = batch_size
+    self.shuffle = shuffle
+    self.drop_last = drop_last
+    self._rng = np.random.default_rng(seed)
+
+  def __iter__(self):
+    order = (self._rng.permutation(self.num_seeds) if self.shuffle
+             else np.arange(self.num_seeds))
+    n_full = self.num_seeds // self.batch_size
+    for i in range(n_full):
+      yield order[i * self.batch_size:(i + 1) * self.batch_size]
+    rem = self.num_seeds - n_full * self.batch_size
+    if rem and not self.drop_last:
+      yield order[n_full * self.batch_size:]
+
+  def __len__(self):
+    n_full = self.num_seeds // self.batch_size
+    rem = self.num_seeds - n_full * self.batch_size
+    return n_full + (1 if rem and not self.drop_last else 0)
+
+
+class NodeLoader:
+  """Sample-and-collate loader over seed nodes
+  (reference: loader/node_loader.py:27-113)."""
+
+  def __init__(self, data: Dataset, node_sampler: BaseSampler,
+               input_nodes, batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, to_device=None,
+               seed: Optional[int] = None):
+    self.data = data
+    self.sampler = node_sampler
+    if isinstance(input_nodes, tuple):
+      self.input_type, self.input_seeds = input_nodes
+    else:
+      self.input_type, self.input_seeds = None, input_nodes
+    self.input_seeds = np.asarray(self.input_seeds).reshape(-1)
+    self.batch_size = batch_size
+    self.collect_features = collect_features
+    self.to_device = to_device
+    self._batcher = SeedBatcher(len(self.input_seeds), batch_size, shuffle,
+                                drop_last, seed)
+    del with_edge  # carried by the sampler
+
+  def __len__(self):
+    return len(self._batcher)
+
+  def __iter__(self):
+    for idx in self._batcher:
+      seeds = self.input_seeds[idx]
+      out = self.sampler.sample_from_nodes(
+          NodeSamplerInput(seeds, self.input_type),
+          batch_cap=self.batch_size)
+      yield self._collate_fn(out)
+
+  # -- collate (reference: node_loader.py:85-113) --------------------------
+
+  def _collate_fn(self, out):
+    import jax.numpy as jnp
+    if getattr(self.sampler, 'is_hetero', False):
+      x = y = None
+      if self.collect_features and self.data.node_features is not None:
+        x = {}
+        for t, buf in out.node.items():
+          store = self.data.get_node_feature(t)
+          if store is not None:
+            safe = jnp.maximum(jnp.asarray(buf), 0)
+            x[t] = store[safe]
+      if self.data.node_labels is not None:
+        y = {}
+        for t, buf in out.node.items():
+          labels = self.data.get_node_label(t)
+          if labels is not None:
+            safe = np.clip(np.asarray(buf), 0, len(labels) - 1)
+            y[t] = jnp.asarray(np.asarray(labels)[safe])
+      return to_hetero_data(out, x, y)
+
+    x = y = None
+    if self.collect_features and self.data.node_features is not None:
+      safe = jnp.maximum(jnp.asarray(out.node), 0)
+      x = self.data.node_features[safe]
+    if self.data.node_labels is not None:
+      labels = np.asarray(self.data.node_labels)
+      safe = np.clip(np.asarray(out.node), 0, len(labels) - 1)
+      y = jnp.asarray(labels[safe])
+    ef = None
+    if out.edge is not None and self.data.edge_features is not None:
+      safe = jnp.maximum(jnp.asarray(out.edge), 0)
+      ef = self.data.edge_features[safe]
+    return to_data(out, x, y, ef)

@@ -1,0 +1,38 @@
+"""SubGraphLoader: k-hop induced-subgraph batches.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/loader/subgraph_loader.py: each
+batch is the full induced subgraph over the k-hop expansion of the seeds,
+with ``mapping`` metadata locating each seed in the node list.
+"""
+from typing import Optional
+
+from ..data import Dataset
+from ..sampler import NeighborSampler, NodeSamplerInput
+from .node_loader import NodeLoader, SeedBatcher
+
+
+class SubGraphLoader(NodeLoader):
+  """Reference: loader/subgraph_loader.py:27-98."""
+
+  def __init__(self, data: Dataset, num_neighbors, input_nodes,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, to_device=None,
+               seed: Optional[int] = None,
+               max_degree: Optional[int] = None):
+    sampler = NeighborSampler(
+        data.graph, num_neighbors, device=to_device, with_edge=with_edge,
+        edge_dir=data.edge_dir, seed=seed)
+    super().__init__(data, sampler, input_nodes, batch_size, shuffle,
+                     drop_last, with_edge, collect_features, to_device,
+                     seed)
+    self.max_degree = max_degree
+
+  def __iter__(self):
+    for idx in self._batcher:
+      seeds = self.input_seeds[idx]
+      out = self.sampler.subgraph(
+          NodeSamplerInput(seeds, self.input_type),
+          max_degree=self.max_degree)
+      yield self._collate_fn(out)

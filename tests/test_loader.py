@@ -1,0 +1,88 @@
+"""Loader tests, mirroring the reference's loader coverage
+(test_link_loader.py, neighbor loader paths in test_neighbor_sampler.py)."""
+import numpy as np
+
+import graphlearn_tpu as glt
+
+
+def make_dataset(n=16, f=4):
+  rng = np.random.default_rng(0)
+  rows = np.repeat(np.arange(n), 3)
+  cols = (rows + rng.integers(1, n, rows.shape[0])) % n
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), graph_mode='CPU', num_nodes=n)
+  feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, f),
+                                                           np.float32)
+  ds.init_node_features(feat, sort_func=glt.data.sort_by_in_degree,
+                        split_ratio=0.5)
+  ds.init_node_labels(np.arange(n) % 3)
+  return ds, feat
+
+
+def test_neighbor_loader_batches():
+  ds, feat = make_dataset()
+  loader = glt.loader.NeighborLoader(ds, [2, 2], np.arange(16),
+                                     batch_size=4, shuffle=True, seed=0)
+  assert len(loader) == 4
+  seen = []
+  for batch in loader:
+    assert batch.batch_size == 4
+    node = np.asarray(batch.node)
+    n = int(batch.num_nodes)
+    # features/labels aligned to node list
+    x = np.asarray(batch.x)
+    y = np.asarray(batch.y)
+    np.testing.assert_allclose(x[:n], feat[node[:n]])
+    np.testing.assert_array_equal(y[:n], node[:n] % 3)
+    seen.extend(node[:4].tolist())
+  assert sorted(seen) == list(range(16))
+
+
+def test_neighbor_loader_static_shapes():
+  ds, _ = make_dataset()
+  loader = glt.loader.NeighborLoader(ds, [2], np.arange(10), batch_size=4)
+  shapes = {tuple(np.asarray(b.node).shape) for b in loader}
+  # padded: every batch (incl. the short last one) has identical shape
+  assert len(shapes) == 1
+
+
+def test_link_neighbor_loader_binary():
+  ds, _ = make_dataset()
+  g = ds.get_graph()
+  row, col = g.topo.to_coo()
+  loader = glt.loader.LinkNeighborLoader(
+      ds, [2], np.stack([row[:8], col[:8]]),
+      neg_sampling=glt.sampler.NegativeSampling('binary', 1),
+      batch_size=4, seed=1)
+  batches = list(loader)
+  assert len(batches) == 2
+  b = batches[0]
+  eli = np.asarray(b.metadata['edge_label_index'])
+  label = np.asarray(b.metadata['edge_label'])
+  assert eli.shape[1] == label.shape[0] == 8  # 4 pos + 4 neg
+  assert label[:4].sum() == 4 and label[4:].sum() == 0
+
+
+def test_subgraph_loader():
+  ds, _ = make_dataset()
+  loader = glt.loader.SubGraphLoader(ds, [2], np.arange(8), batch_size=4)
+  for b in loader:
+    mapping = np.asarray(b.metadata['mapping'])
+    node = np.asarray(b.node)
+    assert (mapping >= 0).all()
+    # seeds are locatable in the node list
+    np.testing.assert_array_equal(node[mapping], np.asarray(b.batch))
+
+
+def test_to_pyg_bridge():
+  try:
+    import torch_geometric  # noqa: F401
+  except ImportError:
+    import pytest
+    pytest.skip('torch_geometric not installed')
+  ds, _ = make_dataset()
+  loader = glt.loader.NeighborLoader(ds, [2], np.arange(8), batch_size=4)
+  b = next(iter(loader))
+  pyg = b.to_pyg()
+  assert pyg.edge_index.shape[0] == 2
+  assert pyg.batch_size == 4
