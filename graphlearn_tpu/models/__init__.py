@@ -1,0 +1,5 @@
+from .conv import (GATConv, GCNConv, SAGEConv, segment_max_agg,
+                   segment_mean_agg, segment_sum_agg)
+from .models import GAT, GCN, GraphSAGE, HeteroConv, RGNN
+from .train import (TrainState, batch_to_dict, create_train_state,
+                    make_train_step)

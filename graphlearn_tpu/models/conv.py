@@ -1,0 +1,129 @@
+"""Masked message-passing convolutions over padded COO batches.
+
+The reference framework stops at producing PyG batches and leaves models to
+torch_geometric (SURVEY.md §1; /root/reference/README.md:102-111's SAGEConv
+examples). A TPU framework needs native models: these flax convs consume the
+fixed-shape `Data` batches (edge_index [2, E] with -1 padding, row=message
+source, col=target) and aggregate via `jax.ops.segment_*` — XLA lowers the
+segment ops to efficient scatter-adds, and the masked-padding design means
+one compile for the whole epoch. Feature matmuls are [N, F] x [F, H] dense —
+MXU-shaped; keep hidden dims multiples of 128 for best tiling.
+"""
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _masked_targets(col, edge_mask, num_nodes: int):
+  """Padded/invalid edges scatter into segment `num_nodes` (dropped)."""
+  return jnp.where(edge_mask & (col >= 0), col, num_nodes)
+
+
+def segment_mean_agg(msgs, col, edge_mask, num_nodes: int):
+  """Mean-aggregate edge messages at their target nodes."""
+  tgt = _masked_targets(col, edge_mask, num_nodes)
+  summed = jax.ops.segment_sum(msgs, tgt, num_segments=num_nodes + 1)
+  count = jax.ops.segment_sum(jnp.ones_like(tgt, msgs.dtype), tgt,
+                              num_segments=num_nodes + 1)
+  return summed[:num_nodes] / jnp.maximum(count[:num_nodes, None], 1.0)
+
+
+def segment_sum_agg(msgs, col, edge_mask, num_nodes: int):
+  tgt = _masked_targets(col, edge_mask, num_nodes)
+  return jax.ops.segment_sum(msgs, tgt, num_segments=num_nodes + 1
+                             )[:num_nodes]
+
+
+def segment_max_agg(msgs, col, edge_mask, num_nodes: int):
+  tgt = _masked_targets(col, edge_mask, num_nodes)
+  out = jax.ops.segment_max(msgs, tgt, num_segments=num_nodes + 1)
+  out = jnp.where(jnp.isfinite(out), out, 0.0)
+  return out[:num_nodes]
+
+
+_AGGS = {'mean': segment_mean_agg, 'sum': segment_sum_agg,
+         'max': segment_max_agg}
+
+
+class SAGEConv(nn.Module):
+  """GraphSAGE conv: W_self x_v + W_nbr agg_{u->v} x_u."""
+  out_dim: int
+  aggr: str = 'mean'
+  use_bias: bool = True
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask):
+    n = x.shape[0]
+    row, col = edge_index[0], edge_index[1]
+    src = jnp.where((row >= 0)[:, None], x[jnp.maximum(row, 0)], 0.0)
+    agg = _AGGS[self.aggr](src, col, edge_mask, n)
+    h = nn.Dense(self.out_dim, use_bias=self.use_bias, name='lin_self')(x)
+    h = h + nn.Dense(self.out_dim, use_bias=False, name='lin_nbr')(agg)
+    return h
+
+
+class GCNConv(nn.Module):
+  """GCN conv with symmetric degree normalization + implicit self loops."""
+  out_dim: int
+  use_bias: bool = True
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask):
+    n = x.shape[0]
+    row, col = edge_index[0], edge_index[1]
+    tgt = _masked_targets(col, edge_mask, n)
+    srcseg = _masked_targets(row, edge_mask, n)
+    ones = jnp.ones_like(tgt, x.dtype)
+    # degrees including the self loop
+    deg_in = jax.ops.segment_sum(ones, tgt, num_segments=n + 1)[:n] + 1.0
+    deg_out = jax.ops.segment_sum(ones, srcseg, num_segments=n + 1)[:n] + 1.0
+    h = nn.Dense(self.out_dim, use_bias=self.use_bias)(x)
+    inv_src = (1.0 / jnp.sqrt(deg_out))[jnp.maximum(row, 0)]
+    inv_dst_e = (1.0 / jnp.sqrt(deg_in))[jnp.maximum(col, 0)]
+    msgs = h[jnp.maximum(row, 0)] * (inv_src * inv_dst_e)[:, None]
+    agg = jax.ops.segment_sum(
+        jnp.where(edge_mask[:, None], msgs, 0.0), tgt,
+        num_segments=n + 1)[:n]
+    return agg + h / deg_in[:, None]  # self loop term (1/sqrt(d)^2)
+
+
+class GATConv(nn.Module):
+  """Graph attention conv (multi-head, masked segment softmax)."""
+  out_dim: int
+  heads: int = 1
+  negative_slope: float = 0.2
+  concat: bool = True
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask):
+    n = x.shape[0]
+    h_dim = self.out_dim
+    row, col = edge_index[0], edge_index[1]
+    safe_row, safe_col = jnp.maximum(row, 0), jnp.maximum(col, 0)
+    w = nn.Dense(self.heads * h_dim, use_bias=False, name='lin')(x)
+    w = w.reshape(n, self.heads, h_dim)
+    a_src = self.param('att_src', nn.initializers.glorot_uniform(),
+                       (self.heads, h_dim))
+    a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
+                       (self.heads, h_dim))
+    alpha_src = (w * a_src[None]).sum(-1)  # [N, H]
+    alpha_dst = (w * a_dst[None]).sum(-1)
+    e = alpha_src[safe_row] + alpha_dst[safe_col]  # [E, H]
+    e = nn.leaky_relu(e, self.negative_slope)
+    tgt = _masked_targets(col, edge_mask, n)
+    # segment softmax: subtract per-target max for stability
+    seg_max = jax.ops.segment_max(e, tgt, num_segments=n + 1)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    e = jnp.exp(e - seg_max[tgt])
+    e = jnp.where(edge_mask[:, None], e, 0.0)
+    denom = jax.ops.segment_sum(e, tgt, num_segments=n + 1)
+    attn = e / jnp.maximum(denom[tgt], 1e-9)
+    msgs = w[safe_row] * attn[:, :, None]           # [E, H, D]
+    out = jax.ops.segment_sum(
+        jnp.where(edge_mask[:, None, None], msgs, 0.0), tgt,
+        num_segments=n + 1)[:n]
+    if self.concat:
+      return out.reshape(n, self.heads * h_dim)
+    return out.mean(axis=1)

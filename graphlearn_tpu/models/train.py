@@ -1,0 +1,71 @@
+"""Training utilities: jitted supervised train/eval steps.
+
+The reference leaves the training loop to user code
+(/root/reference/examples/train_sage_ogbn_products.py:120-150: DDP +
+cross-entropy on the seed slots). Here the step is a single jitted function
+over the padded batch: loss is masked cross-entropy on the seed-node slots
+(local indices [0, num_seed_nodes)), so the same compiled step serves every
+batch of an epoch.
+"""
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(NamedTuple):
+  params: Any
+  opt_state: Any
+  step: jnp.ndarray
+
+
+def create_train_state(model, rng, sample_batch, lr: float = 3e-3,
+                       optimizer=None):
+  params = model.init(rng, sample_batch['x'], sample_batch['edge_index'],
+                      sample_batch['edge_mask'])
+  tx = optimizer or optax.adam(lr)
+  return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
+
+
+def make_train_step(model, tx, num_classes: int):
+  """Build the jitted supervised step. The batch dict carries padded
+  x/edge_index/edge_mask/y plus num_seed_nodes (seed slots lead the node
+  list by inducer construction)."""
+
+  def loss_fn(params, batch):
+    logits = model.apply(params, batch['x'], batch['edge_index'],
+                         batch['edge_mask'])
+    n = logits.shape[0]
+    seed_mask = jnp.arange(n) < batch['num_seed_nodes']
+    labels = jax.nn.one_hot(batch['y'], num_classes)
+    ce = optax.softmax_cross_entropy(logits, labels)
+    ce = jnp.where(seed_mask, ce, 0.0)
+    loss = ce.sum() / jnp.maximum(seed_mask.sum(), 1)
+    correct = (logits.argmax(-1) == batch['y']) & seed_mask
+    acc = correct.sum() / jnp.maximum(seed_mask.sum(), 1)
+    return loss, acc
+
+  @jax.jit
+  def train_step(state: TrainState, batch):
+    (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params, batch)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss, acc
+
+  @jax.jit
+  def eval_step(state: TrainState, batch):
+    return loss_fn(state.params, batch)[1]
+
+  return train_step, eval_step
+
+
+def batch_to_dict(batch):
+  """`loader.Data` -> the flat dict the jitted step consumes."""
+  num_seed = (batch.num_sampled_nodes[0]
+              if batch.num_sampled_nodes is not None else batch.batch_size)
+  return dict(x=batch.x, edge_index=batch.edge_index,
+              edge_mask=batch.edge_mask, y=batch.y,
+              num_seed_nodes=num_seed)
