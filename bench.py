@@ -1,0 +1,83 @@
+"""Benchmark: neighbor-sampling throughput (the reference's headline metric).
+
+Mirrors /root/reference/benchmarks/api/bench_sampler.py: ogbn-products-like
+config — 3-hop fanout [15, 10, 5], batch 1024 — reporting sampled edges/sec
+in millions. The graph is synthetic at products scale density (avg degree
+~25) because datasets aren't downloadable here; the metric definition matches
+the reference's (total sampled edges / wall time, bench_sampler.py:48-54).
+
+`vs_baseline`: the reference publishes figure-only numbers
+(docs/figures/scale_up.png; SURVEY.md §6). The comparison constant below is
+the GLT-CUDA A100 scale read off that figure (~40M sampled edges/s for this
+config). Prints ONE JSON line.
+"""
+import json
+import time
+
+import numpy as np
+
+GLT_A100_EDGES_PER_SEC_M = 40.0  # figure-scale estimate, see module docstring
+
+NUM_NODES = 1_000_000
+AVG_DEG = 25
+FANOUT = [15, 10, 5]
+BATCH = 1024
+WARMUP = 3
+ITERS = 20
+
+
+def build_graph():
+  import graphlearn_tpu as glt
+  rng = np.random.default_rng(0)
+  # power-law-ish: half the edges uniform, half into a hot head
+  e = NUM_NODES * AVG_DEG
+  rows = rng.integers(0, NUM_NODES, e)
+  cols = np.empty(e, np.int64)
+  half = e // 2
+  cols[:half] = rng.integers(0, NUM_NODES, half)
+  cols[half:] = rng.zipf(1.5, e - half) % NUM_NODES
+  topo = glt.data.Topology(np.stack([rows, cols]), num_nodes=NUM_NODES)
+  return glt.data.Graph(topo, 'HBM')
+
+
+def main():
+  import jax
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.sampler import NodeSamplerInput
+
+  graph = build_graph()
+  sampler = glt.sampler.NeighborSampler(graph, FANOUT, seed=0)
+  rng = np.random.default_rng(1)
+
+  def one_batch(i):
+    seeds = rng.integers(0, NUM_NODES, BATCH)
+    return sampler.sample_from_nodes(NodeSamplerInput(seeds),
+                                     batch_cap=BATCH)
+
+  for i in range(WARMUP):
+    out = one_batch(i)
+    jax.block_until_ready(out.row)
+
+  total_edges = 0
+  t0 = time.perf_counter()
+  outs = []
+  for i in range(ITERS):
+    outs.append(one_batch(i))
+  # count on device, sync once at the end (matches the reference's
+  # synchronize-then-time discipline, bench_sampler.py:48-53)
+  counts = [o.edge_mask.sum() for o in outs]
+  jax.block_until_ready(counts)
+  dt = time.perf_counter() - t0
+  total_edges = int(sum(int(c) for c in counts))
+
+  edges_per_sec_m = total_edges / dt / 1e6
+  print(json.dumps({
+      'metric': 'sampled_edges_per_sec',
+      'value': round(edges_per_sec_m, 3),
+      'unit': 'M edges/s',
+      'vs_baseline': round(edges_per_sec_m / GLT_A100_EDGES_PER_SEC_M, 3),
+  }))
+
+
+if __name__ == '__main__':
+  main()
