@@ -1,0 +1,37 @@
+"""Random (round-robin over shuffled chunks) node partitioning.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/partition/random_partitioner.py:
+node ids are split into chunks, chunks shuffled, and dealt round-robin so
+each partition gets a near-equal share.
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..typing import NodeType
+from .base import PartitionerBase
+
+
+class RandomPartitioner(PartitionerBase):
+  """Reference: random_partitioner.py:28-86."""
+
+  def __init__(self, output_dir, num_parts, num_nodes, edge_index,
+               node_feat=None, edge_feat=None, edge_weights=None,
+               edge_assign_strategy='by_src', chunk_size=10000,
+               seed: Optional[int] = None):
+    super().__init__(output_dir, num_parts, num_nodes, edge_index,
+                     node_feat, edge_feat, edge_weights,
+                     edge_assign_strategy, chunk_size)
+    self._rng = np.random.default_rng(seed)
+
+  def _partition_node(self, ntype: Optional[NodeType]) -> np.ndarray:
+    n = (self.num_nodes[ntype] if isinstance(self.num_nodes, dict)
+         else self.num_nodes)
+    perm = self._rng.permutation(n)
+    pb = np.empty(n, dtype=np.int32)
+    # shuffled ids dealt round-robin in equal contiguous shares
+    share = (n + self.num_parts - 1) // self.num_parts
+    for p in range(self.num_parts):
+      pb[perm[p * share:(p + 1) * share]] = p
+    return pb

@@ -1,0 +1,98 @@
+"""Partition tests, mirroring the reference's test/python/test_partition.py
+(random homo/hetero, frequency with cache, cat_feature_cache, load)."""
+import numpy as np
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.partition import (FrequencyPartitioner,
+                                      RandomPartitioner, cat_feature_cache,
+                                      load_partition)
+
+
+def ring_edges(n):
+  rows = np.arange(n)
+  return np.stack([rows, (rows + 1) % n])
+
+
+def test_random_partition_homo(tmp_path):
+  n = 40
+  ei = ring_edges(n)
+  feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  efeat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 2),
+                                                            np.float32)
+  p = RandomPartitioner(str(tmp_path), 2, n, ei, node_feat=feat,
+                        edge_feat=efeat, seed=0)
+  p.partition()
+
+  num_parts, graph, nfeat, ef, node_pb, edge_pb = load_partition(
+      str(tmp_path), 0)
+  assert num_parts == 2
+  # balance
+  assert abs((node_pb == 0).sum() - (node_pb == 1).sum()) <= 1
+  # every part-0 edge's src is owned by part 0 (by_src strategy)
+  assert (node_pb[graph.edge_index[0]] == 0).all()
+  # all edges accounted for across parts
+  _, g1, _, _, _, _ = load_partition(str(tmp_path), 1)
+  assert graph.eids.shape[0] + g1.eids.shape[0] == n
+  # features round-trip by global id
+  np.testing.assert_allclose(nfeat.feats, feat[nfeat.ids])
+  np.testing.assert_allclose(ef.feats, efeat[ef.ids])
+  # edge_pb consistent with edge ownership
+  assert (edge_pb[graph.eids] == 0).all()
+
+
+def test_random_partition_hetero(tmp_path):
+  ei = {('user', 'buys', 'item'): np.array([[0, 1, 2, 3], [0, 1, 0, 1]]),
+        ('item', 'rev_buys', 'user'): np.array([[0, 1, 0], [0, 1, 2]])}
+  nfeat = {'user': np.eye(4, dtype=np.float32),
+           'item': np.eye(2, dtype=np.float32)}
+  p = RandomPartitioner(str(tmp_path), 2,
+                        {'user': 4, 'item': 2}, ei, node_feat=nfeat,
+                        seed=0)
+  p.partition()
+  num_parts, graph, nf, ef, node_pb, edge_pb = load_partition(
+      str(tmp_path), 0)
+  assert num_parts == 2
+  assert set(node_pb.keys()) == {'user', 'item'}
+  et = ('user', 'buys', 'item')
+  if et in graph and graph[et].eids.size:
+    assert (node_pb['user'][graph[et].edge_index[0]] == 0).all()
+  np.testing.assert_allclose(nf['user'].feats,
+                             nfeat['user'][nf['user'].ids])
+
+
+def test_frequency_partition_with_cache(tmp_path):
+  n = 40
+  ei = ring_edges(n)
+  feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  # partition 0 is hot on low ids, partition 1 on high ids
+  p0 = np.zeros(n); p0[:20] = 1.0
+  p1 = np.zeros(n); p1[20:] = 1.0
+  p = FrequencyPartitioner(str(tmp_path), 2, n, ei, probs=[p0, p1],
+                           node_feat=feat, chunk_size=5, cache_ratio=0.2)
+  p.partition()
+  _, graph, nfeat, _, node_pb, _ = load_partition(str(tmp_path), 0)
+  # hot-for-0 nodes mostly land on partition 0
+  assert (node_pb[:20] == 0).mean() > 0.9
+  # cache present and hot for partition 0 (remote-owned hot nodes)
+  if nfeat.cache_ids is not None:
+    assert (node_pb[nfeat.cache_ids] != 0).all()
+    np.testing.assert_allclose(nfeat.cache_feats, feat[nfeat.cache_ids])
+
+
+def test_cat_feature_cache():
+  feats = np.arange(6, dtype=np.float32)[:, None]
+  data = glt.typing.FeaturePartitionData(
+      feats=feats, ids=np.array([10, 11, 12, 13, 14, 15]),
+      cache_feats=np.array([[100.0], [101.0]]),
+      cache_ids=np.array([3, 7]))
+  pb = np.full(20, 1, dtype=np.int32)
+  pb[[10, 11, 12, 13, 14, 15]] = 0
+  f, ids, new_pb = cat_feature_cache(0, data, pb)
+  # cache prepended (hot-first for the HBM prefix)
+  np.testing.assert_array_equal(ids[:2], [3, 7])
+  np.testing.assert_allclose(f[:2, 0], [100.0, 101.0])
+  assert (new_pb[[3, 7]] == 0).all()
+  # untouched entries keep their owner
+  assert new_pb[4] == 1
