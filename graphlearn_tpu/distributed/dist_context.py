@@ -1,0 +1,96 @@
+"""Distributed context: mesh + role bookkeeping.
+
+TPU-native re-design of
+/root/reference/graphlearn_torch/python/distributed/dist_context.py. The
+reference tracks (role, world_size, rank, group_name) per *process* in an
+RPC mesh. On TPU a single host process drives all local chips and the
+scale-out unit is the `jax.sharding.Mesh`; the context therefore carries the
+mesh (graph-partition axis 'g') plus the same role/rank fields for
+multi-host and server/client topologies (jax.process_index serves as the
+node rank).
+"""
+import enum
+from typing import Optional
+
+import numpy as np
+
+
+class DistRole(enum.Enum):
+  """Reference: dist_context.py:23-25."""
+  WORKER = 1
+  SERVER = 2
+  CLIENT = 3
+
+
+class DistContext:
+  """Reference: dist_context.py:100-134 (worker_name, rank arithmetic)."""
+
+  def __init__(self, world_size: int, rank: int,
+               role: DistRole = DistRole.WORKER,
+               group_name: str = 'worker', num_partitions: int = 1,
+               mesh=None):
+    self.role = role
+    self.world_size = world_size
+    self.rank = rank
+    self.group_name = group_name
+    self.num_partitions = num_partitions
+    self.mesh = mesh
+
+  @property
+  def worker_name(self) -> str:
+    return f'{self.group_name}-{self.rank}'
+
+  def is_worker(self) -> bool:
+    return self.role == DistRole.WORKER
+
+  def is_server(self) -> bool:
+    return self.role == DistRole.SERVER
+
+  def is_client(self) -> bool:
+    return self.role == DistRole.CLIENT
+
+
+_dist_context: Optional[DistContext] = None
+
+
+def get_context() -> Optional[DistContext]:
+  return _dist_context
+
+
+def init_worker_group(world_size: int = 1, rank: int = 0,
+                      group_name: str = 'worker',
+                      num_partitions: Optional[int] = None,
+                      devices=None):
+  """Create the worker context + graph mesh
+  (reference: dist_context.py:169-183).
+
+  ``num_partitions`` defaults to the device count: one graph partition per
+  chip, the TPU analog of one partition per worker process.
+  """
+  global _dist_context
+  import jax
+  from jax.sharding import Mesh
+  devs = list(devices) if devices is not None else jax.devices()
+  nparts = num_partitions or len(devs)
+  mesh = Mesh(np.array(devs[:nparts]), ('g',))
+  _dist_context = DistContext(world_size, rank, DistRole.WORKER,
+                              group_name, nparts, mesh)
+  return _dist_context
+
+
+def _set_server_context(num_servers, num_clients, server_rank,
+                        group_name='server', num_partitions=1, mesh=None):
+  """Reference: dist_context.py:135-151."""
+  global _dist_context
+  _dist_context = DistContext(num_servers, server_rank, DistRole.SERVER,
+                              group_name, num_partitions, mesh)
+  return _dist_context
+
+
+def _set_client_context(num_servers, num_clients, client_rank,
+                        group_name='client'):
+  """Reference: dist_context.py:152-167."""
+  global _dist_context
+  _dist_context = DistContext(num_clients, client_rank, DistRole.CLIENT,
+                              group_name)
+  return _dist_context

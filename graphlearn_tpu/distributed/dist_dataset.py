@@ -1,0 +1,80 @@
+"""Distributed dataset: partitioned graph + features + books on the mesh.
+
+TPU-native re-design of
+/root/reference/graphlearn_torch/python/distributed/dist_dataset.py. The
+reference process loads ITS partition from the partition dir and keeps
+partition books for the rest. On TPU one host process drives all local
+chips, so `load()` loads every partition this host serves and stacks them
+into the mesh-sharded DistGraph / DistFeature containers; the hot-cache is
+merged via cat_feature_cache exactly like the reference (dist_dataset.py:
+78-167), moving cached entries' feature-PB ownership.
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..partition import cat_feature_cache, load_partition
+from .dist_feature import DistFeature
+from .dist_graph import DistGraph
+
+
+class DistDataset:
+  """Reference: dist_dataset.py:30-226 (homogeneous path)."""
+
+  def __init__(self, num_partitions: int = 1, partition_idx: int = 0,
+               dist_graph: Optional[DistGraph] = None,
+               dist_feature: Optional[DistFeature] = None,
+               node_labels=None, node_feat_pb=None, edge_dir: str = 'out'):
+    self.num_partitions = num_partitions
+    self.partition_idx = partition_idx
+    self.graph = dist_graph
+    self.node_features = dist_feature
+    self.node_labels = node_labels
+    self.node_feat_pb = node_feat_pb
+    self.edge_dir = edge_dir
+
+  def load(self, root_dir: str, mesh=None, node_labels=None,
+           edge_dir: str = 'out', feature_dtype=None,
+           feature_with_cache: bool = True):
+    """Load all partitions of `root_dir` and shard them over `mesh`
+    (reference: DistDataset.load, dist_dataset.py:78-167)."""
+    num_parts, g0, nf0, ef0, node_pb, edge_pb = load_partition(root_dir, 0)
+    if mesh is None:
+      from .dist_context import get_context
+      ctx = get_context()
+      mesh = ctx.mesh if ctx else None
+    parts = [g0]
+    nfeats = [nf0]
+    for p in range(1, num_parts):
+      _, g, nf, _, _, _ = load_partition(root_dir, p)
+      parts.append(g)
+      nfeats.append(nf)
+
+    self.num_partitions = num_parts
+    self.edge_dir = edge_dir
+    self.graph = DistGraph(num_parts, 0, parts, node_pb, edge_pb,
+                           edge_dir)
+
+    if nf0 is not None:
+      feat_pb = node_pb.astype(np.int32).copy()
+      blocks = []
+      for p, nf in enumerate(nfeats):
+        if feature_with_cache and nf.cache_feats is not None:
+          feats, ids, feat_pb = cat_feature_cache(p, nf, feat_pb)
+        else:
+          feats, ids = nf.feats, nf.ids
+        blocks.append((ids, feats))
+      self.node_feat_pb = feat_pb
+      self.node_features = DistFeature(num_parts, blocks, node_pb,
+                                       mesh=mesh, dtype=feature_dtype)
+      # note: lookups route by the *graph* node_pb (each id's canonical
+      # owner); the cache raises the chance the row is also local, but
+      # canonical routing keeps responses unique. The feature pb with cache
+      # entries is kept for host-side locality decisions.
+    if node_labels is not None:
+      self.node_labels = np.asarray(node_labels)
+    return self
+
+  @property
+  def node_pb(self):
+    return self.graph.node_pb if self.graph is not None else None

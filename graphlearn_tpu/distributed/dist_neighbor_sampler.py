@@ -1,0 +1,218 @@
+"""Distributed multi-hop neighbor sampling over a mesh-sharded graph.
+
+TPU-native re-design of
+/root/reference/graphlearn_torch/python/distributed/dist_neighbor_sampler.py.
+The reference's engine is an asyncio event loop per worker: per hop it splits
+the frontier by partition book, samples the local part on its GPU, RPCs the
+remote parts to their owners, and stitches results (dist_neighbor_sampler.py:
+585-648), hiding RPC latency with concurrent seed batches.
+
+Here the entire multi-hop sample is ONE jitted shard_map program over the
+mesh axis 'g' (one graph partition per chip). Per hop, per shard:
+
+  1. dest = node_pb[frontier]                       (replicated PB lookup)
+  2. pack frontier into [P, C] buckets              (ops.route_slots/scatter)
+  3. lax.all_to_all                                 (requests ride ICI)
+  4. local fanout sample over the shard's CSR       (ops.uniform_sample_local)
+  5. lax.all_to_all back                            (responses)
+  6. unpermute into frontier order                  (ops.gather_from_buckets)
+  7. dedup/relabel into the shard's batch           (ops.induce_next)
+
+No asyncio, no RPC, no stitch kernels: the collectives are compiled into the
+step and XLA overlaps them with compute. Every shard builds its own batch
+from its own seed block — the SPMD equivalent of the reference's
+one-batch-per-worker model.
+"""
+from typing import List, Optional
+
+import numpy as np
+
+from .. import ops
+from ..sampler import NodeSamplerInput, SamplerOutput
+from .dist_feature import DistFeature
+from .dist_graph import DistGraph
+
+
+class DistNeighborSampler:
+  """Reference: dist_neighbor_sampler.py:95-744 (homogeneous path).
+
+  Args:
+    dist_graph: DistGraph (stacked sharded partitions + node_pb).
+    num_neighbors: per-hop fanouts.
+    mesh: jax Mesh with axis 'g' of size num_partitions.
+    dist_feature: optional DistFeature for fused feature collection.
+    with_edge: emit global edge ids.
+    seed: PRNG seed.
+  """
+
+  def __init__(self, dist_graph: DistGraph, num_neighbors: List[int],
+               mesh, dist_feature: Optional[DistFeature] = None,
+               with_edge: bool = False, seed: Optional[int] = None,
+               node_budget: Optional[int] = None,
+               collect_features: bool = False):
+    import jax
+    self.graph = dist_graph
+    self.num_neighbors = list(num_neighbors)
+    self.mesh = mesh
+    self.dist_feature = dist_feature
+    self.with_edge = with_edge
+    self.collect_features = collect_features and dist_feature is not None
+    self.node_budget = node_budget
+    self._key = jax.random.PRNGKey(0 if seed is None else seed)
+    self._dev = dist_graph.device_arrays(mesh)
+    self._fns = {}
+
+  def _next_keys(self):
+    import jax
+    self._key, sub = jax.random.split(self._key)
+    return jax.random.split(sub, self.graph.num_partitions)
+
+  def _capacities(self, b: int):
+    caps = [b]
+    for k in self.num_neighbors:
+      nxt = caps[-1] * k
+      if self.node_budget is not None:
+        nxt = min(nxt, self.node_budget)
+      caps.append(nxt)
+    return caps
+
+  # ------------------------------------------------------------- build fn
+
+  def _build_fn(self, b: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    nparts = self.graph.num_partitions
+    fanouts = tuple(self.num_neighbors)
+    caps = self._capacities(b)
+    node_cap = sum(caps)
+    with_edge = self.with_edge
+
+    def exchange_hop(gdev, frontier, fmask, k, key):
+      """One hop: route -> local sample -> route back. All [.] per-shard."""
+      bf = frontier.shape[0]
+      pb = gdev['node_pb']
+      safe = jnp.maximum(frontier, 0)
+      dest = jnp.where(fmask, pb[safe], nparts)
+      slot, ok = ops.route_slots(dest, fmask, capacity=bf)
+      send = ops.scatter_to_buckets(frontier, dest, slot, ok, nparts, bf)
+      req = jax.lax.all_to_all(send, 'g', 0, 0)
+      flat = req.reshape(-1)
+      fm = flat >= 0
+      nbrs, epos, m = ops.uniform_sample_local(
+          gdev['row_ids'], gdev['indptr'], gdev['indices'], flat, fm, k,
+          key)
+      resp_n = jax.lax.all_to_all(nbrs.reshape(nparts, bf, k), 'g', 0, 0)
+      resp_m = jax.lax.all_to_all(m.reshape(nparts, bf, k), 'g', 0, 0)
+      back_n = ops.gather_from_buckets(resp_n, dest, slot, ok)
+      back_m = ops.gather_from_buckets(resp_m, dest, slot, ok,
+                                       fill=False) & ok[:, None]
+      back_e = None
+      if with_edge:
+        e = jnp.where(m, gdev['eids'][jnp.where(m, epos, 0)], -1)
+        resp_e = jax.lax.all_to_all(e.reshape(nparts, bf, k), 'g', 0, 0)
+        back_e = ops.gather_from_buckets(resp_e, dest, slot, ok)
+      return back_n, back_m, back_e
+
+    def body(row_ids, indptr, indices, eids, pb, seeds, smask, keys):
+      gdev = dict(row_ids=row_ids[0], indptr=indptr[0],
+                  indices=indices[0], eids=eids[0], node_pb=pb)
+      seeds, smask, key = seeds[0], smask[0], keys[0]
+      hop_keys = jax.random.split(key, len(fanouts))
+      state, uniq, umask, inv = ops.init_node(seeds, smask,
+                                              capacity=node_cap)
+      frontier, fidx, fmask = uniq, jnp.arange(b, dtype=jnp.int32), umask
+      rows, cols, edges, emasks = [], [], [], []
+      nodes_per_hop = [state.num_nodes]
+      edges_per_hop = []
+      for i, k in enumerate(fanouts):
+        nbrs, m, e = exchange_hop(gdev, frontier, fmask, k, hop_keys[i])
+        state, out = ops.induce_next(state, fidx, nbrs, m)
+        rows.append(out['cols'])   # message direction: neighbor -> seed
+        cols.append(out['rows'])
+        emasks.append(out['edge_mask'])
+        if with_edge:
+          edges.append(jnp.where(out['edge_mask'], e.reshape(-1), -1))
+        nodes_per_hop.append(out['num_new'])
+        edges_per_hop.append(out['edge_mask'].sum())
+        nxt = caps[i + 1]
+        frontier = out['frontier'][:nxt]
+        fidx = out['frontier_idx'][:nxt]
+        fmask = out['frontier_mask'][:nxt]
+      res = dict(
+          node=state.nodes[None], num_nodes=state.num_nodes[None],
+          row=jnp.concatenate(rows)[None],
+          col=jnp.concatenate(cols)[None],
+          edge_mask=jnp.concatenate(emasks)[None],
+          seed_inverse=inv[None],
+          num_sampled_nodes=jnp.stack(nodes_per_hop)[None],
+          num_sampled_edges=jnp.stack(edges_per_hop)[None])
+      if with_edge:
+        res['edge'] = jnp.concatenate(edges)[None]
+      return res
+
+    out_specs = dict(node=P('g'), num_nodes=P('g'), row=P('g'),
+                     col=P('g'), edge_mask=P('g'), seed_inverse=P('g'),
+                     num_sampled_nodes=P('g'), num_sampled_edges=P('g'))
+    if with_edge:
+      out_specs['edge'] = P('g')
+    fn = shard_map(
+        body, mesh=self.mesh,
+        in_specs=(P('g'), P('g'), P('g'), P('g'), P(), P('g'), P('g'),
+                  P('g')),
+        out_specs=out_specs)
+    jfn = jax.jit(fn)
+    d = self._dev
+
+    def run(seeds, smask, keys):
+      return jfn(d['row_ids'], d['indptr'], d['indices'], d['eids'],
+                 d['node_pb'], seeds, smask, keys)
+
+    return run
+
+  # ------------------------------------------------------------ public API
+
+  def sample_from_nodes(self, inputs, **kwargs) -> SamplerOutput:
+    """Sample per-shard batches: seeds [P, B] (or [P*B] flat, split evenly).
+
+    Returns a SamplerOutput whose arrays carry a leading partition axis
+    [P, ...] — shard p is the batch built from seed block p, ready to feed
+    a data-parallel train step on the same mesh.
+    """
+    import jax.numpy as jnp
+    seeds = np.asarray(inputs.node if isinstance(inputs, NodeSamplerInput)
+                       else inputs)
+    p = self.graph.num_partitions
+    if seeds.ndim == 1:
+      assert seeds.shape[0] % p == 0, 'flat seeds must split evenly'
+      seeds = seeds.reshape(p, -1)
+    b = seeds.shape[1]
+    smask = np.ones_like(seeds, bool)
+    if b not in self._fns:
+      self._fns[b] = self._build_fn(b)
+    res = self._fns[b](jnp.asarray(seeds, jnp.int32), jnp.asarray(smask),
+                       self._next_keys())
+    return SamplerOutput(
+        node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
+        col=res['col'], edge=res.get('edge'), edge_mask=res['edge_mask'],
+        batch=jnp.asarray(seeds), batch_size=b,
+        num_sampled_nodes=res['num_sampled_nodes'],
+        num_sampled_edges=res['num_sampled_edges'],
+        metadata={'seed_inverse': res['seed_inverse']})
+
+  def collate(self, out: SamplerOutput, node_labels=None):
+    """Attach features (sharded all_to_all gather) and labels.
+
+    Reference: _colloate_fn (dist_neighbor_sampler.py:650-744).
+    """
+    import jax.numpy as jnp
+    x = None
+    if self.collect_features:
+      x = self.dist_feature.get(out.node)
+    y = None
+    if node_labels is not None:
+      labels = jnp.asarray(node_labels)
+      y = labels[jnp.maximum(out.node, 0)]
+    return x, y

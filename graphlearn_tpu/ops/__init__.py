@@ -1,7 +1,8 @@
 from .induce import InducerState, induce_next, init_empty, init_node
 from .negative import random_negative_sample, sort_csr_segments
 from .neighbor import (build_row_cumsum, edge_in_csr, uniform_sample,
-                       weighted_sample)
+                       uniform_sample_local, weighted_sample)
+from .route import gather_from_buckets, route_slots, scatter_to_buckets
 from .stitch import stitch_rows
 from .subgraph import node_subgraph
 from .unique import FILL, masked_unique, searchsorted_membership

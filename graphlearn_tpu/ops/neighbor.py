@@ -115,6 +115,38 @@ def weighted_sample(indptr, indices, row_cumsum, seeds, seed_mask, k: int,
   return nbrs, jnp.where(mask, epos, 0), mask
 
 
+@functools.partial(jax.jit, static_argnames=('k',))
+def uniform_sample_local(row_ids, indptr_loc, indices, seeds, seed_mask,
+                         k: int, key):
+  """Uniform fanout sampling over a *partition-local* CSR.
+
+  The distributed graph stores only owned rows per shard: ``row_ids`` is the
+  ascending (INT_MAX-padded) list of owned global ids and ``indptr_loc``
+  their local CSR offsets. Row lookup is a binary search instead of direct
+  indexing — the TPU replacement for the reference's partition-local Graph
+  rows (csrc/cpu/graph.cc + dist_neighbor_sampler.py:624). Seeds not owned
+  by this shard come back masked out.
+
+  Same output contract as :func:`uniform_sample`.
+  """
+  b = seeds.shape[0]
+  pos = jnp.searchsorted(row_ids, seeds)
+  pos = jnp.clip(pos, 0, row_ids.shape[0] - 1)
+  found = (row_ids[pos] == seeds) & seed_mask
+  start = indptr_loc[pos]
+  deg = jnp.where(found, indptr_loc[pos + 1] - start, 0)
+  u = jax.random.uniform(key, (b, k))
+  rand_off = jnp.floor(u * deg[:, None].astype(u.dtype)).astype(jnp.int32)
+  rand_off = jnp.minimum(rand_off, jnp.maximum(deg[:, None] - 1, 0))
+  seq_off = jnp.arange(k, dtype=jnp.int32)[None, :]
+  offsets = jnp.where(deg[:, None] > k, rand_off, seq_off)
+  mask = found[:, None] & (offsets < deg[:, None])
+  epos = start[:, None] + offsets
+  safe_epos = jnp.where(mask, epos, 0)
+  nbrs = jnp.where(mask, indices[safe_epos], FILL)
+  return nbrs, jnp.where(mask, epos, 0), mask
+
+
 def edge_in_csr(indptr, indices, rows, cols):
   """Vectorized membership test: is (rows[i], cols[i]) an edge?
 

@@ -1,0 +1,63 @@
+"""Fixed-capacity routing primitives for cross-shard exchange.
+
+The reference routes data-dependent id sets between workers over RPC
+(/root/reference/graphlearn_torch/python/distributed/dist_neighbor_sampler.py:585-648).
+On TPU the exchange is a fixed-shape `all_to_all` over the mesh: each shard
+packs its outgoing ids into a dense [num_parts, capacity] bucket buffer
+(FILL-padded), the collective transposes shard<->bucket, and responses are
+un-permuted with the remembered (dest, slot) coordinates. Capacity is
+static; overflow beyond `capacity` per destination is masked out (the
+SURVEY §7 "per-partition capacity padding + overflow handling" point).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .unique import FILL
+
+
+@functools.partial(jax.jit, static_argnames=('capacity',))
+def route_slots(dest, mask, capacity: int):
+  """Assign each element a slot within its destination bucket.
+
+  Args:
+    dest: [B] destination partition per element.
+    mask: [B] validity.
+    capacity: bucket capacity (static).
+
+  Returns (slot [B], ok [B]): ``ok`` = valid and not overflowed.
+  """
+  b = dest.shape[0]
+  big = jnp.int32(2 ** 30)
+  key = jnp.where(mask, dest.astype(jnp.int32), big)
+  order = jnp.argsort(key, stable=True)
+  sorted_key = key[order]
+  idx = jnp.arange(b, dtype=jnp.int32)
+  is_first = jnp.concatenate(
+      [jnp.ones((1,), bool), sorted_key[1:] != sorted_key[:-1]])
+  group_start = jax.lax.cummax(jnp.where(is_first, idx, 0))
+  rank_sorted = idx - group_start
+  slot = jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
+  ok = mask & (slot < capacity)
+  return slot, ok
+
+
+def scatter_to_buckets(vals, dest, slot, ok, num_parts: int, capacity: int,
+                       fill=FILL):
+  """Pack [B] (or [B, ...]) values into [num_parts, capacity, ...]."""
+  shape = (num_parts, capacity) + vals.shape[1:]
+  out = jnp.full(shape, fill, dtype=vals.dtype)
+  d = jnp.where(ok, dest, num_parts)
+  return out.at[d, slot].set(vals, mode='drop')
+
+
+def gather_from_buckets(recv, dest, slot, ok, fill=FILL):
+  """Inverse of scatter: pull each element's response from
+  recv[dest, slot]."""
+  safe_d = jnp.where(ok, dest, 0)
+  safe_s = jnp.where(ok, slot, 0)
+  out = recv[safe_d, safe_s]
+  if out.ndim == 1:
+    return jnp.where(ok, out, fill)
+  return jnp.where(ok.reshape((-1,) + (1,) * (out.ndim - 1)), out, fill)

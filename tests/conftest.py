@@ -1,17 +1,26 @@
 """Test configuration: run everything on an 8-device virtual CPU mesh.
 
-Mirrors the reference's test strategy (SURVEY.md §4): multi-node is simulated
-on one machine; here multi-chip is simulated with
-``--xla_force_host_platform_device_count`` so sharding/collective paths are
-exercised without TPU hardware. Must run before jax is imported anywhere.
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node is
+simulated on one machine; here multi-chip is simulated with virtual CPU
+devices so sharding/collective paths are exercised without TPU hardware.
+
+Note: with the installed jax (0.9 + axon TPU plugin) the JAX_PLATFORMS /
+XLA_FLAGS env vars are NOT honored for backend selection — the config keys
+below are, and they must be set before any backend use.
 """
 import os
 
+# kept for older jax versions / subprocesses
 os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)
 
 import numpy as np
 import pytest
