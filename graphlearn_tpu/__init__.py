@@ -6,7 +6,7 @@ accelerator-resident graph sampling, a sharded HBM feature store with
 hot-vertex caching, graph partitioning, distributed sampling + feature
 collection over ICI/DCN collectives, and PyG-compatible dataset/loader APIs.
 """
-from . import (data, distributed, loader, models, ops, partition, sampler,
-               typing, utils)
+from . import (channel, data, distributed, loader, models, ops, partition,
+               sampler, typing, utils)
 
 __version__ = '0.1.0'

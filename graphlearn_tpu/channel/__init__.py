@@ -1,0 +1,4 @@
+from .base import (ChannelBase, QueueTimeoutError, SampleMessage,
+                   deserialize_message, serialize_message)
+from .mp_channel import MpChannel
+from .shm_channel import ShmChannel

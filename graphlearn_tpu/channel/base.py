@@ -1,0 +1,92 @@
+"""Channel interface + SampleMessage serialization.
+
+TPU-native port of /root/reference/graphlearn_torch/python/channel/base.py
+plus the TensorMapSerializer
+(/root/reference/graphlearn_torch/include/tensor_map.h: layout
+|tensor_num| key | dtype | shape | data |). A SampleMessage is a flat
+Dict[str, np.ndarray]; serialization packs it into one contiguous buffer
+for the shm ring. Deserialization views arrays over the received buffer
+(one copy out of shm — the TPU H2D transfer happens later via
+jax.device_put, replacing the reference's pinned-ring CUDA H2D).
+"""
+import struct
+from typing import Dict
+
+import numpy as np
+
+# A flat dict of host arrays, with '#' control keys (reference
+# dist_neighbor_sampler.py '#IS_HETERO'/'#META.*' convention).
+SampleMessage = Dict[str, np.ndarray]
+
+_MAGIC = 0x474C5431  # 'GLT1'
+
+
+def serialize_message(msg: SampleMessage) -> bytes:
+  """Pack to: magic u32, count u32, then per tensor:
+  key_len u16 | key | dtype_len u8 | dtype | ndim u8 | dims i64* | nbytes
+  u64 | raw data (8-aligned)."""
+  parts = [struct.pack('<II', _MAGIC, len(msg))]
+  offset = 8
+  for key, arr in msg.items():
+    arr = np.ascontiguousarray(arr)
+    kb = key.encode()
+    db = arr.dtype.str.encode()
+    hdr = struct.pack('<H', len(kb)) + kb + struct.pack('<B', len(db)) + db
+    hdr += struct.pack('<B', arr.ndim)
+    hdr += struct.pack(f'<{arr.ndim}q', *arr.shape) if arr.ndim else b''
+    hdr += struct.pack('<Q', arr.nbytes)
+    pad = (-(offset + len(hdr))) % 8  # align the data region
+    parts.append(hdr + b'\x00' * pad)
+    offset += len(hdr) + pad
+    parts.append(arr.tobytes())
+    offset += arr.nbytes
+  return b''.join(parts)
+
+
+def deserialize_message(buf) -> SampleMessage:
+  """Inverse of :func:`serialize_message`; arrays are views over ``buf``
+  where alignment allows (reference TensorMapSerializer::Load views over
+  shm, tensor_map.cc:143)."""
+  mv = memoryview(buf)
+  magic, count = struct.unpack_from('<II', mv, 0)
+  assert magic == _MAGIC, 'corrupt sample message'
+  off = 8
+  out: SampleMessage = {}
+  for _ in range(count):
+    (klen,) = struct.unpack_from('<H', mv, off)
+    off += 2
+    key = bytes(mv[off:off + klen]).decode()
+    off += klen
+    (dlen,) = struct.unpack_from('<B', mv, off)
+    off += 1
+    dtype = np.dtype(bytes(mv[off:off + dlen]).decode())
+    off += dlen
+    (ndim,) = struct.unpack_from('<B', mv, off)
+    off += 1
+    shape = struct.unpack_from(f'<{ndim}q', mv, off) if ndim else ()
+    off += 8 * ndim
+    (nbytes,) = struct.unpack_from('<Q', mv, off)
+    off += 8
+    off += (-off) % 8  # skip the writer's data-alignment pad
+    arr = np.frombuffer(mv, dtype=dtype, count=nbytes // dtype.itemsize,
+                        offset=off).reshape(shape)
+    off += nbytes
+    out[key] = arr
+  return out
+
+
+class QueueTimeoutError(RuntimeError):
+  """Reference: include/shm_queue.h QueueTimeoutError."""
+
+
+class ChannelBase:
+  """Reference: channel/base.py:25-47."""
+
+  def send(self, msg: SampleMessage):
+    raise NotImplementedError
+
+  def recv(self, timeout_ms: int = -1) -> SampleMessage:
+    raise NotImplementedError
+
+  def empty(self) -> bool:
+    raise NotImplementedError

@@ -1,0 +1,31 @@
+"""Multiprocessing-queue channel.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/channel/mp_channel.py: a plain
+multiprocessing.Queue fallback (slower than shm, no native dependency).
+"""
+import multiprocessing as mp
+import queue as queue_mod
+
+from .base import ChannelBase, QueueTimeoutError, SampleMessage
+
+
+class MpChannel(ChannelBase):
+  """Reference: channel/mp_channel.py:24-34."""
+
+  def __init__(self, capacity: int = 128, **kwargs):
+    ctx = mp.get_context('spawn')
+    self._queue = ctx.Queue(maxsize=capacity)
+
+  def send(self, msg: SampleMessage):
+    self._queue.put(msg)
+
+  def recv(self, timeout_ms: int = -1) -> SampleMessage:
+    try:
+      timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+      return self._queue.get(timeout=timeout)
+    except queue_mod.Empty as e:
+      raise QueueTimeoutError('mp channel recv timeout') from e
+
+  def empty(self) -> bool:
+    return self._queue.empty()

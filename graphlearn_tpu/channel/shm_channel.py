@@ -1,0 +1,82 @@
+"""Shared-memory channel over the native C++ ring queue.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/channel/shm_channel.py: wraps the
+native SampleQueue (csrc/shm_queue.cc here) with message (de)serialization,
+timeout recv, and fork/spawn pickling by shmid (reference
+py_export.cc:137-154). `pin_memory` is accepted for API parity; on TPU the
+H2D path is jax.device_put from the deserialized views, so there is no
+cudaHostRegister equivalent to apply.
+"""
+import ctypes
+from typing import Optional
+
+from .base import (ChannelBase, QueueTimeoutError, SampleMessage,
+                   deserialize_message, serialize_message)
+
+
+class ShmChannel(ChannelBase):
+  """Reference: shm_channel.py:24-66."""
+
+  def __init__(self, capacity: Optional[int] = None,
+               shm_size: Optional[int] = None, pin_memory: bool = False,
+               _shmid: Optional[int] = None):
+    from ..utils.build import load_native
+    self._lib = load_native()
+    del capacity  # ring is byte-bounded; block count is implicit
+    self.shm_size = shm_size or (1 << 26)  # 64 MiB default
+    self.pin_memory = pin_memory
+    if _shmid is not None:
+      self._q = self._lib.shmq_attach(_shmid)
+      if not self._q:
+        raise RuntimeError(f'shmq_attach({_shmid}) failed')
+    else:
+      self._q = self._lib.shmq_create(self.shm_size)
+      if not self._q:
+        raise RuntimeError('shmq_create failed')
+
+  @property
+  def shmid(self) -> int:
+    return self._lib.shmq_id(self._q)
+
+  def send(self, msg: SampleMessage):
+    buf = serialize_message(msg)
+    rc = self._lib.shmq_enqueue(self._q, buf, len(buf))
+    if rc != 0:
+      raise RuntimeError(
+          f'message of {len(buf)} bytes exceeds ring capacity '
+          f'{self.shm_size}')
+
+  def recv(self, timeout_ms: int = -1) -> SampleMessage:
+    size = self._lib.shmq_next_size(self._q, timeout_ms)
+    if size == -1:
+      raise QueueTimeoutError('shm channel recv timeout')
+    if size == -2:
+      raise StopIteration('channel finished')
+    buf = ctypes.create_string_buffer(size)
+    got = self._lib.shmq_dequeue(self._q, buf, size, timeout_ms)
+    if got == -1:
+      raise QueueTimeoutError('shm channel recv timeout')
+    if got == -2:
+      raise StopIteration('channel finished')
+    assert got == size, (got, size)
+    return deserialize_message(bytes(buf))
+
+  def empty(self) -> bool:
+    return self._lib.shmq_count(self._q) == 0
+
+  def finish(self):
+    """Producer end-of-epoch mark (end-of-stream protocol)."""
+    self._lib.shmq_finish(self._q)
+
+  def reset(self):
+    self._lib.shmq_reset_finished(self._q)
+
+  def close(self):
+    if self._q:
+      self._lib.shmq_close(self._q)
+      self._q = None
+
+  # pickling by shmid: consumer processes re-attach
+  def __reduce__(self):
+    return (ShmChannel, (None, self.shm_size, self.pin_memory, self.shmid))

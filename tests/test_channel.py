@@ -1,0 +1,102 @@
+"""Channel tests, mirroring the reference's test_shm_channel.py (send/recv
+round-trip) and test_tensor_map_serializer.cu (serialize/load), plus a real
+cross-process producer (the reference exercises real shm, no mocks)."""
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu.channel import (MpChannel, QueueTimeoutError,
+                                    ShmChannel, deserialize_message,
+                                    serialize_message)
+
+
+def sample_msg():
+  return {
+      'node': np.arange(10, dtype=np.int64),
+      'x': np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32),
+      '#META.bs': np.array([4], dtype=np.int32),
+      'scalarish': np.array(7, dtype=np.int64),
+  }
+
+
+def assert_msg_equal(a, b):
+  assert set(a.keys()) == set(b.keys())
+  for k in a:
+    np.testing.assert_array_equal(a[k], b[k])
+    assert a[k].dtype == b[k].dtype
+
+
+def test_serializer_roundtrip():
+  msg = sample_msg()
+  buf = serialize_message(msg)
+  out = deserialize_message(buf)
+  assert_msg_equal(msg, out)
+
+
+def test_shm_channel_roundtrip():
+  ch = ShmChannel(shm_size=1 << 20)
+  msg = sample_msg()
+  ch.send(msg)
+  ch.send(msg)
+  out = ch.recv(timeout_ms=1000)
+  assert_msg_equal(msg, out)
+  out = ch.recv(timeout_ms=1000)
+  assert_msg_equal(msg, out)
+  assert ch.empty()
+  ch.close()
+
+
+def test_shm_channel_timeout():
+  ch = ShmChannel(shm_size=1 << 16)
+  t0 = time.monotonic()
+  with pytest.raises(QueueTimeoutError):
+    ch.recv(timeout_ms=200)
+  assert time.monotonic() - t0 >= 0.15
+  ch.close()
+
+
+def test_shm_channel_finish():
+  ch = ShmChannel(shm_size=1 << 16)
+  ch.finish()
+  with pytest.raises(StopIteration):
+    ch.recv(timeout_ms=1000)
+  ch.reset()
+  ch.send({'a': np.arange(3)})
+  assert_msg_equal({'a': np.arange(3)}, ch.recv(timeout_ms=1000))
+  ch.close()
+
+
+def _producer(channel, n):
+  for i in range(n):
+    channel.send({'i': np.array([i]), 'payload': np.full((100,), i)})
+  channel.finish()
+
+
+def test_shm_channel_cross_process():
+  ch = ShmChannel(shm_size=1 << 20)
+  ctx = mp.get_context('spawn')
+  proc = ctx.Process(target=_producer, args=(ch, 5))
+  proc.start()
+  got = []
+  while True:
+    try:
+      msg = ch.recv(timeout_ms=10000)
+    except StopIteration:
+      break
+    got.append(int(msg['i'][0]))
+    np.testing.assert_array_equal(msg['payload'],
+                                  np.full((100,), got[-1]))
+  proc.join(timeout=10)
+  assert got == list(range(5))
+  ch.close()
+
+
+def test_mp_channel():
+  ch = MpChannel(capacity=4)
+  msg = sample_msg()
+  ch.send(msg)
+  assert_msg_equal(msg, ch.recv(timeout_ms=1000))
+  with pytest.raises(QueueTimeoutError):
+    ch.recv(timeout_ms=100)
