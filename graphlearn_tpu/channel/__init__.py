@@ -1,4 +1,5 @@
 from .base import (ChannelBase, QueueTimeoutError, SampleMessage,
                    deserialize_message, serialize_message)
 from .mp_channel import MpChannel
+from .remote_channel import RemoteReceivingChannel
 from .shm_channel import ShmChannel

@@ -1,0 +1,96 @@
+"""Client-side receiving channel pulling batches from sampling servers.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/channel/remote_channel.py: keeps
+`prefetch_size` outstanding fetch requests per server, buffers responses in
+a local queue, and tracks the per-server end-of-epoch protocol
+(message None + end flag, remote_channel.py:58-131).
+"""
+import queue
+import threading
+from typing import List
+
+from .base import ChannelBase, QueueTimeoutError, SampleMessage
+
+
+class RemoteReceivingChannel(ChannelBase):
+  """Reference: remote_channel.py:24-131."""
+
+  def __init__(self, server_ranks: List[int], producer_ids: List[int],
+               prefetch_size: int = 4, request_fn=None):
+    """`request_fn(server_rank, producer_id)` -> (msg|None, end_flag);
+    defaults to dist_client.request_server(fetch_one_sampled_message)."""
+    self.server_ranks = list(server_ranks)
+    self.producer_ids = list(producer_ids)
+    self.prefetch_size = prefetch_size
+    if request_fn is None:
+      from ..distributed import dist_client
+
+      def request_fn(rank, pid):
+        return dist_client.request_server(
+            rank, 'fetch_one_sampled_message', pid)
+    self._request_fn = request_fn
+    self._queue: queue.Queue = queue.Queue()
+    self._threads: List[threading.Thread] = []
+    self._stopped = threading.Event()
+    self._pending_end = 0
+    self._lock = threading.Lock()
+    self._started = False
+
+  def _puller(self, rank: int, pid: int):
+    """One puller thread per (server, prefetch slot)."""
+    while not self._stopped.is_set():
+      try:
+        msg, end = self._request_fn(rank, pid)
+      except Exception as e:  # noqa: BLE001 - surfaced to the consumer
+        self._queue.put(('error', repr(e)))
+        return
+      if msg is not None:
+        self._queue.put(('msg', msg))
+      if end:
+        self._queue.put(('end', rank))
+        return
+
+  def start(self):
+    """Begin one epoch of pulling (idempotent per epoch)."""
+    self._stopped.clear()
+    with self._lock:
+      self._pending_end = 0
+      self._threads = []
+      for rank, pid in zip(self.server_ranks, self.producer_ids):
+        self._pending_end += 1
+        for _ in range(self.prefetch_size):
+          t = threading.Thread(target=self._puller, args=(rank, pid),
+                               daemon=True)
+          self._threads.append(t)
+      # only one end-marker per server must count: track per server below
+      self._ends_seen = set()
+      for t in self._threads:
+        t.start()
+    self._started = True
+
+  def recv(self, timeout_ms: int = -1) -> SampleMessage:
+    if not self._started:
+      self.start()
+    timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+    while True:
+      try:
+        kind, payload = self._queue.get(timeout=timeout)
+      except queue.Empty as e:
+        raise QueueTimeoutError('remote channel recv timeout') from e
+      if kind == 'msg':
+        return payload
+      if kind == 'error':
+        raise RuntimeError(f'remote fetch failed: {payload}')
+      # end marker for one server
+      with self._lock:
+        self._ends_seen.add(payload)
+        if len(self._ends_seen) >= len(set(self.server_ranks)):
+          self._started = False
+          raise StopIteration('epoch complete')
+
+  def empty(self) -> bool:
+    return self._queue.empty()
+
+  def stop(self):
+    self._stopped.set()

@@ -3,5 +3,19 @@ from .dist_context import (DistContext, DistRole, get_context,
 from .dist_dataset import DistDataset
 from .dist_feature import DistFeature
 from .dist_graph import DistGraph, build_local_csr
-from .dist_loader import DistLoader, DistNeighborLoader
+from .dist_loader import (DistLoader, DistNeighborLoader,
+                          MpDistNeighborLoader, RemoteDistNeighborLoader)
 from .dist_neighbor_sampler import DistNeighborSampler
+from .dist_options import (CollocatedDistSamplingWorkerOptions,
+                           MpDistSamplingWorkerOptions,
+                           RemoteDistSamplingWorkerOptions)
+from .dist_sampling_producer import (DistCollocatedSamplingProducer,
+                                     DistMpSamplingProducer)
+from .dist_server import (DistServer, get_server, init_server,
+                          wait_and_shutdown_server)
+from .dist_client import (async_request_server, init_client,
+                          request_server, shutdown_client)
+from .event_loop import ConcurrentEventLoop
+from .message import message_to_data, output_to_message
+from .rpc import (Barrier, RpcCalleeBase, RpcClient,
+                  RpcDataPartitionRouter, RpcServer, get_free_port)

@@ -1,0 +1,66 @@
+"""Training-client side of the server-client topology.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/distributed/dist_client.py:
+`init_client` connects to the sampling servers, `request_server` /
+`async_request_server` dispatch named calls, `shutdown_client` runs the
+client barrier and (client 0) fans out server exit.
+"""
+from typing import List, Optional, Tuple
+
+from .dist_context import _set_client_context, get_context
+from .rpc import RpcClient
+
+_client: Optional[RpcClient] = None
+
+
+def init_client(num_servers: int, num_clients: int, client_rank: int,
+                server_addrs: List[Tuple[str, int]]):
+  """Reference: dist_client.py:24-51 (tensorpipe rendezvous replaced by an
+  explicit server address list)."""
+  global _client
+  assert len(server_addrs) == num_servers
+  _set_client_context(num_servers, num_clients, client_rank)
+  _client = RpcClient()
+  for rank, (host, port) in enumerate(server_addrs):
+    _client.add_target(rank, host, port)
+  return _client
+
+
+def request_server(server_rank: int, func, *args, **kwargs):
+  """Reference: dist_client.py:79-88. `func` may be a name or a DistServer
+  method (its __name__ is used)."""
+  name = func if isinstance(func, str) else func.__name__
+  return _client.request_sync(server_rank, name, *args, **kwargs)
+
+
+def async_request_server(server_rank: int, func, *args, **kwargs):
+  """Reference: dist_client.py:90-98."""
+  name = func if isinstance(func, str) else func.__name__
+  return _client.request_async(server_rank, name, *args, **kwargs)
+
+
+def barrier(timeout: float = 180.0):
+  """Client-group barrier hosted by server 0."""
+  ctx = get_context()
+  return _client.request_sync(0, 'client_barrier', ctx.rank,
+                              timeout=timeout)
+
+
+def shutdown_client():
+  """Reference: dist_client.py:54-76."""
+  global _client
+  if _client is None:
+    return
+  ctx = get_context()
+  try:
+    barrier()
+    if ctx is not None and ctx.rank == 0:
+      for rank in _client.targets:
+        try:
+          _client.request_sync(rank, 'exit')
+        except (RuntimeError, ConnectionError, OSError):
+          pass
+  finally:
+    _client.close()
+    _client = None

@@ -75,6 +75,124 @@ class DistLoader:
                 metadata=dict(out.metadata))
 
 
+class MpDistNeighborLoader:
+  """Mp worker mode: sampling subprocesses feed a native shm channel, the
+  loader drains it (reference: dist_loader.py:226-302 mp branch). Use when
+  host-side seed prep/feature IO should overlap device training; the
+  collocated mesh loader (DistNeighborLoader) is the device-fast path."""
+
+  def __init__(self, data, num_neighbors: List[int], input_nodes,
+               batch_size: int = 64, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, num_workers: int = 2,
+               channel_size: int = 1 << 26, seed: Optional[int] = None):
+    from ..channel import QueueTimeoutError, ShmChannel
+    from ..sampler import SamplingConfig, SamplingType
+    from .dist_sampling_producer import DistMpSamplingProducer
+    from .message import message_to_data
+    self._message_to_data = message_to_data
+    self._timeout_error = QueueTimeoutError
+    config = SamplingConfig(
+        SamplingType.NODE, list(num_neighbors), batch_size, shuffle,
+        drop_last, with_edge, collect_features, False, False,
+        data.edge_dir, seed)
+    self.channel = ShmChannel(shm_size=channel_size)
+    self.producer = DistMpSamplingProducer(
+        data, NodeSamplerInput(np.asarray(input_nodes).reshape(-1)),
+        config, self.channel, num_workers=num_workers, seed=seed)
+    self.producer.init()
+    self._expected = self.producer.num_expected()
+
+  def __len__(self):
+    return self._expected
+
+  def __iter__(self):
+    self.producer.produce_all()
+    received = 0
+    while received < self._expected:
+      try:
+        msg = self.channel.recv(timeout_ms=60000)
+      except self._timeout_error:
+        if self.producer.is_all_sampling_completed() and \
+            self.channel.empty():
+          break
+        continue
+      received += 1
+      yield self._message_to_data(msg)
+
+  def shutdown(self):
+    self.producer.shutdown()
+    self.channel.close()
+
+
+class RemoteDistNeighborLoader:
+  """Remote (server-client) mode: producers run on sampling servers,
+  batches stream back over RPC (reference: dist_loader.py:155-195 +
+  dist_neighbor_loader.py remote branch)."""
+
+  def __init__(self, num_neighbors: List[int], input_nodes,
+               batch_size: int = 64, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, worker_options=None,
+               seed: Optional[int] = None):
+    from ..channel import RemoteReceivingChannel
+    from ..sampler import SamplingConfig, SamplingType
+    from . import dist_client
+    from .message import message_to_data
+    self._message_to_data = message_to_data
+    opts = worker_options
+    ranks = opts.server_rank if opts and opts.server_rank is not None \
+        else [0]
+    if isinstance(ranks, int):
+      ranks = [ranks]
+    self.server_ranks = list(ranks)
+    config = SamplingConfig(
+        SamplingType.NODE, list(num_neighbors), batch_size, shuffle,
+        drop_last, with_edge, collect_features, False, False, 'out', seed)
+    seeds = np.asarray(input_nodes).reshape(-1)
+    # split seeds across servers; each server samples its share
+    splits = np.array_split(seeds, len(self.server_ranks))
+    self.producer_ids = []
+    self._expected = 0
+    for rank, part in zip(self.server_ranks, splits):
+      pid = dist_client.request_server(
+          rank, 'create_sampling_producer', part, config,
+          opts.num_workers if opts else 1,
+          worker_key=(opts.worker_key if opts else None))
+      self.producer_ids.append(pid)
+      n = part.shape[0]
+      self._expected += (n // batch_size if drop_last
+                         else -(-n // batch_size))
+    self.channel = RemoteReceivingChannel(
+        self.server_ranks, self.producer_ids,
+        prefetch_size=(opts.prefetch_size if opts else 4))
+    self._dist_client = dist_client
+
+  def __len__(self):
+    return self._expected
+
+  def __iter__(self):
+    for rank, pid in zip(self.server_ranks, self.producer_ids):
+      self._dist_client.request_server(rank, 'start_new_epoch_sampling',
+                                       pid)
+    self.channel.start()
+    while True:
+      try:
+        msg = self.channel.recv(timeout_ms=60000)
+      except StopIteration:
+        return
+      yield self._message_to_data(msg)
+
+  def shutdown(self):
+    self.channel.stop()
+    for rank, pid in zip(self.server_ranks, self.producer_ids):
+      try:
+        self._dist_client.request_server(rank,
+                                         'destroy_sampling_producer', pid)
+      except (RuntimeError, ConnectionError, OSError):
+        pass
+
+
 class DistNeighborLoader(DistLoader):
   """Reference: dist_neighbor_loader.py:104-112."""
 

@@ -1,0 +1,56 @@
+"""Sampling-worker option bundles.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/distributed/dist_options.py. The
+three deployment shapes survive: collocated (sampling compiled into the
+training step's mesh program — the default and fastest on TPU), mp
+(sampling in subprocesses feeding a shm channel; useful when host-side
+seed prep/IO is the bottleneck), and remote (sampling on server processes,
+batches streamed to clients over DCN).
+"""
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+
+@dataclass
+class _BasicDistSamplingWorkerOptions:
+  """Reference: dist_options.py:24-116."""
+  num_workers: int = 1
+  worker_concurrency: int = 4
+  master_addr: Optional[str] = None
+  master_port: Optional[Union[str, int]] = None
+  channel_size: Optional[Union[int, str]] = None
+  pin_memory: bool = False
+  rpc_timeout: float = 180.0
+
+
+@dataclass
+class CollocatedDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
+  """Sampling runs in-process on the training mesh
+  (reference: dist_options.py:145-166)."""
+  use_all2all: bool = True
+
+
+@dataclass
+class MpDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
+  """Sampling subprocesses + shm channel
+  (reference: dist_options.py:169-199)."""
+  channel_capacity: int = 128
+
+
+@dataclass
+class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
+  """Server-side producers streaming to this client
+  (reference: dist_options.py:202-260)."""
+  server_rank: Optional[Union[int, List[int]]] = None
+  buffer_size: Optional[Union[int, str]] = None
+  prefetch_size: int = 4
+  worker_key: Optional[str] = None
+  epochs: int = 1
+
+
+AllDistSamplingWorkerOptions = Union[
+    CollocatedDistSamplingWorkerOptions,
+    MpDistSamplingWorkerOptions,
+    RemoteDistSamplingWorkerOptions,
+]

@@ -1,0 +1,202 @@
+"""Sampling producers: collocated (in-process) and mp (subprocess) batch
+production into a channel.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/distributed/dist_sampling_producer.py.
+The mp producer spawns worker subprocesses that run the sampler over a
+static split of the seed range and push serialized SampleMessages into the
+shared shm channel (reference _sampling_worker_loop, :53-151). Worker
+subprocesses force the CPU jax backend — the TPU chips belong to the
+training process (single-controller model), so host-side producers sample
+on CPU; the fast path for device sampling is the collocated mesh program.
+"""
+import multiprocessing as mp
+from enum import Enum
+from typing import Optional
+
+import numpy as np
+
+from ..channel import ChannelBase
+from ..sampler import NodeSamplerInput, SamplingConfig, SamplingType
+from .message import output_to_message
+
+
+class MpCommand(Enum):
+  """Reference: dist_sampling_producer.py MpCommand."""
+  SAMPLE_ALL = 0
+  STOP = 1
+
+
+def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
+                          task_queue, channel, done_counter):
+  """Subprocess body (reference: dist_sampling_producer.py:53-151)."""
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except RuntimeError:
+    pass
+  import graphlearn_tpu as glt
+
+  # rebuild from host-side ipc handles; device state stays on CPU here
+  topo, _ = dataset_handle['graph_ipc']
+  graph = glt.data.Graph(topo, 'CPU')
+  feature = None
+  if dataset_handle['feature_ipc'] is not None:
+    feature = glt.data.Feature.from_ipc_handle(
+        dataset_handle['feature_ipc'])
+    feature.with_device = False
+  dataset = glt.data.Dataset(graph, feature, None,
+                             dataset_handle['node_labels'],
+                             dataset_handle['edge_dir'])
+  cfg: SamplingConfig = sampling_config
+  sampler = glt.sampler.NeighborSampler(
+      dataset.graph, cfg.num_neighbors, with_edge=cfg.with_edge,
+      with_weight=cfg.with_weight, edge_dir=cfg.edge_dir, seed=cfg.seed)
+  while True:
+    cmd, payload = task_queue.get()
+    if cmd == MpCommand.STOP:
+      break
+    epoch_seed_order = payload
+    n = seeds.shape[0]
+    bs = cfg.batch_size
+    for i in range(0, n - (n % bs if cfg.drop_last else 0), bs):
+      idx = epoch_seed_order[i:i + bs]
+      if idx.shape[0] == 0:
+        continue
+      out = sampler.sample_from_nodes(NodeSamplerInput(seeds[idx]),
+                                      batch_cap=bs)
+      x = y = None
+      if cfg.collect_features and dataset.node_features is not None:
+        x = dataset.node_features.cpu_get(
+            np.maximum(np.asarray(out.node), 0))
+      if dataset.node_labels is not None:
+        labels = np.asarray(dataset.node_labels)
+        y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
+      channel.send(output_to_message(out, x, y))
+    with done_counter.get_lock():
+      done_counter.value += 1
+
+
+class DistMpSamplingProducer:
+  """Spawn N sampling subprocesses feeding `channel`
+  (reference: dist_sampling_producer.py:154-280)."""
+
+  def __init__(self, dataset, sampler_input: NodeSamplerInput,
+               sampling_config: SamplingConfig, channel: ChannelBase,
+               num_workers: int = 1, seed: Optional[int] = None):
+    self.dataset = dataset
+    self.seeds = np.asarray(sampler_input.node).reshape(-1)
+    self.config = sampling_config
+    self.channel = channel
+    self.num_workers = num_workers
+    self._rng = np.random.default_rng(seed)
+    self._procs = []
+    self._queues = []
+    self._done = None
+    self._splits = np.array_split(np.arange(self.seeds.shape[0]),
+                                  num_workers)
+
+  def init(self):
+    ctx = mp.get_context('spawn')
+    self._done = ctx.Value('i', 0)
+    handle = dict(
+        graph_ipc=self.dataset.graph.share_ipc(),
+        feature_ipc=(self.dataset.node_features.share_ipc()
+                     if self.dataset.node_features is not None else None),
+        node_labels=self.dataset.node_labels,
+        edge_dir=self.dataset.edge_dir)
+    # ship host containers; subprocesses rebuild on the CPU backend
+    for w in range(self.num_workers):
+      q = ctx.Queue()
+      p = ctx.Process(
+          target=_sampling_worker_loop,
+          args=(w, handle, self.config, self.seeds[self._splits[w]], q,
+                self.channel, self._done),
+          daemon=True)
+      p.start()
+      self._procs.append(p)
+      self._queues.append(q)
+
+  def produce_all(self):
+    """Kick one epoch of sampling on all workers
+    (reference: :227-240)."""
+    with self._done.get_lock():
+      self._done.value = 0
+    if hasattr(self.channel, 'reset'):
+      self.channel.reset()
+    for w in range(self.num_workers):
+      n = self._splits[w].shape[0]
+      order = (self._rng.permutation(n) if self.config.shuffle
+               else np.arange(n))
+      self._queues[w].put((MpCommand.SAMPLE_ALL, order))
+
+  def is_all_sampling_completed(self) -> bool:
+    with self._done.get_lock():
+      return self._done.value == self.num_workers
+
+  def num_expected(self) -> int:
+    bs = self.config.batch_size
+    total = 0
+    for s in self._splits:
+      n = s.shape[0]
+      total += n // bs if self.config.drop_last else -(-n // bs)
+    return total
+
+  def shutdown(self):
+    for q in self._queues:
+      try:
+        q.put((MpCommand.STOP, None))
+      except Exception:
+        pass
+    for p in self._procs:
+      p.join(timeout=5)
+      if p.is_alive():
+        p.terminate()
+
+
+class DistCollocatedSamplingProducer:
+  """In-process synchronous producer (reference: :283-349)."""
+
+  def __init__(self, dataset, sampler_input: NodeSamplerInput,
+               sampling_config: SamplingConfig,
+               seed: Optional[int] = None):
+    import graphlearn_tpu as glt
+    self.dataset = dataset
+    self.seeds = np.asarray(sampler_input.node).reshape(-1)
+    self.config = sampling_config
+    cfg = sampling_config
+    self.sampler = glt.sampler.NeighborSampler(
+        dataset.graph, cfg.num_neighbors, with_edge=cfg.with_edge,
+        with_weight=cfg.with_weight, edge_dir=cfg.edge_dir, seed=cfg.seed)
+    self._rng = np.random.default_rng(seed)
+    self._order = None
+    self._pos = 0
+
+  def reset(self):
+    self._order = (self._rng.permutation(self.seeds.shape[0])
+                   if self.config.shuffle
+                   else np.arange(self.seeds.shape[0]))
+    self._pos = 0
+
+  def sample(self):
+    """Produce the next batch's message, or None at epoch end."""
+    if self._order is None:
+      self.reset()
+    bs = self.config.batch_size
+    n = self.seeds.shape[0]
+    if self._pos >= n or (self.config.drop_last and
+                          self._pos + bs > n):
+      return None
+    idx = self._order[self._pos:self._pos + bs]
+    self._pos += bs
+    out = self.sampler.sample_from_nodes(NodeSamplerInput(self.seeds[idx]),
+                                         batch_cap=bs)
+    x = y = None
+    if self.config.collect_features and \
+        self.dataset.node_features is not None:
+      x = self.dataset.node_features.cpu_get(
+          np.maximum(np.asarray(out.node), 0))
+    if self.dataset.node_labels is not None:
+      labels = np.asarray(self.dataset.node_labels)
+      y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
+    return output_to_message(out, x, y)

@@ -1,0 +1,160 @@
+"""Sampling server: holds graph data, produces batches for remote clients.
+
+TPU-native port of
+/root/reference/graphlearn_torch/python/distributed/dist_server.py. The
+server process owns a Dataset (its graph partition + features), registers a
+producer per client request, and streams serialized SampleMessages on
+demand over the TCP RPC (replacing torch-RPC). `fetch_one_sampled_message`
+keeps the reference's poll contract: (message|None, end_of_epoch_flag) with
+a bounded wait (dist_server.py:149-166).
+"""
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..channel import QueueTimeoutError, ShmChannel
+from ..sampler import NodeSamplerInput, SamplingConfig
+from .dist_context import _set_server_context, get_context
+from .dist_sampling_producer import DistMpSamplingProducer
+from .rpc import Barrier, RpcServer
+
+
+class DistServer:
+  """Reference: dist_server.py:38-176."""
+
+  def __init__(self, dataset):
+    self.dataset = dataset
+    self._producers: Dict[int, DistMpSamplingProducer] = {}
+    self._buffers: Dict[int, ShmChannel] = {}
+    self._expected: Dict[int, int] = {}
+    self._received: Dict[int, int] = {}
+    self._next_id = 0
+    self._worker_key_to_id: Dict[str, int] = {}
+    self._lock = threading.RLock()
+    self._exit = threading.Event()
+
+  # -- producer lifecycle (reference: dist_server.py:104-147) --------------
+
+  def create_sampling_producer(self, seeds, sampling_config: SamplingConfig,
+                               num_workers: int = 1,
+                               buffer_size: int = 1 << 26,
+                               worker_key: Optional[str] = None) -> int:
+    with self._lock:
+      if worker_key is not None and worker_key in self._worker_key_to_id:
+        return self._worker_key_to_id[worker_key]
+      pid = self._next_id
+      self._next_id += 1
+      buf = ShmChannel(shm_size=buffer_size)
+      producer = DistMpSamplingProducer(
+          self.dataset, NodeSamplerInput.cast(seeds), sampling_config, buf,
+          num_workers=num_workers)
+      producer.init()
+      self._producers[pid] = producer
+      self._buffers[pid] = buf
+      self._expected[pid] = producer.num_expected()
+      self._received[pid] = 0
+      if worker_key is not None:
+        self._worker_key_to_id[worker_key] = pid
+      return pid
+
+  def start_new_epoch_sampling(self, producer_id: int):
+    with self._lock:
+      self._received[producer_id] = 0
+    self._producers[producer_id].produce_all()
+
+  def fetch_one_sampled_message(self, producer_id: int,
+                                timeout_ms: int = 500
+                                ) -> Tuple[Optional[dict], bool]:
+    """(message|None, end_of_epoch). Reference: dist_server.py:149-166."""
+    producer = self._producers[producer_id]
+    buf = self._buffers[producer_id]
+    with self._lock:
+      if self._received[producer_id] >= self._expected[producer_id]:
+        return None, True
+    try:
+      msg = buf.recv(timeout_ms=timeout_ms)
+    except QueueTimeoutError:
+      done = (producer.is_all_sampling_completed() and buf.empty())
+      return None, done
+    except StopIteration:
+      return None, True
+    with self._lock:
+      self._received[producer_id] += 1
+      end = self._received[producer_id] >= self._expected[producer_id]
+    return msg, end
+
+  def destroy_sampling_producer(self, producer_id: int):
+    with self._lock:
+      producer = self._producers.pop(producer_id, None)
+      buf = self._buffers.pop(producer_id, None)
+      self._expected.pop(producer_id, None)
+      self._received.pop(producer_id, None)
+      for k, v in list(self._worker_key_to_id.items()):
+        if v == producer_id:
+          del self._worker_key_to_id[k]
+    if producer:
+      producer.shutdown()
+    if buf:
+      buf.close()
+
+  # -- misc (reference: dist_server.py:60-102) -----------------------------
+
+  def get_dataset_meta(self):
+    g = self.dataset.graph
+    return dict(num_nodes=g.num_nodes, num_edges=g.num_edges,
+                edge_dir=self.dataset.edge_dir)
+
+  def exit(self):
+    for pid in list(self._producers):
+      self.destroy_sampling_producer(pid)
+    self._exit.set()
+    return True
+
+  def wait_for_exit(self, timeout: Optional[float] = None) -> bool:
+    return self._exit.wait(timeout)
+
+
+_server: Optional[DistServer] = None
+_rpc_server: Optional[RpcServer] = None
+
+
+def get_server() -> Optional[DistServer]:
+  return _server
+
+
+def init_server(num_servers: int, num_clients: int, server_rank: int,
+                dataset, master_addr: str = '127.0.0.1',
+                server_client_master_port: int = 0) -> Tuple[str, int]:
+  """Start this server's RPC endpoint (reference: dist_server.py:180-212).
+  Returns (host, port) — hand these to clients (the reference's tensorpipe
+  rendezvous becomes explicit address exchange)."""
+  global _server, _rpc_server
+  _set_server_context(num_servers, num_clients, server_rank)
+  _server = DistServer(dataset)
+  _rpc_server = RpcServer(master_addr, server_client_master_port)
+  s = _server
+  _rpc_server.register('create_sampling_producer',
+                       s.create_sampling_producer)
+  _rpc_server.register('start_new_epoch_sampling',
+                       s.start_new_epoch_sampling)
+  _rpc_server.register('fetch_one_sampled_message',
+                       s.fetch_one_sampled_message)
+  _rpc_server.register('destroy_sampling_producer',
+                       s.destroy_sampling_producer)
+  _rpc_server.register('get_dataset_meta', s.get_dataset_meta)
+  _rpc_server.register('exit', s.exit)
+  barrier = Barrier(num_clients)
+  _rpc_server.register('client_barrier', barrier.arrive)
+  return _rpc_server.host, _rpc_server.port
+
+
+def wait_and_shutdown_server(timeout: Optional[float] = None):
+  """Block until a client calls exit (reference: dist_server.py:215-233)."""
+  global _server, _rpc_server
+  if _server is not None:
+    _server.wait_for_exit(timeout)
+    time.sleep(0.1)  # let the exit RPC response flush
+  if _rpc_server is not None:
+    _rpc_server.shutdown()
+  _server = None
+  _rpc_server = None

@@ -1,0 +1,80 @@
+"""SamplerOutput <-> flat SampleMessage conversion.
+
+Counterpart of the reference's SampleMessage dict convention
+(/root/reference/graphlearn_torch/python/distributed/dist_neighbor_sampler.py:650-744:
+flat Dict[str, Tensor] with '#' control keys) used across channels and the
+server-client wire.
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..loader import Data
+from ..sampler import SamplerOutput
+
+META_PREFIX = '#META.'
+
+
+def output_to_message(out: SamplerOutput, x=None, y=None) -> dict:
+  """Flatten a (homogeneous) SamplerOutput + optional collected features."""
+  msg = {
+      'node': np.asarray(out.node),
+      'num_nodes': np.asarray(out.num_nodes),
+      'row': np.asarray(out.row),
+      'col': np.asarray(out.col),
+      'edge_mask': np.asarray(out.edge_mask),
+  }
+  if out.edge is not None:
+    msg['edge'] = np.asarray(out.edge)
+  if out.batch is not None:
+    msg['batch'] = np.asarray(out.batch)
+  if out.batch_size is not None:
+    msg['#META.batch_size'] = np.asarray(out.batch_size)
+  if out.num_sampled_nodes is not None:
+    msg['num_sampled_nodes'] = np.asarray(
+        [np.asarray(v) for v in out.num_sampled_nodes])
+  if out.num_sampled_edges is not None:
+    msg['num_sampled_edges'] = np.asarray(
+        [np.asarray(v) for v in out.num_sampled_edges])
+  if x is not None:
+    msg['x'] = np.asarray(x)
+  if y is not None:
+    msg['y'] = np.asarray(y)
+  for k, v in out.metadata.items():
+    try:
+      msg[META_PREFIX + k] = np.asarray(v)
+    except Exception:
+      pass
+  return msg
+
+
+def message_to_data(msg: dict, to_device: bool = True) -> Data:
+  """SampleMessage -> loader.Data (reference: DistLoader._collate_fn,
+  dist_loader.py:331-441). Arrays stay padded; device transfer is one
+  device_put per array when `to_device`."""
+  import jax.numpy as jnp
+  conv = (lambda a: jnp.asarray(a)) if to_device else (lambda a: a)
+  node = conv(msg['node'])
+  row, col = conv(msg['row']), conv(msg['col'])
+  ei = jnp.stack([row, col]) if to_device else np.stack([row, col])
+  num_nodes = msg.get('num_nodes')
+  node_mask = None
+  if num_nodes is not None:
+    num_nodes = int(np.asarray(num_nodes).reshape(-1)[0])
+    rng = jnp.arange(node.shape[0]) if to_device else \
+        np.arange(node.shape[0])
+    node_mask = rng < num_nodes
+  metadata = {k[len(META_PREFIX):]: v for k, v in msg.items()
+              if k.startswith(META_PREFIX) and k != '#META.batch_size'}
+  return Data(
+      node=node, num_nodes=num_nodes, node_mask=node_mask, edge_index=ei,
+      edge_mask=conv(msg['edge_mask']),
+      x=conv(msg['x']) if 'x' in msg else None,
+      y=conv(msg['y']) if 'y' in msg else None,
+      edge_ids=conv(msg['edge']) if 'edge' in msg else None,
+      batch=conv(msg['batch']) if 'batch' in msg else None,
+      batch_size=(int(np.asarray(msg['#META.batch_size']).reshape(-1)[0])
+                  if '#META.batch_size' in msg else None),
+      num_sampled_nodes=msg.get('num_sampled_nodes'),
+      num_sampled_edges=msg.get('num_sampled_edges'),
+      metadata=metadata)
