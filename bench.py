@@ -23,7 +23,7 @@ AVG_DEG = 25
 FANOUT = [15, 10, 5]
 BATCH = 1024
 WARMUP = 3
-ITERS = 20
+ITERS = 50
 
 
 def build_graph():
@@ -46,7 +46,10 @@ def main():
   from graphlearn_tpu.sampler import NodeSamplerInput
 
   graph = build_graph()
-  sampler = glt.sampler.NeighborSampler(graph, FANOUT, seed=0)
+  # fused: one XLA program per batch (in-program dependencies are free;
+  # per-op host dispatch is not). dedup='auto' picks the direct-address
+  # table inducer (no sorts) at this graph size.
+  sampler = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True)
   rng = np.random.default_rng(1)
 
   def one_batch(i):
@@ -54,21 +57,22 @@ def main():
     return sampler.sample_from_nodes(NodeSamplerInput(seeds),
                                      batch_cap=BATCH)
 
+  import jax.numpy as jnp
   for i in range(WARMUP):
     out = one_batch(i)
-    jax.block_until_ready(out.row)
+    _ = int(out.edge_mask.sum())  # host fetch = real sync
 
-  total_edges = 0
+  # Accumulate the edge count on device and fetch ONCE at the end: the
+  # remote-dispatch runtime here has a ~100ms host-fetch round trip, so a
+  # per-batch fetch would measure RTT, not sampling (the reference
+  # likewise syncs once around the timed loop, bench_sampler.py:48-53).
   t0 = time.perf_counter()
-  outs = []
+  total = jnp.zeros((), jnp.int32)
   for i in range(ITERS):
-    outs.append(one_batch(i))
-  # count on device, sync once at the end (matches the reference's
-  # synchronize-then-time discipline, bench_sampler.py:48-53)
-  counts = [o.edge_mask.sum() for o in outs]
-  jax.block_until_ready(counts)
+    out = one_batch(i)
+    total = total + out.edge_mask.sum()
+  total_edges = int(total)  # single device->host fetch, syncs everything
   dt = time.perf_counter() - t0
-  total_edges = int(sum(int(c) for c in counts))
 
   edges_per_sec_m = total_edges / dt / 1e6
   print(json.dumps({

@@ -1,4 +1,5 @@
 from .induce import InducerState, induce_next, init_empty, init_node
+from .induce_map import (MapInducerState, induce_next_map, init_node_map)
 from .negative import random_negative_sample, sort_csr_segments
 from .neighbor import (build_row_cumsum, edge_in_csr, uniform_sample,
                        uniform_sample_local, weighted_sample)

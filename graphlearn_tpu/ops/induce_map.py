@@ -1,0 +1,115 @@
+"""Direct-address (map-based) inducer: dedup/relabel without sorts.
+
+The sort-based inducer (ops/induce.py) pays O(cap log cap) XLA sorts per
+hop — the dominant cost of a multi-hop sample at products scale. This
+variant is the TPU answer to the reference's GPU open-addressing hash table
+(/root/reference/graphlearn_torch/include/hash_table.cuh): a dense [N]
+table mapping global node id -> local index + 1 (0 = absent). All steps are
+gathers, scatters and one cumsum over the hop block — no sorts:
+
+  1. winner pick: scatter position ids into the table slot; the stored
+     winner dedups duplicates within the hop (any winner is correct, like
+     the reference's atomicCAS first-writer-wins, hash_table.cuh:43-64).
+  2. membership: one gather against the table.
+  3. new-node ranks: cumsum over the hop block.
+  4. state update: scatter new local indices into the table and new ids
+     into the node list.
+
+Cost scales with num_nodes only through the one-time table allocation
+(int32[N] = 4 bytes/node; 1M nodes = 4MB HBM). For billion-node graphs use
+the sort-based inducer or shard the table (the distributed sampler's
+partitions each hold a shard-sized table).
+"""
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .unique import FILL
+
+
+class MapInducerState(NamedTuple):
+  table: jax.Array      # [N] global id -> local index + 1 (0 = absent)
+  nodes: jax.Array      # [cap] global ids, FILL-padded; pos == local idx
+  num_nodes: jax.Array  # scalar int32
+
+
+@functools.partial(jax.jit, static_argnames=('capacity', 'num_graph_nodes'))
+def init_node_map(seeds: jax.Array, seed_mask: jax.Array, capacity: int,
+                  num_graph_nodes: int):
+  """Start a batch: dedup seeds into local indices (seeds first).
+
+  Returns (state, uniq_seeds [B], uniq_mask [B], inverse [B]); unlike the
+  sort-based init_node, uniq_seeds keeps FIRST-OCCURRENCE order rather
+  than ascending order (both satisfy the contract: position == local idx).
+  """
+  b = seeds.shape[0]
+  table = jnp.zeros((num_graph_nodes,), jnp.int32)
+  safe = jnp.where(seed_mask, seeds, 0)
+  pos = jnp.arange(b, dtype=jnp.int32)
+  # winner: plain set-scatter; among duplicates exactly one position's
+  # write survives and `probe[id] == pos` selects it (any winner is
+  # correct — same contract as the reference's atomicCAS first-writer.
+  # set-scatter measures ~4x faster than min-scatter on TPU).
+  probe = jnp.full((num_graph_nodes,), b, jnp.int32)
+  probe = probe.at[jnp.where(seed_mask, safe, num_graph_nodes)].set(
+      pos, mode='drop')
+  winner = seed_mask & (probe[safe] == pos)
+  rank = (jnp.cumsum(winner) - 1).astype(jnp.int32)
+  count = jnp.sum(winner).astype(jnp.int32)
+  nodes = jnp.full((capacity,), FILL, seeds.dtype)
+  nodes = nodes.at[jnp.where(winner, rank, capacity)].set(seeds,
+                                                          mode='drop')
+  table = table.at[jnp.where(winner, safe, num_graph_nodes)].set(
+      rank + 1, mode='drop')
+  uniq = nodes[:b]
+  uniq_mask = jnp.arange(b) < count
+  inverse = jnp.where(seed_mask, table[safe] - 1, -1)
+  return MapInducerState(table, nodes, count), uniq, uniq_mask, inverse
+
+
+@jax.jit
+def induce_next_map(state: MapInducerState, src_idx: jax.Array,
+                    nbrs: jax.Array, nbr_mask: jax.Array):
+  """Absorb one hop (same contract as ops.induce.induce_next)."""
+  f, k = nbrs.shape
+  size = f * k
+  n_table = state.table.shape[0]
+  flat = nbrs.reshape(-1)
+  flat_mask = nbr_mask.reshape(-1)
+  safe = jnp.where(flat_mask, flat, 0)
+
+  existing = state.table[safe]                     # local idx + 1, 0 absent
+  is_new_id = flat_mask & (existing == 0)
+  # one winner among duplicates of each new id via set-scatter (see
+  # init_node_map note)
+  pos = jnp.arange(size, dtype=jnp.int32)
+  probe = jnp.full((n_table,), size, jnp.int32)
+  probe = probe.at[jnp.where(is_new_id, safe, n_table)].set(pos,
+                                                            mode='drop')
+  winner = is_new_id & (probe[safe] == pos)
+  rank = (jnp.cumsum(winner) - 1).astype(jnp.int32)
+  num_new = jnp.sum(winner).astype(jnp.int32)
+  new_idx = state.num_nodes + rank
+
+  nodes = state.nodes.at[jnp.where(winner, new_idx,
+                                   state.nodes.shape[0])].set(flat,
+                                                              mode='drop')
+  table = state.table.at[jnp.where(winner, safe, n_table)].set(
+      new_idx + 1, mode='drop')
+
+  local = jnp.where(flat_mask, table[safe] - 1, -1)
+  rows = jnp.where(flat_mask, jnp.repeat(src_idx.astype(jnp.int32), k), -1)
+
+  slot = jnp.where(winner, rank, size)
+  frontier = jnp.full((size,), FILL, flat.dtype).at[slot].set(flat,
+                                                              mode='drop')
+  frontier_idx = jnp.full((size,), -1, jnp.int32).at[slot].set(new_idx,
+                                                               mode='drop')
+  frontier_mask = jnp.arange(size) < num_new
+
+  out = dict(rows=rows, cols=local, edge_mask=flat_mask,
+             frontier=frontier, frontier_idx=frontier_idx,
+             frontier_mask=frontier_mask, num_new=num_new)
+  return MapInducerState(table, nodes, state.num_nodes + num_new), out
