@@ -332,14 +332,28 @@ class NeighborSampler(BaseSampler):
     """
     import jax
     import jax.numpy as jnp
-    seeds = np.asarray(inputs.node).reshape(-1)
-    ntype = inputs.input_type
-    assert ntype is not None, 'hetero sampling requires input_type'
-    n = seeds.shape[0]
-    cap = batch_cap or _round_up(n)
-    padded = np.zeros((cap,), np.int32)
-    padded[:n] = seeds
-    smask = np.arange(cap) < n
+    if isinstance(inputs, dict):
+      # multi-type seeds (link sampling): {ntype: seed array}
+      seeds_dict = {t: np.asarray(v).reshape(-1)
+                    for t, v in inputs.items()}
+      ntype = next(iter(seeds_dict))
+    else:
+      ntype = inputs.input_type
+      assert ntype is not None, 'hetero sampling requires input_type'
+      seeds_dict = {ntype: np.asarray(inputs.node).reshape(-1)}
+    caps_in, padded_d, smask_d = {}, {}, {}
+    for t, s in seeds_dict.items():
+      n_t = s.shape[0]
+      c = (batch_cap if batch_cap and len(seeds_dict) == 1
+           else _round_up(n_t))
+      caps_in[t] = c
+      buf = np.zeros((c,), np.int32)
+      buf[:n_t] = s
+      padded_d[t] = buf
+      smask_d[t] = np.arange(c) < n_t
+    n = seeds_dict[ntype].shape[0]
+    cap = caps_in[ntype]
+    padded, smask = padded_d[ntype], smask_d[ntype]
 
     etypes = list(self.graph.keys())
     num_hops = max(len(self._etype_fanouts(et)) for et in etypes)
@@ -348,7 +362,7 @@ class NeighborSampler(BaseSampler):
     ntypes = set()
     for (u, _, v) in etypes:
       ntypes.update((u, v))
-    frontier_cap = {t: (cap if t == ntype else 0) for t in ntypes}
+    frontier_cap = {t: caps_in.get(t, 0) for t in ntypes}
     node_caps = dict(frontier_cap)
     hop_caps = []  # per hop: dict et -> (src frontier cap, k)
     for hop in range(num_hops):
@@ -381,12 +395,17 @@ class NeighborSampler(BaseSampler):
     nodes_per_hop: Dict[NodeType, list] = {t: [] for t in ntypes}
     edges_per_hop: Dict[EdgeType, list] = {}
 
-    st, uniq, umask, inv = ops.init_node(
-        jnp.asarray(padded), jnp.asarray(smask), capacity=node_caps[ntype])
-    states[ntype] = st
-    frontier[ntype] = (uniq, jnp.arange(cap, dtype=jnp.int32), umask)
+    inv_d = {}
+    for t in seeds_dict:
+      st, uniq, umask, inv_t = ops.init_node(
+          jnp.asarray(padded_d[t]), jnp.asarray(smask_d[t]),
+          capacity=node_caps[t])
+      states[t] = st
+      frontier[t] = (uniq, jnp.arange(caps_in[t], dtype=jnp.int32), umask)
+      inv_d[t] = inv_t
+    inv = inv_d[ntype]
     for t in ntypes:
-      nodes_per_hop[t].append(st.num_nodes if t == ntype
+      nodes_per_hop[t].append(states[t].num_nodes if t in states
                               else jnp.asarray(0, jnp.int32))
 
     for hop in range(num_hops):
@@ -435,21 +454,22 @@ class NeighborSampler(BaseSampler):
         edge=({et: jnp.concatenate(v) for et, v in edges.items()}
               if with_edge else None),
         edge_mask={et: jnp.concatenate(v) for et, v in emasks.items()},
-        batch={ntype: jnp.asarray(padded)}, batch_size=n,
+        batch={t: jnp.asarray(padded_d[t]) for t in seeds_dict},
+        batch_size=n,
         num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
         input_type=ntype,
-        metadata={'seed_inverse': inv, 'seed_mask': smask})
+        metadata={'seed_inverse': inv, 'seed_inverse_dict': inv_d,
+                  'seed_mask': smask})
     return out
 
   # ------------------------------------------------------------- link path
 
   def sample_from_edges(self, inputs: EdgeSamplerInput, **kwargs):
     """Link sampling: negatives + seed union + node sampling + metadata
-    (reference: neighbor_sampler.py:301-428). Homo only for now; hetero link
-    sampling lands with the link loader."""
+    (reference: neighbor_sampler.py:301-428)."""
     import jax.numpy as jnp
     if self.is_hetero:
-      raise NotImplementedError('hetero sample_from_edges: use link loader')
+      return self._hetero_sample_from_edges(inputs, **kwargs)
     rows = np.asarray(inputs.row).reshape(-1)
     cols = np.asarray(inputs.col).reshape(-1)
     b = rows.shape[0]
@@ -500,6 +520,89 @@ class NeighborSampler(BaseSampler):
       md = dict(src_index=inv[:b], dst_pos_index=inv[b:2 * b],
                 dst_neg_index=inv[2 * b:2 * b + num_neg])
     out.metadata.update(md)
+    out.batch_size = b
+    return out
+
+  def _hetero_sample_from_edges(self, inputs: EdgeSamplerInput,
+                                num_dst_nodes: Optional[int] = None,
+                                **kwargs):
+    """Hetero link sampling (reference: neighbor_sampler.py:301-428 hetero
+    branch): typed seed edges (src_t, rel, dst_t); negatives are drawn
+    against the seed edge type's CSR; src/dst seed sets go into their
+    node-type frontiers and metadata indices reference each type's local
+    node buffers."""
+    import jax.numpy as jnp
+    etype = inputs.input_type
+    assert etype is not None, 'hetero link sampling requires input_type'
+    src_t, _, dst_t = etype
+    rows = np.asarray(inputs.row).reshape(-1)
+    cols = np.asarray(inputs.col).reshape(-1)
+    b = rows.shape[0]
+    neg = inputs.neg_sampling
+    g = self._get_graph(etype)
+    # id ranges: key-type rows come from indptr length, other side from the
+    # neighbor ids present (caller may pass num_dst_nodes for exactness)
+    num_key = int(np.asarray(g.indptr).shape[0]) - 1
+    num_other = num_dst_nodes or int(np.asarray(g.indices).max()) + 1
+
+    neg_rows = neg_cols = None
+    if neg is not None:
+      num_neg = neg.num_negatives(b)
+      sorted_idx, _ = ops.sort_csr_segments(np.asarray(g.indptr),
+                                            np.asarray(g.indices))
+      nr, nc, _ = ops.random_negative_sample(
+          g.indptr, jnp.asarray(sorted_idx), num_key, num_other, num_neg,
+          self._next_key(), padding=True)
+      neg_rows, neg_cols = np.asarray(nr), np.asarray(nc)
+      if self.edge_dir == 'in':
+        neg_rows, neg_cols = neg_cols, neg_rows
+
+    # typed seed sets with positional bookkeeping
+    if neg is None:
+      src_seeds, dst_seeds = rows, cols
+    elif neg.is_binary():
+      src_seeds = np.concatenate([rows, neg_rows])
+      dst_seeds = np.concatenate([cols, neg_cols])
+    else:  # triplet: negatives are dst candidates
+      src_seeds = rows
+      dst_seeds = np.concatenate([cols, neg_cols])
+
+    if src_t == dst_t:
+      seeds = {src_t: np.concatenate([src_seeds, dst_seeds])}
+      off = src_seeds.shape[0]
+    else:
+      seeds = {src_t: src_seeds, dst_t: dst_seeds}
+      off = 0
+
+    out = self._hetero_sample_from_nodes(seeds)
+    inv_d = out.metadata['seed_inverse_dict']
+    if src_t == dst_t:
+      inv_src = jnp.asarray(inv_d[src_t])[:src_seeds.shape[0]]
+      inv_dst = jnp.asarray(inv_d[src_t])[off:off + dst_seeds.shape[0]]
+    else:
+      inv_src = jnp.asarray(inv_d[src_t])[:src_seeds.shape[0]]
+      inv_dst = jnp.asarray(inv_d[dst_t])[:dst_seeds.shape[0]]
+
+    if neg is None:
+      md = dict(edge_label_index=jnp.stack([inv_src[:b], inv_dst[:b]]),
+                edge_label=(jnp.asarray(inputs.label)
+                            if inputs.label is not None
+                            else jnp.ones((b,), jnp.int32)))
+    elif neg.is_binary():
+      num_neg = neg_rows.shape[0]
+      src = jnp.concatenate([inv_src[:b], inv_src[b:b + num_neg]])
+      dst = jnp.concatenate([inv_dst[:b], inv_dst[b:b + num_neg]])
+      pos_label = (jnp.asarray(inputs.label) if inputs.label is not None
+                   else jnp.ones((b,), jnp.int32))
+      label = jnp.concatenate([pos_label,
+                               jnp.zeros((num_neg,), pos_label.dtype)])
+      md = dict(edge_label_index=jnp.stack([src, dst]), edge_label=label)
+    else:
+      num_neg = neg_cols.shape[0]
+      md = dict(src_index=inv_src[:b], dst_pos_index=inv_dst[:b],
+                dst_neg_index=inv_dst[b:b + num_neg])
+    out.metadata.update(md)
+    out.input_type = etype
     out.batch_size = b
     return out
 

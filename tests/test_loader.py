@@ -86,3 +86,56 @@ def test_to_pyg_bridge():
   pyg = b.to_pyg()
   assert pyg.edge_index.shape[0] == 2
   assert pyg.batch_size == 4
+
+
+def make_hetero_dataset():
+  ub = np.array([[0, 0, 1, 2, 2, 3], [0, 1, 2, 3, 0, 1]])
+  bu = ub[::-1].copy()
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph({('user', 'buys', 'item'): ub,
+                 ('item', 'rev_buys', 'user'): bu},
+                graph_mode='CPU',
+                num_nodes={('user', 'buys', 'item'): 4,
+                           ('item', 'rev_buys', 'user'): 4})
+  ds.init_node_features({'user': np.eye(4, dtype=np.float32),
+                         'item': np.eye(4, dtype=np.float32) * 2})
+  return ds, ub
+
+
+def test_hetero_link_neighbor_loader_binary():
+  ds, ub = make_hetero_dataset()
+  loader = glt.loader.LinkNeighborLoader(
+      ds, [2, 2], (('user', 'buys', 'item'), ub),
+      neg_sampling=glt.sampler.NegativeSampling('binary', 1),
+      batch_size=3, seed=0)
+  batches = list(loader)
+  assert len(batches) == 2
+  b = batches[0]
+  eli = np.asarray(b.metadata['edge_label_index'])
+  label = np.asarray(b.metadata['edge_label'])
+  assert eli.shape == (2, 6) and label.shape == (6,)
+  assert label[:3].sum() == 3 and label[3:].sum() == 0
+  pos = {(int(r), int(c)) for r, c in zip(ub[0], ub[1])}
+  user_nodes = np.asarray(b.node['user'])
+  item_nodes = np.asarray(b.node['item'])
+  for j in range(3):  # positives decode to real edges
+    u = int(user_nodes[eli[0, j]])
+    i = int(item_nodes[eli[1, j]])
+    assert (u, i) in pos
+  # features collected per type
+  assert b.x['user'].shape[1] == 4
+
+
+def test_hetero_link_neighbor_loader_triplet():
+  ds, ub = make_hetero_dataset()
+  loader = glt.loader.LinkNeighborLoader(
+      ds, [2], (('user', 'buys', 'item'), ub),
+      neg_sampling=glt.sampler.NegativeSampling('triplet', 2),
+      batch_size=3, seed=1)
+  b = next(iter(loader))
+  assert np.asarray(b.metadata['src_index']).shape == (3,)
+  assert np.asarray(b.metadata['dst_pos_index']).shape == (3,)
+  assert np.asarray(b.metadata['dst_neg_index']).shape == (6,)
+  user_nodes = np.asarray(b.node['user'])
+  src = user_nodes[np.asarray(b.metadata['src_index'])]
+  np.testing.assert_array_equal(src, ub[0][:3])
