@@ -1,0 +1,42 @@
+"""Vineyard (GraphScope object store) adapters — gated.
+
+Port surface of
+/root/reference/graphlearn_torch/python/data/vineyard_utils.py (backed by
+csrc/cpu/vineyard_utils.cc): load CSR topology and vertex/edge features
+from a vineyard socket. Vineyard is not available in this environment, so
+these raise a clear ImportError on use; the function signatures match the
+reference so callers can be ported unchanged.
+"""
+
+
+def _require_vineyard():
+  try:
+    import vineyard  # noqa: F401
+  except ImportError as e:
+    raise ImportError(
+        'vineyard is not installed; vineyard adapters load GraphScope '
+        'fragments (reference vineyard_utils.cc) and need the vineyard '
+        'runtime') from e
+
+
+def vineyard_to_csr(sock: str, object_id: str, v_label: int, e_label: int,
+                    edge_dir: str = 'out'):
+  """Reference: ToCSR (csrc/cpu/vineyard_utils.cc:32)."""
+  _require_vineyard()
+  raise NotImplementedError(
+      'vineyard fragment -> CSR: implement against the GraphScope '
+      'fragment API when vineyard is present')
+
+
+def load_vertex_feature_from_vineyard(sock: str, object_id: str,
+                                      cols, v_label: int):
+  """Reference: LoadVertexFeatures (vineyard_utils.cc:130)."""
+  _require_vineyard()
+  raise NotImplementedError
+
+
+def load_edge_feature_from_vineyard(sock: str, object_id: str,
+                                    cols, e_label: int):
+  """Reference: LoadEdgeFeatures (vineyard_utils.cc:189)."""
+  _require_vineyard()
+  raise NotImplementedError

@@ -614,6 +614,37 @@ class NeighborSampler(BaseSampler):
   def __hash__(self):
     return id(self)
 
+  def sample_pyg_v1(self, seeds, batch_cap: Optional[int] = None):
+    """PyG-v1 style sampling: (batch_size, n_id, adjs)
+    (reference: neighbor_sampler.py:430-454).
+
+    adjs is per-layer [(edge_index [2, cap_e_i], edge_mask, e_id, size)]
+    in REVERSE hop order (deepest hop first), the layout SAGE-style models
+    consume layer by layer. Arrays stay padded.
+    """
+    import jax.numpy as jnp
+    seeds = np.asarray(seeds).reshape(-1)
+    out = self.sample_from_nodes(NodeSamplerInput(seeds),
+                                 batch_cap=batch_cap)
+    cap = out.batch.shape[0]
+    fanouts = list(self.num_neighbors)
+    caps = self._homo_capacities(cap, fanouts)
+    adjs = []
+    offset = 0
+    nodes_so_far = caps[0]
+    for i, k in enumerate(fanouts):
+      seg = caps[i] * k
+      ei = jnp.stack([out.row[offset:offset + seg],
+                      out.col[offset:offset + seg]])
+      em = out.edge_mask[offset:offset + seg]
+      eid = (out.edge[offset:offset + seg] if out.edge is not None
+             else None)
+      nodes_so_far += caps[i + 1]
+      size = (nodes_so_far, caps[i])
+      adjs.append((ei, em, eid, size))
+      offset += seg
+    return out.batch_size, out.node, list(reversed(adjs))
+
   # --------------------------------------------------------------- subgraph
 
   def subgraph(self, inputs: NodeSamplerInput,

@@ -1,5 +1,8 @@
 from .common import count_dict, get_free_port, merge_dict
+from .device import ensure_device, get_available_device
+from .exit_status import python_exit_status
 from .mixin import CastMixin
+from .singleton import Singleton
 from .tensor import convert_to_array, id2idx, squeeze_dict
 from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, csr_to_csc, ind2ptr,
                    ptr2ind)

@@ -1,0 +1,25 @@
+"""Device assignment helpers.
+
+TPU-native port of /root/reference/graphlearn_torch/python/utils/device.py:
+the reference rotates sampling workers across CUDA devices; here devices are
+jax devices and the default policy is round-robin over local chips.
+"""
+from typing import Optional, Sequence
+
+
+def get_available_device(index: int = 0, devices: Optional[Sequence] = None):
+  """Round-robin device pick (reference: device.py:22-40)."""
+  import jax
+  devs = list(devices) if devices is not None else jax.local_devices()
+  if not devs:
+    return None
+  return devs[index % len(devs)]
+
+
+def ensure_device(device=None):
+  """Default device when none given (reference: device.py:42-54)."""
+  import jax
+  if device is not None:
+    return device
+  devs = jax.local_devices()
+  return devs[0] if devs else None

@@ -1,0 +1,21 @@
+"""Interpreter-exit guard for __del__-time cleanup.
+
+Port of /root/reference/graphlearn_torch/python/utils/exit_status.py:19-33:
+destructors that talk to channels/processes must not run during interpreter
+teardown.
+"""
+import atexit
+
+_python_exit_status = False
+
+
+def _set_python_exit():
+  global _python_exit_status
+  _python_exit_status = True
+
+
+atexit.register(_set_python_exit)
+
+
+def python_exit_status() -> bool:
+  return _python_exit_status

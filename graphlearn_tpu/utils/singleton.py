@@ -1,0 +1,15 @@
+"""Singleton metaclass (port of
+/root/reference/graphlearn_torch/python/utils/singleton.py)."""
+import threading
+
+
+class Singleton(type):
+  _instances = {}
+  _lock = threading.Lock()
+
+  def __call__(cls, *args, **kwargs):
+    if cls not in cls._instances:
+      with cls._lock:
+        if cls not in cls._instances:
+          cls._instances[cls] = super().__call__(*args, **kwargs)
+    return cls._instances[cls]
