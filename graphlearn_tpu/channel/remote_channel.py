@@ -33,37 +33,63 @@ class RemoteReceivingChannel(ChannelBase):
     self._queue: queue.Queue = queue.Queue()
     self._threads: List[threading.Thread] = []
     self._stopped = threading.Event()
-    self._pending_end = 0
     self._lock = threading.Lock()
     self._started = False
 
-  def _puller(self, rank: int, pid: int):
-    """One puller thread per (server, prefetch slot)."""
-    while not self._stopped.is_set():
-      try:
-        msg, end = self._request_fn(rank, pid)
-      except Exception as e:  # noqa: BLE001 - surfaced to the consumer
-        self._queue.put(('error', repr(e)))
-        return
-      if msg is not None:
-        self._queue.put(('msg', msg))
-      if end:
-        self._queue.put(('end', rank))
-        return
+  def _puller(self, rank: int, pid: int, q: queue.Queue, active: dict,
+              stopped: threading.Event):
+    """One puller thread per (producer, prefetch slot).
+
+    End-of-epoch ordering: with prefetch_size > 1 several pullers fetch the
+    same producer concurrently, so the thread that receives the (None, end)
+    response may finish while a sibling still has an earlier message in
+    flight. The producer's 'end' marker is therefore only enqueued by the
+    LAST puller of that producer to exit — every sibling has enqueued its
+    final message before then, so no batch can be dropped behind the
+    marker.
+
+    ``q``/``active``/``stopped`` are THIS epoch's objects, passed in rather
+    than read from self: a puller that outlives its epoch (consumer
+    abandoned it mid-stream, then start() began a new one) keeps writing to
+    its own epoch's dead queue and can never poison a later epoch's state.
+    """
+    try:
+      while not stopped.is_set():
+        try:
+          msg, end = self._request_fn(rank, pid)
+        except Exception as e:  # noqa: BLE001 - surfaced to the consumer
+          q.put(('error', repr(e)))
+          return
+        if msg is not None:
+          q.put(('msg', msg))
+        if end:
+          return
+    finally:
+      with self._lock:
+        active[(rank, pid)] -= 1
+        last = active[(rank, pid)] == 0
+      if last:
+        q.put(('end', (rank, pid)))
 
   def start(self):
     """Begin one epoch of pulling (idempotent per epoch)."""
-    self._stopped.clear()
+    # Retire any previous epoch: signal its pullers, then rebind fresh
+    # per-epoch objects (old threads hold references to the retired ones).
+    self._stopped.set()
+    self._stopped = threading.Event()
+    self._queue = queue.Queue()
     with self._lock:
-      self._pending_end = 0
       self._threads = []
+      active = {}
       for rank, pid in zip(self.server_ranks, self.producer_ids):
-        self._pending_end += 1
+        active[(rank, pid)] = self.prefetch_size
         for _ in range(self.prefetch_size):
-          t = threading.Thread(target=self._puller, args=(rank, pid),
-                               daemon=True)
+          t = threading.Thread(
+              target=self._puller,
+              args=(rank, pid, self._queue, active, self._stopped),
+              daemon=True)
           self._threads.append(t)
-      # only one end-marker per server must count: track per server below
+      # one end-marker per (server, producer) pair ends the epoch
       self._ends_seen = set()
       for t in self._threads:
         t.start()
@@ -82,10 +108,11 @@ class RemoteReceivingChannel(ChannelBase):
         return payload
       if kind == 'error':
         raise RuntimeError(f'remote fetch failed: {payload}')
-      # end marker for one server
+      # end marker for one (server, producer) pair
       with self._lock:
         self._ends_seen.add(payload)
-        if len(self._ends_seen) >= len(set(self.server_ranks)):
+        n_pairs = len(set(zip(self.server_ranks, self.producer_ids)))
+        if len(self._ends_seen) >= n_pairs:
           self._started = False
           raise StopIteration('epoch complete')
 
