@@ -72,10 +72,16 @@ class RemoteReceivingChannel(ChannelBase):
         q.put(('end', (rank, pid)))
 
   def start(self):
-    """Begin one epoch of pulling (idempotent per epoch)."""
-    # Retire any previous epoch: signal its pullers, then rebind fresh
-    # per-epoch objects (old threads hold references to the retired ones).
-    self._stopped.set()
+    """Begin one epoch of pulling.
+
+    Any previous epoch's pullers are stopped AND joined first: a stale
+    puller that survived into the new epoch would consume new-epoch
+    messages into its retired queue (the server counts them toward
+    expected, so the new epoch would silently come up short). Callers
+    restarting server producers must do so AFTER the old pullers are dead
+    — see RemoteDistNeighborLoader.__iter__ ordering.
+    """
+    self.stop(join=True)
     self._stopped = threading.Event()
     self._queue = queue.Queue()
     with self._lock:
@@ -119,5 +125,12 @@ class RemoteReceivingChannel(ChannelBase):
   def empty(self) -> bool:
     return self._queue.empty()
 
-  def stop(self):
+  def stop(self, join: bool = False, timeout: float = 30.0):
+    """Signal pullers to wind down; with ``join`` wait for them to exit
+    (each finishes at most one in-flight request)."""
     self._stopped.set()
+    if join:
+      for t in self._threads:
+        t.join(timeout=timeout)
+      self._threads = []
+    self._started = False

@@ -9,6 +9,7 @@ H2D path is jax.device_put from the deserialized views, so there is no
 cudaHostRegister equivalent to apply.
 """
 import ctypes
+import threading
 from typing import Optional
 
 from .base import (ChannelBase, QueueTimeoutError, SampleMessage,
@@ -34,6 +35,12 @@ class ShmChannel(ChannelBase):
       self._q = self._lib.shmq_create(self.shm_size)
       if not self._q:
         raise RuntimeError('shmq_create failed')
+    # recv is a peek(size)-then-dequeue pair in two native critical
+    # sections; concurrent recv callers in one process (e.g. DistServer
+    # handlers on a ThreadingTCPServer) could interleave them and size the
+    # dequeue buffer for a different block. Serialize the pair per process
+    # (each process re-attaching via __reduce__ builds its own lock).
+    self._recv_lock = threading.Lock()
 
   @property
   def shmid(self) -> int:
@@ -48,18 +55,19 @@ class ShmChannel(ChannelBase):
           f'{self.shm_size}')
 
   def recv(self, timeout_ms: int = -1) -> SampleMessage:
-    size = self._lib.shmq_next_size(self._q, timeout_ms)
-    if size == -1:
-      raise QueueTimeoutError('shm channel recv timeout')
-    if size == -2:
-      raise StopIteration('channel finished')
-    buf = ctypes.create_string_buffer(size)
-    got = self._lib.shmq_dequeue(self._q, buf, size, timeout_ms)
-    if got == -1:
-      raise QueueTimeoutError('shm channel recv timeout')
-    if got == -2:
-      raise StopIteration('channel finished')
-    assert got == size, (got, size)
+    with self._recv_lock:
+      size = self._lib.shmq_next_size(self._q, timeout_ms)
+      if size == -1:
+        raise QueueTimeoutError('shm channel recv timeout')
+      if size == -2:
+        raise StopIteration('channel finished')
+      buf = ctypes.create_string_buffer(size)
+      got = self._lib.shmq_dequeue(self._q, buf, size, timeout_ms)
+      if got == -1:
+        raise QueueTimeoutError('shm channel recv timeout')
+      if got == -2:
+        raise StopIteration('channel finished')
+      assert got == size, (got, size)
     return deserialize_message(bytes(buf))
 
   def empty(self) -> bool:

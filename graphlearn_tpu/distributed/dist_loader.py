@@ -50,11 +50,22 @@ class DistLoader:
     n_steps = len(self)
     for s in range(n_steps):
       idx = order[s * g:(s + 1) * g]
-      if idx.shape[0] < g:  # pad the final global batch (repeat seeds)
-        idx = np.concatenate([idx, order[:g - idx.shape[0]]])
+      n_valid = idx.shape[0]
+      mask = None
+      if n_valid < g:
+        # pad the final global batch by repeating seeds (cyclically, so it
+        # works even when fewer total seeds than one global batch), but
+        # carry a validity mask: pad seeds produce no nodes/edges in the
+        # sampler and consumers can exclude them (no silent
+        # double-counting; reference emits a short batch instead,
+        # dist_loader.py:284-295)
+        idx = np.concatenate([idx, np.resize(order, g - n_valid)])
+        mask = (np.arange(g) < n_valid).reshape(self.num_partitions,
+                                                self.batch_size)
       seeds = self.input_seeds[idx].reshape(self.num_partitions,
                                             self.batch_size)
-      out = self.sampler.sample_from_nodes(NodeSamplerInput(seeds))
+      out = self.sampler.sample_from_nodes(NodeSamplerInput(seeds),
+                                           seed_mask=mask)
       yield self._collate_fn(out)
 
   def _collate_fn(self, out) -> Data:
@@ -160,9 +171,10 @@ class RemoteDistNeighborLoader:
           opts.num_workers if opts else 1,
           worker_key=(opts.worker_key if opts else None))
       self.producer_ids.append(pid)
-      n = part.shape[0]
-      self._expected += (n // batch_size if drop_last
-                         else -(-n // batch_size))
+      # the producer's own count: its mp workers split the seed share and
+      # each rounds up, so ceil(n/batch_size) would undercount here
+      self._expected += dist_client.request_server(
+          rank, 'producer_num_expected', pid)
     self.channel = RemoteReceivingChannel(
         self.server_ranks, self.producer_ids,
         prefetch_size=(opts.prefetch_size if opts else 4))
@@ -172,6 +184,11 @@ class RemoteDistNeighborLoader:
     return self._expected
 
   def __iter__(self):
+    # Ordering matters: kill any previous epoch's pullers BEFORE
+    # restarting the server producers (a stale puller would consume
+    # new-epoch messages into its dead queue), and only then start the
+    # new pullers.
+    self.channel.stop(join=True)
     for rank, pid in zip(self.server_ranks, self.producer_ids):
       self._dist_client.request_server(rank, 'start_new_epoch_sampling',
                                        pid)

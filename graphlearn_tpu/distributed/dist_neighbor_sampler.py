@@ -174,12 +174,15 @@ class DistNeighborSampler:
 
   # ------------------------------------------------------------ public API
 
-  def sample_from_nodes(self, inputs, **kwargs) -> SamplerOutput:
+  def sample_from_nodes(self, inputs, seed_mask=None,
+                        **kwargs) -> SamplerOutput:
     """Sample per-shard batches: seeds [P, B] (or [P*B] flat, split evenly).
 
     Returns a SamplerOutput whose arrays carry a leading partition axis
     [P, ...] — shard p is the batch built from seed block p, ready to feed
-    a data-parallel train step on the same mesh.
+    a data-parallel train step on the same mesh. ``seed_mask`` (same shape
+    as seeds) marks padding seeds False — they produce no nodes/edges and
+    are excluded from num_nodes (used by DistLoader's final short batch).
     """
     import jax.numpy as jnp
     seeds = np.asarray(inputs.node if isinstance(inputs, NodeSamplerInput)
@@ -189,7 +192,8 @@ class DistNeighborSampler:
       assert seeds.shape[0] % p == 0, 'flat seeds must split evenly'
       seeds = seeds.reshape(p, -1)
     b = seeds.shape[1]
-    smask = np.ones_like(seeds, bool)
+    smask = (np.ones_like(seeds, bool) if seed_mask is None
+             else np.asarray(seed_mask).reshape(seeds.shape))
     if b not in self._fns:
       self._fns[b] = self._build_fn(b)
     res = self._fns[b](jnp.asarray(seeds, jnp.int32), jnp.asarray(smask),
@@ -200,7 +204,8 @@ class DistNeighborSampler:
         batch=jnp.asarray(seeds), batch_size=b,
         num_sampled_nodes=res['num_sampled_nodes'],
         num_sampled_edges=res['num_sampled_edges'],
-        metadata={'seed_inverse': res['seed_inverse']})
+        metadata={'seed_inverse': res['seed_inverse'],
+                  'seed_mask': jnp.asarray(smask)})
 
   def collate(self, out: SamplerOutput, node_labels=None):
     """Attach features (sharded all_to_all gather) and labels.

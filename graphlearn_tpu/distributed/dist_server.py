@@ -57,10 +57,36 @@ class DistServer:
         self._worker_key_to_id[worker_key] = pid
       return pid
 
-  def start_new_epoch_sampling(self, producer_id: int):
+  def producer_num_expected(self, producer_id: int) -> int:
+    """Exact number of batches this producer emits per epoch (its mp
+    workers each round their seed share up, so the client cannot derive
+    this from ceil(n/batch_size) — see DistMpSamplingProducer
+    .num_expected)."""
     with self._lock:
+      return self._expected[producer_id]
+
+  def start_new_epoch_sampling(self, producer_id: int):
+    buf = self._buffers[producer_id]
+    producer = self._producers[producer_id]
+    with self._lock:
+      # Drain messages left over from an abandoned previous epoch so they
+      # are not served as (and counted against) the new epoch's batches.
+      # A still-producing abandoned epoch keeps writing until its seeds
+      # are exhausted; wait it out first (bounded by production time).
+      if 0 < self._received.get(producer_id, 0) < \
+          self._expected.get(producer_id, 0):
+        deadline = time.time() + 120.0
+        while not producer.is_all_sampling_completed():
+          if time.time() > deadline:
+            break
+          time.sleep(0.05)
+      while not buf.empty():
+        try:
+          buf.recv(timeout_ms=10)
+        except (QueueTimeoutError, StopIteration):
+          break
       self._received[producer_id] = 0
-    self._producers[producer_id].produce_all()
+    producer.produce_all()
 
   def fetch_one_sampled_message(self, producer_id: int,
                                 timeout_ms: int = 500
@@ -135,6 +161,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
   s = _server
   _rpc_server.register('create_sampling_producer',
                        s.create_sampling_producer)
+  _rpc_server.register('producer_num_expected', s.producer_num_expected)
   _rpc_server.register('start_new_epoch_sampling',
                        s.start_new_epoch_sampling)
   _rpc_server.register('fetch_one_sampled_message',

@@ -13,6 +13,13 @@ zero-copy buffers).
 API parity: rpc_register / rpc_request_async / rpc_request_sync /
 RpcCalleeBase (reference rpc.py:371-473), barrier/all_gather
 (rpc.py:109-233).
+
+TRUST MODEL: frames are deserialized with pickle, so anyone who can
+connect can execute arbitrary code — identical to the reference's
+torch-RPC posture (TensorPipe performs no authentication either). Deploy
+only on trusted, isolated cluster networks. The default bind is loopback;
+when passing a routable ``master_addr``, the network boundary (VPC /
+firewall / pod network policy) IS the security boundary.
 """
 import pickle
 import socket

@@ -53,7 +53,7 @@ class NeighborSampler(BaseSampler):
                num_neighbors=None, device=None, with_edge: bool = False,
                with_weight: bool = False, strategy: str = 'random',
                edge_dir: str = 'out', seed: Optional[int] = None,
-               node_budget: Optional[int] = None, fused: bool = False,
+               node_budget: Optional[int] = None, fused: bool = True,
                dedup: str = 'auto'):
     import jax
     self.graph = graph
@@ -64,12 +64,13 @@ class NeighborSampler(BaseSampler):
     self.strategy = strategy
     self.edge_dir = edge_dir
     self.node_budget = node_budget
-    # fused=True compiles the whole multi-hop sample into one XLA program;
-    # fused=False (default) chains the per-op jitted kernels from the host.
-    # On directly-attached TPU the fused program is the right shape, but
-    # through a remote-dispatch runtime (axon tunnel) a single large
-    # program pays per-call costs the chained ops avoid — measured 100x on
-    # this host (see bench notes); both paths produce identical outputs.
+    # fused=True (default) compiles the whole multi-hop sample into one
+    # XLA program — one dispatch per batch, and in-program op fusion. The
+    # chained path (fused=False) dispatches each per-op kernel from the
+    # host; it exists for debugging/bisection. (An earlier version
+    # defaulted to chained because the fused program was slow through the
+    # remote-dispatch runtime; that was the closure-captured-constant
+    # penalty, since fixed — see _build_homo_fn.)
     self.fused = fused
     # dedup strategy: 'map' = direct-address table over node ids (no
     # sorts; 4 bytes/node HBM — the TPU hash-table analog), 'sort' =

@@ -100,3 +100,68 @@ def test_mp_channel():
   assert_msg_equal(msg, ch.recv(timeout_ms=1000))
   with pytest.raises(QueueTimeoutError):
     ch.recv(timeout_ms=100)
+
+
+class _FakeServer:
+  """In-process stand-in for DistServer's fetch contract: one epoch =
+  ``total`` messages then (None, True); restartable."""
+
+  def __init__(self, total):
+    import threading
+    self.total = total
+    self.served = 0
+    self.lock = threading.Lock()
+
+  def fetch(self, rank, pid):
+    with self.lock:
+      i = self.served
+      if i >= self.total:
+        return None, True
+      self.served += 1
+    if i == self.total - 1:
+      time.sleep(0.15)  # straggler: last message delayed past end response
+    return {'i': np.array([i])}, False
+
+  def restart(self):
+    with self.lock:
+      self.served = 0
+
+
+def _drain(ch):
+  got = []
+  while True:
+    try:
+      got.append(int(ch.recv(timeout_ms=5000)['i'][0]))
+    except StopIteration:
+      return got
+
+
+def test_remote_channel_no_straggler_drop():
+  """prefetch>1: the delayed final message must not be lost behind the
+  end marker (ADVICE r1: end enqueued only by the last puller)."""
+  from graphlearn_tpu.channel.remote_channel import RemoteReceivingChannel
+  srv = _FakeServer(7)
+  ch = RemoteReceivingChannel([0], [0], prefetch_size=4,
+                              request_fn=srv.fetch)
+  assert sorted(_drain(ch)) == list(range(7))
+
+
+def test_remote_channel_abandoned_epoch_restart():
+  """Abandoning an epoch mid-stream then starting a new one must not lose
+  or duplicate new-epoch messages (stale pullers joined in start())."""
+  from graphlearn_tpu.channel.remote_channel import RemoteReceivingChannel
+  srv = _FakeServer(6)
+  ch = RemoteReceivingChannel([0], [0], prefetch_size=3,
+                              request_fn=srv.fetch)
+  ch.start()
+  first = int(ch.recv(timeout_ms=5000)['i'][0])  # consume one, abandon
+  assert first in range(6)
+  # the loader's restart protocol: kill stale pullers BEFORE the server
+  # re-primes producers, so they cannot steal new-epoch messages
+  # (RemoteDistNeighborLoader.__iter__ ordering)
+  ch.stop(join=True)
+  srv.restart()
+  ch.start()
+  got = _drain(ch)
+  assert sorted(set(got)) == sorted(got), 'duplicates in epoch'
+  assert len(got) == 6, got
