@@ -1,0 +1,117 @@
+"""Profiling harness: where does the per-batch sampling time go?"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.sampler import NodeSamplerInput
+from graphlearn_tpu import ops
+
+NUM_NODES = 1_000_000
+AVG_DEG = 25
+FANOUT = [15, 10, 5]
+BATCH = 1024
+
+
+def build_graph():
+  rng = np.random.default_rng(0)
+  e = NUM_NODES * AVG_DEG
+  rows = rng.integers(0, NUM_NODES, e)
+  cols = np.empty(e, np.int64)
+  half = e // 2
+  cols[:half] = rng.integers(0, NUM_NODES, half)
+  cols[half:] = rng.zipf(1.5, e - half) % NUM_NODES
+  topo = glt.data.Topology(np.stack([rows, cols]), num_nodes=NUM_NODES)
+  return glt.data.Graph(topo, 'HBM')
+
+
+def timeit(name, fn, iters=30, warmup=3):
+  for _ in range(warmup):
+    r = fn()
+  jax.block_until_ready(r)
+  t0 = time.perf_counter()
+  results = []
+  for _ in range(iters):
+    results.append(fn())
+  jax.block_until_ready(results)
+  dt = (time.perf_counter() - t0) / iters
+  print(f'{name:50s} {dt*1e3:9.3f} ms/iter')
+  return dt
+
+
+def main():
+  graph = build_graph()
+  sampler = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True)
+  rng = np.random.default_rng(1)
+  seeds_np = rng.integers(0, NUM_NODES, BATCH)
+
+  out = sampler.sample_from_nodes(NodeSamplerInput(seeds_np), batch_cap=BATCH)
+  print('edges per batch:', int(out.edge_mask.sum()))
+
+  # full fused program, same seeds each time (device-resident args)
+  fn = sampler._homo_fn(BATCH, tuple(FANOUT))
+  seeds = jnp.asarray(np.asarray(seeds_np, np.int32))
+  mask = jnp.ones((BATCH,), bool)
+  key = jax.random.PRNGKey(7)
+  timeit('fused full 3-hop program', lambda: fn(seeds, mask, key))
+
+  indptr = jnp.asarray(graph.indptr)
+  indices = jnp.asarray(graph.indices)
+
+  # per-hop uniform_sample at each hop's frontier size
+  caps = [BATCH, BATCH * 15, BATCH * 15 * 10]
+  f0 = seeds
+  m0 = mask
+  for i, k in enumerate(FANOUT):
+    b = caps[i]
+    f = jnp.asarray(rng.integers(0, NUM_NODES, b).astype(np.int32))
+    m = jnp.ones((b,), bool)
+    timeit(f'uniform_sample hop{i} [B={b}, K={k}]',
+           lambda f=f, m=m, k=k: ops.uniform_sample(indptr, indices, f, m, k,
+                                                    key))
+
+  # induce_next_map at each hop's size
+  node_cap = BATCH + BATCH * 15 + BATCH * 150 + BATCH * 750
+  state, uniq, umask, inv = ops.init_node_map(seeds, mask,
+                                              capacity=node_cap,
+                                              num_graph_nodes=NUM_NODES)
+  timeit('init_node_map [B=1024]',
+         lambda: ops.init_node_map(seeds, mask, capacity=node_cap,
+                                   num_graph_nodes=NUM_NODES))
+  for i, k in enumerate(FANOUT):
+    b = caps[i]
+    nbrs = jnp.asarray(rng.integers(0, NUM_NODES, (b, k)).astype(np.int32))
+    nm = jnp.ones((b, k), bool)
+    fidx = jnp.arange(b, dtype=jnp.int32)
+    timeit(f'induce_next_map hop{i} [F={b}, K={k}]',
+           lambda nbrs=nbrs, nm=nm, fidx=fidx: ops.induce_next_map(
+               state, fidx, nbrs, nm))
+
+  # raw gather benchmark: how fast is indices[idx] at hop-3 scale?
+  idx = jnp.asarray(rng.integers(0, indices.shape[0], 768000))
+  g = jax.jit(lambda i: indices[i])
+  timeit('raw gather 768k from E=25M', lambda: g(idx))
+  idx2 = jnp.asarray(rng.integers(0, NUM_NODES, 768000))
+  g2 = jax.jit(lambda i: indptr[i])
+  timeit('raw gather 768k from N=1M', lambda: g2(idx2))
+
+  # raw scatter at table scale
+  tbl = jnp.zeros((NUM_NODES,), jnp.int32)
+  vals = jnp.arange(768000, dtype=jnp.int32)
+  sc = jax.jit(lambda t, i, v: t.at[i].set(v, mode='drop'))
+  timeit('raw scatter 768k into N=1M', lambda: sc(tbl, idx2, vals))
+
+  # dispatch overhead: trivial program
+  triv = jax.jit(lambda x: x + 1)
+  x = jnp.zeros((8,))
+  timeit('trivial dispatch x+1', lambda: triv(x), iters=100)
+
+  # cumsum at hop3 size
+  cs = jax.jit(lambda m: jnp.cumsum(m.reshape(-1)))
+  mm = jnp.ones((768000,), jnp.int32)
+  timeit('cumsum 768k', lambda: cs(mm))
+
+
+if __name__ == '__main__':
+  main()
