@@ -44,6 +44,7 @@ def main():
   import jax
   import graphlearn_tpu as glt
   from graphlearn_tpu.sampler import NodeSamplerInput
+  glt.utils.enable_compilation_cache()
 
   graph = build_graph()
   # fused: one XLA program per batch (in-program dependencies are free;

@@ -67,7 +67,7 @@ class Dataset:
   # -- feature init --------------------------------------------------------
 
   def init_node_features(self, node_feature_data=None, id2idx=None,
-                         sort_func=None, split_ratio: float = 0.0,
+                         sort_func=None, split_ratio: float = 1.0,
                          device_group_list=None, device=None,
                          with_device: bool = True, dtype=None):
     """Build node Feature store(s) (reference: dataset.py:117-178).
@@ -75,6 +75,11 @@ class Dataset:
     When ``sort_func`` (e.g. :func:`sort_by_in_degree`) is given and no
     explicit ``id2idx``, rows are hotness-reordered and the produced
     id2index map is installed in the store.
+
+    ``split_ratio`` defaults to 1.0 (all rows HBM-resident). The reference
+    defaults to 0.0 because its CPU rows stay device-readable through UVA;
+    TPU has no UVA, so device-resident is the default and the ratio is the
+    knob for tables larger than HBM (cold tail served from host).
     """
     if node_feature_data is None:
       return self
@@ -97,7 +102,7 @@ class Dataset:
       self.node_features = build(node_feature_data, topo, id2idx)
     return self
 
-  def init_edge_features(self, edge_feature_data=None, split_ratio=0.0,
+  def init_edge_features(self, edge_feature_data=None, split_ratio=1.0,
                          device_group_list=None, device=None,
                          with_device: bool = True, dtype=None):
     """Edge feature stores, keyed by edge id (reference: dataset.py:180-220).

@@ -103,6 +103,19 @@ class Feature:
       ids = jnp.take(self._id2index_dev, ids, axis=0)
     return self._unified[ids]
 
+  def device_table(self):
+    """(feats_dev, id2index_dev) when ALL rows are HBM-resident, else None.
+
+    Loaders use this to fuse the feature gather into a single jitted
+    collate dispatch (ops.collate); with a host (cold) part the gather
+    goes through ``__getitem__``'s mixed path instead.
+    """
+    self.lazy_init()
+    if self._unified.host_part is not None or \
+        self._unified.device_part is None:
+      return None
+    return self._unified.device_part, self._id2index_dev
+
   def cpu_get(self, ids) -> np.ndarray:
     """Pure-host gather (used by remote feature serving where the result is
     immediately serialized; reference Feature.cpu_get via feature.py:122-132

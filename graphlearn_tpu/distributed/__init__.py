@@ -2,7 +2,7 @@ from .dist_context import (DistContext, DistRole, get_context,
                            init_worker_group)
 from .dist_dataset import DistDataset
 from .dist_feature import DistFeature
-from .dist_graph import DistGraph, build_local_csr
+from .dist_graph import DistGraph, DistHeteroGraph, build_local_csr
 from .dist_loader import (DistLoader, DistNeighborLoader,
                           MpDistNeighborLoader, RemoteDistNeighborLoader)
 from .dist_neighbor_sampler import DistNeighborSampler

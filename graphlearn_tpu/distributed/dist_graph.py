@@ -86,6 +86,10 @@ class DistGraph:
         self.weights[i, :e] = w
 
   @property
+  def is_hetero(self) -> bool:
+    return False
+
+  @property
   def num_nodes(self) -> int:
     return int(self.node_pb.shape[0])
 
@@ -115,4 +119,62 @@ class DistGraph:
     )
     if self.weights is not None:
       out['weights'] = jax.device_put(self.weights, shard)
+    return out
+
+
+class DistHeteroGraph:
+  """Heterogeneous sharded graph: one stacked local CSR per edge type plus
+  per-node-type partition books.
+
+  Reference: dist_graph.py holds Dict[EdgeType, Graph] + per-type PBs for
+  the hetero path (dist_neighbor_sampler.py:287-319 routes each edge
+  type's frontier by its source type's book). Same stacking re-design as
+  :class:`DistGraph`, per edge type.
+
+  Args:
+    num_partitions / partition_idx: as DistGraph.
+    parts: list (len P) of Dict[EdgeType, GraphPartitionData] — partition
+      p's edges per type.
+    node_pb: Dict[NodeType, [N_t]] global node id -> owning partition.
+    edge_pb: optional Dict[EdgeType, [E_t]].
+    edge_dir: 'out' (CSR by src) or 'in' (CSC by dst).
+  """
+
+  def __init__(self, num_partitions: int, partition_idx: int,
+               parts, node_pb: Dict, edge_pb: Optional[Dict] = None,
+               edge_dir: str = 'out'):
+    self.num_partitions = num_partitions
+    self.partition_idx = partition_idx
+    self.node_pb = {t: np.asarray(pb) for t, pb in node_pb.items()}
+    self.edge_pb = edge_pb
+    self.edge_dir = edge_dir
+    self.etypes = sorted({et for part in parts for et in part})
+    self.ntypes = sorted(self.node_pb)
+
+    by = 'src' if edge_dir == 'out' else 'dst'
+    self.sub = {}
+    empty = GraphPartitionData(edge_index=np.zeros((2, 0), np.int64),
+                               eids=np.zeros((0,), np.int64))
+    for et in self.etypes:
+      g = DistGraph(num_partitions, partition_idx,
+                    [part.get(et, empty) for part in parts],
+                    self.node_pb[et[0] if edge_dir == 'out' else et[2]],
+                    edge_dir=edge_dir)
+      self.sub[et] = g
+
+  @property
+  def is_hetero(self) -> bool:
+    return True
+
+  def num_nodes(self, ntype) -> int:
+    return int(self.node_pb[ntype].shape[0])
+
+  def device_arrays(self, mesh):
+    """{etype: stacked CSR arrays} + {'#pb': {ntype: replicated book}}."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(mesh, P())
+    out = {et: g.device_arrays(mesh) for et, g in self.sub.items()}
+    out['#pb'] = {t: jax.device_put(pb.astype(np.int32), repl)
+                  for t, pb in self.node_pb.items()}
     return out

@@ -30,6 +30,10 @@ class DistLoader:
                seed: Optional[int] = None):
     self.data = data
     self.sampler = sampler
+    if isinstance(input_nodes, tuple) and isinstance(input_nodes[0], str):
+      self.input_type, input_nodes = input_nodes
+    else:
+      self.input_type = None
     self.input_seeds = np.asarray(input_nodes).reshape(-1)
     self.batch_size = batch_size  # per shard
     self.shuffle = shuffle
@@ -64,19 +68,31 @@ class DistLoader:
                                                 self.batch_size)
       seeds = self.input_seeds[idx].reshape(self.num_partitions,
                                             self.batch_size)
-      out = self.sampler.sample_from_nodes(NodeSamplerInput(seeds),
-                                           seed_mask=mask)
+      out = self.sampler.sample_from_nodes(
+          NodeSamplerInput(seeds, self.input_type), seed_mask=mask)
       yield self._collate_fn(out)
 
-  def _collate_fn(self, out) -> Data:
-    """SamplerOutput [P, ...] -> stacked Data (reference: dist_loader.py:
-    331-441 parses the channel SampleMessage; here arrays are already
-    device-resident and sharded)."""
-    import jax.numpy as jnp
+  def _collate_fn(self, out):
+    """SamplerOutput [P, ...] -> stacked Data/HeteroData (reference:
+    dist_loader.py:331-441 parses the channel SampleMessage; here arrays
+    are already device-resident and sharded)."""
+    from .. import ops
+    from ..loader import HeteroData
+    from ..sampler import HeteroSamplerOutput
     x, y = self.sampler.collate(
         out, self.data.node_labels if self.data.node_labels is not None
         else None)
-    ei = jnp.stack([out.row, out.col], axis=1)  # [P, 2, E]
+    if isinstance(out, HeteroSamplerOutput):
+      ei = {et: ops.stack2_batched(out.row[et], out.col[et])
+            for et in out.row}
+      return HeteroData(node=out.node, num_nodes=out.num_nodes,
+                        edge_index=ei, edge_mask=out.edge_mask, x=x, y=y,
+                        edge_ids=out.edge, batch=out.batch,
+                        batch_size=out.batch_size,
+                        num_sampled_nodes=out.num_sampled_nodes,
+                        num_sampled_edges=out.num_sampled_edges,
+                        metadata=dict(out.metadata))
+    ei = ops.stack2_batched(out.row, out.col)  # [P, 2, E]
     return Data(node=out.node, num_nodes=out.num_nodes,
                 edge_index=ei, edge_mask=out.edge_mask, x=x, y=y,
                 edge_ids=out.edge, batch=out.batch,

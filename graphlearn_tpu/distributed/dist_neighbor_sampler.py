@@ -23,14 +23,50 @@ step and XLA overlaps them with compute. Every shard builds its own batch
 from its own seed block — the SPMD equivalent of the reference's
 one-batch-per-worker model.
 """
-from typing import List, Optional
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 
 from .. import ops
-from ..sampler import NodeSamplerInput, SamplerOutput
+from ..sampler import (HeteroSamplerOutput, NodeSamplerInput, SamplerOutput)
+from ..typing import reverse_edge_type
 from .dist_feature import DistFeature
-from .dist_graph import DistGraph
+from .dist_graph import DistGraph, DistHeteroGraph
+
+
+def _exchange_hop(garr, pb, frontier, fmask, k, key, nparts: int,
+                  with_edge: bool):
+  """One cross-shard hop, shared by the homo and hetero engines:
+  route frontier ids by partition book -> all_to_all request ->
+  local fanout sample over this shard's CSR -> all_to_all response ->
+  unpermute into frontier order.
+
+  Runs inside shard_map; all values are per-shard. ``garr`` holds the
+  shard's stacked local CSR (row_ids/indptr/indices/eids).
+  """
+  import jax
+  import jax.numpy as jnp
+  bf = frontier.shape[0]
+  safe = jnp.maximum(frontier, 0)
+  dest = jnp.where(fmask, pb[safe], nparts)
+  slot, ok = ops.route_slots(dest, fmask, capacity=bf)
+  send = ops.scatter_to_buckets(frontier, dest, slot, ok, nparts, bf)
+  req = jax.lax.all_to_all(send, 'g', 0, 0)
+  flat = req.reshape(-1)
+  fm = flat >= 0
+  nbrs, epos, m = ops.uniform_sample_local(
+      garr['row_ids'], garr['indptr'], garr['indices'], flat, fm, k, key)
+  resp_n = jax.lax.all_to_all(nbrs.reshape(nparts, bf, k), 'g', 0, 0)
+  resp_m = jax.lax.all_to_all(m.reshape(nparts, bf, k), 'g', 0, 0)
+  back_n = ops.gather_from_buckets(resp_n, dest, slot, ok)
+  back_m = ops.gather_from_buckets(resp_m, dest, slot, ok,
+                                   fill=False) & ok[:, None]
+  back_e = None
+  if with_edge:
+    e = jnp.where(m, garr['eids'][jnp.where(m, epos, 0)], -1)
+    resp_e = jax.lax.all_to_all(e.reshape(nparts, bf, k), 'g', 0, 0)
+    back_e = ops.gather_from_buckets(resp_e, dest, slot, ok)
+  return back_n, back_m, back_e
 
 
 class DistNeighborSampler:
@@ -45,14 +81,18 @@ class DistNeighborSampler:
     seed: PRNG seed.
   """
 
-  def __init__(self, dist_graph: DistGraph, num_neighbors: List[int],
-               mesh, dist_feature: Optional[DistFeature] = None,
+  def __init__(self, dist_graph: Union[DistGraph, DistHeteroGraph],
+               num_neighbors, mesh,
+               dist_feature: Optional[DistFeature] = None,
                with_edge: bool = False, seed: Optional[int] = None,
                node_budget: Optional[int] = None,
                collect_features: bool = False):
     import jax
     self.graph = dist_graph
-    self.num_neighbors = list(num_neighbors)
+    self.is_hetero = dist_graph.is_hetero
+    self.num_neighbors = (dict(num_neighbors)
+                          if isinstance(num_neighbors, dict)
+                          else list(num_neighbors))
     self.mesh = mesh
     self.dist_feature = dist_feature
     self.with_edge = with_edge
@@ -76,6 +116,46 @@ class DistNeighborSampler:
       caps.append(nxt)
     return caps
 
+  # ----------------------------------------------------- hetero static plan
+
+  def _etype_fanouts(self, et) -> List[int]:
+    nn = self.num_neighbors
+    return list(nn[et]) if isinstance(nn, dict) else list(nn)
+
+  def _hetero_plan(self, b: int, input_ntype):
+    """Static per-hop capacity schedule (mirror of the single-machine
+    sampler's plan, sampler/neighbor_sampler.py hetero path)."""
+    g = self.graph
+    etypes = g.etypes
+    edge_dir = g.edge_dir
+    num_hops = max(len(self._etype_fanouts(et)) for et in etypes)
+    ntypes = g.ntypes
+    frontier_cap = {t: 0 for t in ntypes}
+    frontier_cap[input_ntype] = b
+    node_caps = dict(frontier_cap)
+    hop_caps = []
+    for hop in range(num_hops):
+      adds = {t: 0 for t in ntypes}
+      per_et = {}
+      for et in etypes:
+        fo = self._etype_fanouts(et)
+        if hop >= len(fo) or fo[hop] == 0:
+          continue
+        key_t = et[0] if edge_dir == 'out' else et[2]
+        res_t = et[2] if edge_dir == 'out' else et[0]
+        fcap = frontier_cap.get(key_t, 0)
+        if fcap == 0:
+          continue
+        if self.node_budget is not None:
+          fcap = min(fcap, self.node_budget)
+        per_et[et] = (fcap, fo[hop])
+        adds[res_t] += fcap * fo[hop]
+      hop_caps.append(per_et)
+      for t in ntypes:
+        frontier_cap[t] = adds[t]
+        node_caps[t] += adds[t]
+    return num_hops, hop_caps, node_caps
+
   # ------------------------------------------------------------- build fn
 
   def _build_fn(self, b: int):
@@ -90,35 +170,9 @@ class DistNeighborSampler:
     node_cap = sum(caps)
     with_edge = self.with_edge
 
-    def exchange_hop(gdev, frontier, fmask, k, key):
-      """One hop: route -> local sample -> route back. All [.] per-shard."""
-      bf = frontier.shape[0]
-      pb = gdev['node_pb']
-      safe = jnp.maximum(frontier, 0)
-      dest = jnp.where(fmask, pb[safe], nparts)
-      slot, ok = ops.route_slots(dest, fmask, capacity=bf)
-      send = ops.scatter_to_buckets(frontier, dest, slot, ok, nparts, bf)
-      req = jax.lax.all_to_all(send, 'g', 0, 0)
-      flat = req.reshape(-1)
-      fm = flat >= 0
-      nbrs, epos, m = ops.uniform_sample_local(
-          gdev['row_ids'], gdev['indptr'], gdev['indices'], flat, fm, k,
-          key)
-      resp_n = jax.lax.all_to_all(nbrs.reshape(nparts, bf, k), 'g', 0, 0)
-      resp_m = jax.lax.all_to_all(m.reshape(nparts, bf, k), 'g', 0, 0)
-      back_n = ops.gather_from_buckets(resp_n, dest, slot, ok)
-      back_m = ops.gather_from_buckets(resp_m, dest, slot, ok,
-                                       fill=False) & ok[:, None]
-      back_e = None
-      if with_edge:
-        e = jnp.where(m, gdev['eids'][jnp.where(m, epos, 0)], -1)
-        resp_e = jax.lax.all_to_all(e.reshape(nparts, bf, k), 'g', 0, 0)
-        back_e = ops.gather_from_buckets(resp_e, dest, slot, ok)
-      return back_n, back_m, back_e
-
     def body(row_ids, indptr, indices, eids, pb, seeds, smask, keys):
       gdev = dict(row_ids=row_ids[0], indptr=indptr[0],
-                  indices=indices[0], eids=eids[0], node_pb=pb)
+                  indices=indices[0], eids=eids[0])
       seeds, smask, key = seeds[0], smask[0], keys[0]
       hop_keys = jax.random.split(key, len(fanouts))
       state, uniq, umask, inv = ops.init_node(seeds, smask,
@@ -128,7 +182,8 @@ class DistNeighborSampler:
       nodes_per_hop = [state.num_nodes]
       edges_per_hop = []
       for i, k in enumerate(fanouts):
-        nbrs, m, e = exchange_hop(gdev, frontier, fmask, k, hop_keys[i])
+        nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
+                                   hop_keys[i], nparts, with_edge)
         state, out = ops.induce_next(state, fidx, nbrs, m)
         rows.append(out['cols'])   # message direction: neighbor -> seed
         cols.append(out['rows'])
@@ -172,6 +227,176 @@ class DistNeighborSampler:
 
     return run
 
+  # ------------------------------------------------------- hetero build fn
+
+  def _build_hetero_fn(self, b: int, input_ntype):
+    """Typed shard_map engine: per-hop, per-edge-type route -> all_to_all
+    -> local sample -> all_to_all back -> per-node-type induce.
+
+    Reference: dist_neighbor_sampler.py:287-319 (hetero hop fan-out via
+    asyncio tasks per etype + RPC); here each etype's exchange is a pair
+    of collectives inside ONE jitted SPMD program.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    g = self.graph
+    nparts = g.num_partitions
+    etypes = list(g.etypes)
+    ntypes = list(g.ntypes)
+    edge_dir = g.edge_dir
+    with_edge = self.with_edge
+    num_hops, hop_caps, node_caps = self._hetero_plan(b, input_ntype)
+    out_et_of = {et: (reverse_edge_type(et) if edge_dir == 'out' else et)
+                 for et in etypes}
+
+    def body(*flat_args):
+      # unflatten: 4 arrays per etype, then per-ntype pbs, seeds, mask, key
+      i = 0
+      garr = {}
+      for et in etypes:
+        garr[et] = dict(row_ids=flat_args[i][0], indptr=flat_args[i + 1][0],
+                        indices=flat_args[i + 2][0],
+                        eids=flat_args[i + 3][0])
+        i += 4
+      pbs = {}
+      for nt in ntypes:
+        pbs[nt] = flat_args[i]
+        i += 1
+      seeds, smask, key = (flat_args[i][0], flat_args[i + 1][0],
+                           flat_args[i + 2][0])
+
+      states = {}
+      for t in ntypes:
+        if node_caps[t] == 0:
+          continue
+        if t == input_ntype:
+          states[t], uniq, umask, inv = ops.init_node(
+              seeds, smask, capacity=node_caps[t])
+        else:
+          states[t] = ops.init_empty(node_caps[t])
+      frontier = {input_ntype: (uniq, jnp.arange(b, dtype=jnp.int32),
+                                umask)}
+
+      rows, cols, edges, emasks = {}, {}, {}, {}
+      nodes_per_hop = {t: [states[t].num_nodes if t in states
+                           else jnp.asarray(0, jnp.int32)] for t in ntypes}
+      edges_per_hop = {}
+      keys = jax.random.split(key, num_hops * max(1, len(etypes)))
+      ki = 0
+      for hop in range(num_hops):
+        new_parts = {t: [] for t in ntypes}
+        for et, (fcap, k) in hop_caps[hop].items():
+          key_t = et[0] if edge_dir == 'out' else et[2]
+          res_t = et[2] if edge_dir == 'out' else et[0]
+          out_et = out_et_of[et]
+          f, fidx, fmask = frontier[key_t]
+          f, fidx, fmask = f[:fcap], fidx[:fcap], fmask[:fcap]
+          nbrs, m, e = _exchange_hop(garr[et], pbs[key_t], f, fmask, k,
+                                     keys[ki], nparts, with_edge)
+          ki += 1
+          states[res_t], iout = ops.induce_next(states[res_t], fidx, nbrs,
+                                                m)
+          rows.setdefault(out_et, []).append(iout['cols'])
+          cols.setdefault(out_et, []).append(iout['rows'])
+          emasks.setdefault(out_et, []).append(iout['edge_mask'])
+          if with_edge:
+            edges.setdefault(out_et, []).append(
+                jnp.where(iout['edge_mask'], e.reshape(-1), -1))
+          edges_per_hop.setdefault(out_et, []).append(
+              iout['edge_mask'].sum())
+          new_parts[res_t].append((iout['frontier'], iout['frontier_idx'],
+                                   iout['frontier_mask']))
+        for t in ntypes:
+          parts = new_parts[t]
+          if not parts:
+            frontier[t] = (jnp.zeros((0,), jnp.int32),
+                           jnp.zeros((0,), jnp.int32),
+                           jnp.zeros((0,), bool))
+            nodes_per_hop[t].append(jnp.asarray(0, jnp.int32))
+            continue
+          frontier[t] = (jnp.concatenate([p[0] for p in parts]),
+                         jnp.concatenate([p[1] for p in parts]),
+                         jnp.concatenate([p[2] for p in parts]))
+          nodes_per_hop[t].append(frontier[t][2].sum().astype(jnp.int32))
+
+      res = dict(
+          node={t: s.nodes[None] for t, s in states.items()},
+          num_nodes={t: s.num_nodes[None] for t, s in states.items()},
+          row={et: jnp.concatenate(v)[None] for et, v in rows.items()},
+          col={et: jnp.concatenate(v)[None] for et, v in cols.items()},
+          edge_mask={et: jnp.concatenate(v)[None]
+                     for et, v in emasks.items()},
+          num_sampled_nodes={t: jnp.stack(v)[None]
+                             for t, v in nodes_per_hop.items()},
+          num_sampled_edges={et: jnp.stack(v)[None]
+                             for et, v in edges_per_hop.items()},
+          seed_inverse=inv[None])
+      if with_edge:
+        res['edge'] = {et: jnp.concatenate(v)[None]
+                       for et, v in edges.items()}
+      return res
+
+    n_args = 4 * len(etypes) + len(ntypes) + 3
+    in_specs = tuple([P('g')] * (4 * len(etypes)) + [P()] * len(ntypes) +
+                     [P('g'), P('g'), P('g')])
+    # out_specs must mirror the result pytree with P('g') everywhere
+    out_specs = dict(
+        node={t: P('g') for t in ntypes if node_caps[t] > 0},
+        num_nodes={t: P('g') for t in ntypes if node_caps[t] > 0},
+        row={}, col={}, edge_mask={}, num_sampled_nodes={},
+        num_sampled_edges={}, seed_inverse=P('g'))
+    touched = []
+    for hop in hop_caps:
+      for et in hop:
+        if out_et_of[et] not in touched:
+          touched.append(out_et_of[et])
+    for oet in touched:
+      for k in ('row', 'col', 'edge_mask', 'num_sampled_edges'):
+        out_specs[k][oet] = P('g')
+    out_specs['num_sampled_nodes'] = {t: P('g') for t in ntypes}
+    if with_edge:
+      out_specs['edge'] = {oet: P('g') for oet in touched}
+
+    fn = shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                   out_specs=out_specs)
+    jfn = jax.jit(fn)
+    d = self._dev
+
+    def run(seeds, smask, keys):
+      args = []
+      for et in etypes:
+        ga = d[et]
+        args.extend([ga['row_ids'], ga['indptr'], ga['indices'],
+                     ga['eids']])
+      for nt in ntypes:
+        args.append(d['#pb'][nt])
+      args.extend([seeds, smask, keys])
+      assert len(args) == n_args
+      return jfn(*args)
+
+    return run
+
+  def _hetero_sample_from_nodes(self, input_ntype, seeds, smask):
+    import jax.numpy as jnp
+    b = seeds.shape[1]
+    sig = ('het', b, input_ntype)
+    if sig not in self._fns:
+      self._fns[sig] = self._build_hetero_fn(b, input_ntype)
+    res = self._fns[sig](jnp.asarray(seeds, jnp.int32),
+                         jnp.asarray(smask), self._next_keys())
+    return HeteroSamplerOutput(
+        node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
+        col=res['col'], edge=res.get('edge'), edge_mask=res['edge_mask'],
+        batch={input_ntype: jnp.asarray(seeds)}, batch_size=b,
+        num_sampled_nodes=res['num_sampled_nodes'],
+        num_sampled_edges=res['num_sampled_edges'],
+        input_type=input_ntype,
+        metadata={'seed_inverse': res['seed_inverse'],
+                  'seed_mask': jnp.asarray(smask)})
+
   # ------------------------------------------------------------ public API
 
   def sample_from_nodes(self, inputs, seed_mask=None,
@@ -185,8 +410,15 @@ class DistNeighborSampler:
     are excluded from num_nodes (used by DistLoader's final short batch).
     """
     import jax.numpy as jnp
-    seeds = np.asarray(inputs.node if isinstance(inputs, NodeSamplerInput)
-                       else inputs)
+    input_ntype = None
+    if isinstance(inputs, NodeSamplerInput):
+      input_ntype, raw = inputs.input_type, inputs.node
+    elif isinstance(inputs, tuple) and len(inputs) == 2 and \
+        isinstance(inputs[0], str):
+      input_ntype, raw = inputs
+    else:
+      raw = inputs
+    seeds = np.asarray(raw)
     p = self.graph.num_partitions
     if seeds.ndim == 1:
       assert seeds.shape[0] % p == 0, 'flat seeds must split evenly'
@@ -194,6 +426,13 @@ class DistNeighborSampler:
     b = seeds.shape[1]
     smask = (np.ones_like(seeds, bool) if seed_mask is None
              else np.asarray(seed_mask).reshape(seeds.shape))
+    if self.is_hetero:
+      assert input_ntype is not None, \
+          'hetero distributed sampling requires an input node type'
+      if input_ntype not in self.graph.ntypes:
+        raise ValueError(f'unknown input node type {input_ntype!r}; '
+                         f'graph has {self.graph.ntypes}')
+      return self._hetero_sample_from_nodes(input_ntype, seeds, smask)
     if b not in self._fns:
       self._fns[b] = self._build_fn(b)
     res = self._fns[b](jnp.asarray(seeds, jnp.int32), jnp.asarray(smask),
@@ -207,17 +446,40 @@ class DistNeighborSampler:
         metadata={'seed_inverse': res['seed_inverse'],
                   'seed_mask': jnp.asarray(smask)})
 
-  def collate(self, out: SamplerOutput, node_labels=None):
+  def collate(self, out, node_labels=None):
     """Attach features (sharded all_to_all gather) and labels.
 
-    Reference: _colloate_fn (dist_neighbor_sampler.py:650-744).
+    Reference: _colloate_fn (dist_neighbor_sampler.py:650-744). Label
+    gather goes through the jitted ops.gather_rows (no eager op may touch
+    the still-pending sampler outputs — PERF.md).
     """
-    import jax.numpy as jnp
+    if isinstance(out, HeteroSamplerOutput):
+      x = y = None
+      if self.collect_features and self.dist_feature is not None:
+        x = {t: self.dist_feature[t].get(out.node[t])
+             for t in out.node if t in self.dist_feature}
+      if node_labels is not None:
+        y = {t: ops.gather_rows(self._label_dev(node_labels[t], t), None,
+                                out.node[t])
+             for t in out.node if t in node_labels}
+      return x, y
     x = None
     if self.collect_features:
       x = self.dist_feature.get(out.node)
     y = None
     if node_labels is not None:
-      labels = jnp.asarray(node_labels)
-      y = labels[jnp.maximum(out.node, 0)]
+      y = ops.gather_rows(self._label_dev(node_labels), None, out.node)
     return x, y
+
+  def _label_dev(self, labels, key=None):
+    """Device label table, uploaded once per distinct array (keyed by the
+    array's identity, so swapping in different labels is picked up while
+    repeated batches reuse the upload)."""
+    import jax.numpy as jnp
+    if not hasattr(self, '_labels_cache'):
+      self._labels_cache = {}  # key -> (id(labels), device table)
+    hit = self._labels_cache.get(key)
+    if hit is None or hit[0] != id(labels):
+      hit = (id(labels), jnp.asarray(np.asarray(labels)))
+      self._labels_cache[key] = hit
+    return hit[1]

@@ -79,9 +79,28 @@ class NodeLoader:
       yield self._collate_fn(out)
 
   # -- collate (reference: node_loader.py:85-113) --------------------------
+  #
+  # Collation runs as ONE jitted dispatch (ops.collate_batch) whose array
+  # inputs are all arguments: the loader must never run eager ops on the
+  # sampler's still-pending outputs, and never fetch them to host
+  # (PERF.md dispatch rules). The reference gathers on the host driver
+  # instead (node_loader.py:85-113) — that shape would serialize here.
+
+  def _label_table(self, ntype=None):
+    """Device-resident label table, cached (host labels uploaded once)."""
+    import jax.numpy as jnp
+    if not hasattr(self, '_labels_dev'):
+      self._labels_dev = {}
+    key = ntype
+    if key not in self._labels_dev:
+      labels = (self.data.get_node_label(ntype) if ntype is not None
+                else self.data.node_labels)
+      self._labels_dev[key] = (None if labels is None
+                               else jnp.asarray(np.asarray(labels)))
+    return self._labels_dev[key]
 
   def _collate_fn(self, out):
-    import jax.numpy as jnp
+    from .. import ops
     if getattr(self.sampler, 'is_hetero', False):
       x = y = None
       if self.collect_features and self.data.node_features is not None:
@@ -89,27 +108,42 @@ class NodeLoader:
         for t, buf in out.node.items():
           store = self.data.get_node_feature(t)
           if store is not None:
-            safe = jnp.maximum(jnp.asarray(buf), 0)
-            x[t] = store[safe]
+            dt = store.device_table()
+            if dt is not None:
+              x[t] = ops.gather_rows(dt[0], dt[1], buf)
+            else:  # host/mixed store: UnifiedTensor mixed path
+              x[t] = store[buf]
       if self.data.node_labels is not None:
         y = {}
         for t, buf in out.node.items():
-          labels = self.data.get_node_label(t)
+          labels = self._label_table(t)
           if labels is not None:
-            safe = np.clip(np.asarray(buf), 0, len(labels) - 1)
-            y[t] = jnp.asarray(np.asarray(labels)[safe])
+            y[t] = ops.gather_rows(labels, None, buf)
       return to_hetero_data(out, x, y)
 
-    x = y = None
+    feats = id2i = None
     if self.collect_features and self.data.node_features is not None:
-      safe = jnp.maximum(jnp.asarray(out.node), 0)
-      x = self.data.node_features[safe]
-    if self.data.node_labels is not None:
-      labels = np.asarray(self.data.node_labels)
-      safe = np.clip(np.asarray(out.node), 0, len(labels) - 1)
-      y = jnp.asarray(labels[safe])
-    ef = None
+      dt = self.data.node_features.device_table()
+      if dt is not None:
+        feats, id2i = dt
+    efeats = None
     if out.edge is not None and self.data.edge_features is not None:
-      safe = jnp.maximum(jnp.asarray(out.edge), 0)
-      ef = self.data.edge_features[safe]
-    return to_data(out, x, y, ef)
+      edt = self.data.edge_features.device_table()
+      if edt is not None:
+        efeats = edt[0]
+    res = ops.collate_batch(out.node, out.num_nodes, out.row, out.col,
+                            feats, id2i, self._label_table(), efeats,
+                            out.edge)
+    x = res['x']
+    if x is None and self.collect_features and \
+        self.data.node_features is not None:
+      # host/mixed feature store: fall back to the UnifiedTensor path
+      x = self.data.node_features[out.node]
+    ef = res['edge_attr']
+    if ef is None and out.edge is not None and \
+        self.data.edge_features is not None:
+      ef = self.data.edge_features[out.edge]
+    data = to_data(out, x, res['y'], ef,
+                   node_mask=res['node_mask'],
+                   edge_index=res['edge_index'])
+    return data

@@ -106,16 +106,20 @@ class HeteroData:
 
 
 def to_data(out: SamplerOutput, node_feats=None, node_labels=None,
-            edge_feats=None) -> Data:
-  """SamplerOutput -> Data (reference: transform.py:26-57). Keeps padding."""
-  import jax.numpy as jnp
+            edge_feats=None, node_mask=None, edge_index=None) -> Data:
+  """SamplerOutput -> Data (reference: transform.py:26-57). Keeps padding.
+
+  ``node_mask``/``edge_index`` may be passed precomputed (loaders derive
+  them inside the jitted ops.collate_batch so no eager op touches pending
+  sampler outputs); when absent they are derived here.
+  """
+  from .. import ops
   node = out.node
-  node_mask = None
-  if out.num_nodes is not None:
-    node_mask = jnp.arange(node.shape[0]) < out.num_nodes
-  ei = None
-  if out.row is not None:
-    ei = jnp.stack([jnp.asarray(out.row), jnp.asarray(out.col)])
+  if node_mask is None and out.num_nodes is not None:
+    node_mask = ops.valid_mask(node, out.num_nodes)
+  ei = edge_index
+  if ei is None and out.row is not None:
+    ei = ops.stack2(out.row, out.col)
   return Data(
       node=node, num_nodes=out.num_nodes, node_mask=node_mask,
       edge_index=ei, edge_mask=out.edge_mask, x=node_feats, y=node_labels,
@@ -127,11 +131,11 @@ def to_data(out: SamplerOutput, node_feats=None, node_labels=None,
 def to_hetero_data(out: HeteroSamplerOutput, node_feats=None,
                    node_labels=None, edge_feats=None) -> HeteroData:
   """HeteroSamplerOutput -> HeteroData (reference: transform.py:60-136)."""
-  import jax.numpy as jnp
+  from .. import ops
   ei = None
   if out.row is not None:
-    ei = {et: jnp.stack([jnp.asarray(r), jnp.asarray(out.col[et])])
-          for et, r in out.row.items()}
+    # jitted per-etype stack: no eager op on pending sampler outputs
+    ei = {et: ops.stack2(r, out.col[et]) for et, r in out.row.items()}
   return HeteroData(
       node=out.node, num_nodes=out.num_nodes, edge_index=ei,
       edge_mask=out.edge_mask, x=node_feats, y=node_labels,

@@ -62,6 +62,24 @@ def make_train_step(model, tx, num_classes: int):
   return train_step, eval_step
 
 
+def make_eval_counts(model):
+  """Jitted exact-count evaluation: (params, batch) -> (correct, total)
+  over the batch's seed slots. Counts stay on device so epoch-level
+  accuracy can be accumulated without host fetches (PERF.md rules) and
+  aggregated exactly across uneven batches."""
+
+  @jax.jit
+  def eval_counts(params, batch):
+    logits = model.apply(params, batch['x'], batch['edge_index'],
+                         batch['edge_mask'])
+    n = logits.shape[0]
+    seed_mask = jnp.arange(n) < batch['num_seed_nodes']
+    correct = (logits.argmax(-1) == batch['y']) & seed_mask
+    return correct.sum(), seed_mask.sum()
+
+  return eval_counts
+
+
 def batch_to_dict(batch):
   """`loader.Data` -> the flat dict the jitted step consumes."""
   num_seed = (batch.num_sampled_nodes[0]

@@ -1,3 +1,5 @@
+from .collate import (collate_batch, gather_rows, stack2, stack2_batched,
+                      valid_mask)
 from .induce import InducerState, induce_next, init_empty, init_node
 from .induce_map import (MapInducerState, induce_next_map, init_node_map)
 from .negative import random_negative_sample, sort_csr_segments

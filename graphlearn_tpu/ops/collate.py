@@ -1,0 +1,75 @@
+"""Fused batch collation: mask/edge_index/feature/label gathers in ONE
+jitted dispatch.
+
+The reference collates on the host driver (loader/node_loader.py:85-113
+gathers features via UnifiedTensor then builds PyG Data). Here collation
+must be a single device program for a different reason: an eager op whose
+input is a still-pending sampler output serializes the dispatch pipeline
+on remote-dispatch runtimes (PERF.md), so the loader may not touch the
+sampler's outputs eagerly. All arrays enter as arguments (never closures),
+and optional stores are trace-time ``None`` branches.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def collate_batch(node, num_nodes, row, col, feats, id2index, labels,
+                  edge_feats, edge):
+  """Build the derived batch payloads on device.
+
+  Args:
+    node: [cap_n] global ids (FILL=-1 padded).
+    num_nodes: scalar valid count.
+    row / col: [cap_e] relabeled endpoints (or None).
+    feats: [N, F] device feature table (or None).
+    id2index: [N] hotness-reorder map applied before the gather (or None).
+    labels: [N] device label table (or None).
+    edge_feats: [E, F_e] device edge-feature table (or None).
+    edge: [cap_e] global edge ids (needed when edge_feats given).
+
+  Returns dict with node_mask, edge_index (or None), x, y, edge_attr —
+  padded slots gather row/label 0 (masked downstream by node_mask).
+  """
+  out = {}
+  out['node_mask'] = jnp.arange(node.shape[0]) < num_nodes
+  out['edge_index'] = (jnp.stack([row, col]) if row is not None else None)
+  safe = jnp.maximum(node, 0)
+  if feats is not None:
+    fidx = id2index[safe] if id2index is not None else safe
+    out['x'] = feats[fidx]
+  else:
+    out['x'] = None
+  out['y'] = labels[safe] if labels is not None else None
+  if edge_feats is not None and edge is not None:
+    out['edge_attr'] = edge_feats[jnp.maximum(edge, 0)]
+  else:
+    out['edge_attr'] = None
+  return out
+
+
+@jax.jit
+def valid_mask(node, num_nodes):
+  """arange(len(node)) < num_nodes, as a jitted dispatch."""
+  return jnp.arange(node.shape[0]) < num_nodes
+
+
+@jax.jit
+def stack2(a, b):
+  """Jitted 2-row stack (edge_index assembly without an eager op)."""
+  return jnp.stack([a, b])
+
+
+@jax.jit
+def stack2_batched(a, b):
+  """[P, E] x 2 -> [P, 2, E] (sharded edge_index assembly)."""
+  return jnp.stack([a, b], axis=1)
+
+
+@jax.jit
+def gather_rows(table, id2index, ids):
+  """Single fused gather with padding clamp (hetero per-type collate)."""
+  safe = jnp.maximum(ids, 0)
+  if id2index is not None:
+    safe = id2index[safe]
+  return table[safe]

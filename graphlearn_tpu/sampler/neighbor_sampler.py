@@ -31,6 +31,70 @@ def _round_up(n: int, multiple: int = 8) -> int:
   return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, use_map,
+                   num_graph_nodes):
+  """Jitted whole-multi-hop sample program, cached at MODULE level on its
+  static signature: every sampler instance with the same config (e.g. the
+  train and eval loaders of one run) shares one traced/compiled
+  executable instead of paying the ~60s XLA compile per instance.
+
+  All device arrays enter as ARGUMENTS, never closure constants — an
+  executable with captured constants pays a flat ~5ms per call on
+  remote-dispatch runtimes (PERF.md).
+  """
+  import jax
+
+  if use_map:
+    init_fn = functools.partial(ops.init_node_map,
+                                num_graph_nodes=num_graph_nodes)
+    induce_fn = ops.induce_next_map
+  else:
+    init_fn, induce_fn = ops.init_node, ops.induce_next
+
+  def fn(indptr, indices, eids, cum, seeds, seed_mask, key):
+    import jax.numpy as jnp
+    batch_cap = seeds.shape[0]
+    state, uniq, umask, inv = init_fn(seeds, seed_mask, capacity=node_cap)
+    frontier, fidx, fmask = uniq, jnp.arange(batch_cap, dtype=jnp.int32), \
+        umask
+    rows, cols, edges, emasks = [], [], [], []
+    nodes_per_hop = [state.num_nodes]
+    edges_per_hop = []
+    keys = jax.random.split(key, len(fanouts))
+    for i, k in enumerate(fanouts):
+      if weighted:
+        nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
+                                            fmask, k, keys[i])
+      else:
+        nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
+                                           fmask, k, keys[i])
+      state, out = induce_fn(state, fidx, nbrs, m)
+      # message direction: neighbor -> seed
+      rows.append(out['cols'])
+      cols.append(out['rows'])
+      emasks.append(out['edge_mask'])
+      if with_edge:
+        flat_epos = epos.reshape(-1)
+        e = (eids[flat_epos] if eids is not None else flat_epos)
+        edges.append(jnp.where(out['edge_mask'], e, -1))
+      nodes_per_hop.append(out['num_new'])
+      edges_per_hop.append(out['edge_mask'].sum())
+      nxt = caps[i + 1]
+      frontier = out['frontier'][:nxt]
+      fidx = out['frontier_idx'][:nxt]
+      fmask = out['frontier_mask'][:nxt]
+    return dict(
+        node=state.nodes, num_nodes=state.num_nodes,
+        row=jnp.concatenate(rows), col=jnp.concatenate(cols),
+        edge=jnp.concatenate(edges) if with_edge else None,
+        edge_mask=jnp.concatenate(emasks),
+        num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
+        seed_inverse=inv)
+
+  return jax.jit(fn)
+
+
 class NeighborSampler(BaseSampler):
   """Fanout neighbor sampling over device-resident CSR
   (reference: sampler/neighbor_sampler.py:37-674).
@@ -161,63 +225,14 @@ class NeighborSampler(BaseSampler):
     return caps
 
   def _build_homo_fn(self, batch_cap: int, fanouts):
-    """Compile the full multi-hop sample as one jitted function.
-
-    All device arrays (graph CSR, weight CDF) enter as ARGUMENTS, never as
-    closure-captured constants: on remote-dispatch runtimes an executable
-    with captured constants pays a flat ~5ms per call (measured), which at
-    batch granularity would dominate the whole sample.
-    """
-    import jax
+    """Resolve the shared jitted multi-hop program for this config."""
     g = self._get_graph()
     caps = self._homo_capacities(batch_cap, fanouts)
-    node_cap = sum(caps)
-    with_edge = self.with_edge
-    weighted = self.with_weight and g.edge_weights is not None
-    init_fn, induce_fn = self._inducer_fns()
-
-    def fn(indptr, indices, eids, cum, seeds, seed_mask, key):
-      import jax.numpy as jnp
-      state, uniq, umask, inv = init_fn(seeds, seed_mask,
-                                        capacity=node_cap)
-      frontier, fidx, fmask = uniq, jnp.arange(batch_cap, dtype=jnp.int32), \
-          umask
-      rows, cols, edges, emasks = [], [], [], []
-      nodes_per_hop = [state.num_nodes]
-      edges_per_hop = []
-      keys = jax.random.split(key, len(fanouts))
-      for i, k in enumerate(fanouts):
-        cap_i = caps[i]
-        if weighted:
-          nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
-                                              fmask, k, keys[i])
-        else:
-          nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
-                                             fmask, k, keys[i])
-        state, out = induce_fn(state, fidx, nbrs, m)
-        # message direction: neighbor -> seed
-        rows.append(out['cols'])
-        cols.append(out['rows'])
-        emasks.append(out['edge_mask'])
-        if with_edge:
-          flat_epos = epos.reshape(-1)
-          e = (eids[flat_epos] if eids is not None else flat_epos)
-          edges.append(jnp.where(out['edge_mask'], e, -1))
-        nodes_per_hop.append(out['num_new'])
-        edges_per_hop.append(out['edge_mask'].sum())
-        nxt = caps[i + 1]
-        frontier = out['frontier'][:nxt]
-        fidx = out['frontier_idx'][:nxt]
-        fmask = out['frontier_mask'][:nxt]
-      return dict(
-          node=state.nodes, num_nodes=state.num_nodes,
-          row=jnp.concatenate(rows), col=jnp.concatenate(cols),
-          edge=jnp.concatenate(edges) if with_edge else None,
-          edge_mask=jnp.concatenate(emasks),
-          num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
-          seed_inverse=inv)
-
-    return jax.jit(fn)
+    return _fused_homo_fn(
+        tuple(fanouts), tuple(caps), sum(caps), self.with_edge,
+        self.with_weight and g.edge_weights is not None,
+        self._use_map_dedup(),
+        g.num_nodes if self._use_map_dedup() else 0)
 
   def _fused_args(self):
     """Graph device arrays passed (not captured) into the fused program."""

@@ -1,5 +1,6 @@
 from .common import count_dict, get_free_port, merge_dict
-from .device import ensure_device, get_available_device
+from .device import (enable_compilation_cache, ensure_device,
+                     get_available_device)
 from .exit_status import python_exit_status
 from .mixin import CastMixin
 from .singleton import Singleton

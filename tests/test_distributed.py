@@ -164,3 +164,114 @@ def test_dist_dataset_load_from_partition_dir(tmp_path):
   for p in range(2):
     nn = int(np.asarray(batch.num_nodes)[p])
     np.testing.assert_allclose(x[p, :nn, 0], node[p, :nn])
+
+
+# ---------------------------------------------------------------- hetero
+
+def hetero_ring_fixture(num_parts=2):
+  """Two node types, two edge types, analytic books:
+     ('u','to','v'):   u_i -> v_i and v_{(i+1)%N}
+     ('v','back','u'): v_i -> u_{(i+2)%N}
+     node_pb: u_i -> i%P, v_i -> (i+1)%P (different maps exercise routing).
+  """
+  et1, et2 = ('u', 'to', 'v'), ('v', 'back', 'u')
+  r1 = np.concatenate([np.arange(N), np.arange(N)])
+  c1 = np.concatenate([np.arange(N), (np.arange(N) + 1) % N])
+  e1 = np.arange(2 * N)
+  r2 = np.arange(N)
+  c2 = (np.arange(N) + 2) % N
+  e2 = np.arange(N)
+  pb_u = (np.arange(N) % num_parts).astype(np.int32)
+  pb_v = ((np.arange(N) + 1) % num_parts).astype(np.int32)
+  parts = []
+  for p in range(num_parts):
+    part = {}
+    m1 = pb_u[r1] == p      # et1 rows owned by u's partition
+    part[et1] = GraphPartitionData(
+        edge_index=np.stack([r1[m1], c1[m1]]), eids=e1[m1])
+    m2 = pb_v[r2] == p      # et2 rows owned by v's partition
+    part[et2] = GraphPartitionData(
+        edge_index=np.stack([r2[m2], c2[m2]]), eids=e2[m2])
+    parts.append(part)
+  node_pb = {'u': pb_u, 'v': pb_v}
+  feats = {
+      'u': [(np.nonzero(pb_u == p)[0],
+             np.nonzero(pb_u == p)[0][:, None].astype(np.float32) *
+             np.ones((1, 4), np.float32)) for p in range(num_parts)],
+      'v': [(np.nonzero(pb_v == p)[0],
+             1000.0 + np.nonzero(pb_v == p)[0][:, None].astype(np.float32) *
+             np.ones((1, 4), np.float32)) for p in range(num_parts)],
+  }
+  return parts, feats, node_pb, (et1, et2)
+
+
+@pytest.mark.parametrize('num_parts', [2, 4])
+def test_dist_hetero_sampler(num_parts):
+  parts, feats, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  fanouts = {et1: [2, 2], et2: [1, 1]}
+  sampler = glt.distributed.DistNeighborSampler(dg, fanouts, mesh, seed=0)
+  seeds = np.arange(2 * num_parts, dtype=np.int32).reshape(num_parts, 2)
+  out = sampler.sample_from_nodes(('u', seeds))
+
+  rev1 = glt.typing.reverse_edge_type(et1)   # ('v', 'rev_to', 'u')
+  rev2 = glt.typing.reverse_edge_type(et2)   # ('u', 'rev_back', 'v')
+  assert set(out.row) == {rev1, rev2}
+  node_u = np.asarray(out.node['u'])
+  node_v = np.asarray(out.node['v'])
+  for p in range(num_parts):
+    # seeds lead u's node list
+    assert set(node_u[p][:2].tolist()) == set(seeds[p].tolist())
+    # et1 edges: neighbor v == u or u+1 (mod N), emitted under rev1
+    r = np.asarray(out.row[rev1])[p]
+    c = np.asarray(out.col[rev1])[p]
+    m = np.asarray(out.edge_mask[rev1])[p]
+    assert m.sum() > 0
+    for ri, ci in zip(r[m], c[m]):
+      u = int(node_u[p][ci]); v = int(node_v[p][ri])
+      assert v in (u, (u + 1) % N), (u, v)
+    # et2 edges: neighbor u == v+2 (mod N), emitted under rev2
+    r = np.asarray(out.row[rev2])[p]
+    c = np.asarray(out.col[rev2])[p]
+    m = np.asarray(out.edge_mask[rev2])[p]
+    assert m.sum() > 0
+    for ri, ci in zip(r[m], c[m]):
+      v = int(node_v[p][ci]); u = int(node_u[p][ri])
+      assert u == (v + 2) % N, (v, u)
+    # uniqueness per type
+    for node, t in ((node_u, 'u'), (node_v, 'v')):
+      nn = int(np.asarray(out.num_nodes[t])[p])
+      valid = node[p][:nn]
+      assert len(set(valid.tolist())) == nn
+
+
+def test_dist_hetero_loader_end_to_end():
+  num_parts = 2
+  parts, feats, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  df = {t: glt.distributed.DistFeature(num_parts, feats[t], node_pb[t],
+                                       mesh) for t in ('u', 'v')}
+  labels = {'u': np.arange(N) % 5, 'v': np.arange(N) % 3}
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df,
+                                   node_labels=labels)
+  loader = glt.distributed.DistNeighborLoader(
+      ds, {et1: [2, 2], et2: [1, 1]}, ('u', np.arange(N)), batch_size=4,
+      shuffle=True, seed=0, mesh=mesh)
+  steps = 0
+  for batch in loader:
+    steps += 1
+    for t, base in (('u', 0.0), ('v', 1000.0)):
+      node = np.asarray(batch.node[t])
+      x = np.asarray(batch.x[t])
+      y = np.asarray(batch.y[t])
+      for p in range(num_parts):
+        nn = int(np.asarray(batch.num_nodes[t])[p])
+        np.testing.assert_allclose(x[p, :nn, 0], base + node[p, :nn])
+        mod = 5 if t == 'u' else 3
+        np.testing.assert_array_equal(y[p, :nn], node[p, :nn] % mod)
+    assert set(batch.edge_index.keys()) == {
+        glt.typing.reverse_edge_type(et1),
+        glt.typing.reverse_edge_type(et2)}
+  assert steps == len(loader) == N // (num_parts * 4)
