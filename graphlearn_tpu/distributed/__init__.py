@@ -3,7 +3,8 @@ from .dist_context import (DistContext, DistRole, get_context,
 from .dist_dataset import DistDataset
 from .dist_feature import DistFeature
 from .dist_graph import DistGraph, DistHeteroGraph, build_local_csr
-from .dist_loader import (DistLoader, DistNeighborLoader,
+from .dist_loader import (DistLinkNeighborLoader, DistLoader,
+                          DistNeighborLoader, DistSubGraphLoader,
                           MpDistNeighborLoader, RemoteDistNeighborLoader)
 from .dist_neighbor_sampler import DistNeighborSampler
 from .dist_options import (CollocatedDistSamplingWorkerOptions,

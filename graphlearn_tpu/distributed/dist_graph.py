@@ -93,6 +93,39 @@ class DistGraph:
   def num_nodes(self) -> int:
     return int(self.node_pb.shape[0])
 
+  def sorted_local_indices(self) -> np.ndarray:
+    """[P, E] per-shard segment-sorted neighbor ids — the binary-search
+    membership table for shard-local negative sampling
+    (ops.random_negative_sample_local). Computed once, host-side."""
+    if not hasattr(self, '_sorted_loc'):
+      out = np.full_like(self.indices, -1)
+      for p in range(self.indices.shape[0]):
+        ptr, ind = self.indptr[p], self.indices[p]
+        nedges = int(ptr[-1])
+        rows = np.repeat(np.arange(ptr.shape[0] - 1), np.diff(ptr))
+        perm = np.lexsort((ind[:nedges], rows))
+        out[p, :nedges] = ind[:nedges][perm]
+      self._sorted_loc = out
+    return self._sorted_loc
+
+  def row_cumsum_stacked(self) -> np.ndarray:
+    """[P, E] per-shard row-restarting cumulative edge weights — the
+    inverse-CDF table for distributed weighted sampling
+    (ops.weighted_sample_local)."""
+    assert self.weights is not None, 'graph has no edge weights'
+    if not hasattr(self, '_wcum'):
+      out = np.zeros_like(self.weights)
+      for p in range(self.weights.shape[0]):
+        ptr, w = self.indptr[p], self.weights[p]
+        nedges = int(ptr[-1])
+        cum = np.cumsum(w[:nedges])
+        row_base = np.concatenate([[0.0], cum])[ptr[:-1]]
+        counts = np.diff(ptr)
+        base_per_edge = np.repeat(row_base, counts)
+        out[p, :nedges] = cum - base_per_edge
+      self._wcum = out
+    return self._wcum
+
   def get_node_partitions(self, ids) -> np.ndarray:
     """Partition book lookup (reference: dist_graph.py:88-98)."""
     return self.node_pb[np.asarray(ids)]

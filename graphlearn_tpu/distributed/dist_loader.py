@@ -44,32 +44,39 @@ class DistLoader:
 
   def __len__(self):
     g = self.num_partitions * self.batch_size
-    n = self.input_seeds.shape[0]
+    n = self._num_seeds()
     return n // g if self.drop_last else (n + g - 1) // g
 
-  def __iter__(self):
-    order = (self._rng.permutation(self.input_seeds.shape[0])
-             if self.shuffle else np.arange(self.input_seeds.shape[0]))
+  def _num_seeds(self):
+    return self.input_seeds.shape[0]
+
+  def _index_blocks(self):
+    """Yield ([P, B] seed-index blocks, validity mask or None) per step.
+
+    The final short block is padded by repeating indices (cyclically, so
+    it works even with fewer total seeds than one global batch) but
+    carries a validity mask: pad seeds produce no nodes/edges in the
+    sampler and consumers can exclude them (no silent double-counting;
+    the reference emits a short batch instead, dist_loader.py:284-295).
+    """
+    n = self._num_seeds()
+    order = self._rng.permutation(n) if self.shuffle else np.arange(n)
     g = self.num_partitions * self.batch_size
-    n_steps = len(self)
-    for s in range(n_steps):
+    shape = (self.num_partitions, self.batch_size)
+    for s in range(len(self)):
       idx = order[s * g:(s + 1) * g]
       n_valid = idx.shape[0]
       mask = None
       if n_valid < g:
-        # pad the final global batch by repeating seeds (cyclically, so it
-        # works even when fewer total seeds than one global batch), but
-        # carry a validity mask: pad seeds produce no nodes/edges in the
-        # sampler and consumers can exclude them (no silent
-        # double-counting; reference emits a short batch instead,
-        # dist_loader.py:284-295)
         idx = np.concatenate([idx, np.resize(order, g - n_valid)])
-        mask = (np.arange(g) < n_valid).reshape(self.num_partitions,
-                                                self.batch_size)
-      seeds = self.input_seeds[idx].reshape(self.num_partitions,
-                                            self.batch_size)
+        mask = (np.arange(g) < n_valid).reshape(shape)
+      yield idx.reshape(shape), mask
+
+  def __iter__(self):
+    for idx, mask in self._index_blocks():
       out = self.sampler.sample_from_nodes(
-          NodeSamplerInput(seeds, self.input_type), seed_mask=mask)
+          NodeSamplerInput(self.input_seeds[idx], self.input_type),
+          seed_mask=mask)
       yield self._collate_fn(out)
 
   def _collate_fn(self, out):
@@ -226,14 +233,78 @@ class RemoteDistNeighborLoader:
         pass
 
 
-class DistNeighborLoader(DistLoader):
-  """Reference: dist_neighbor_loader.py:104-112."""
+class DistLinkNeighborLoader(DistLoader):
+  """Distributed link-prediction loader: per-shard seed-edge blocks ->
+  one SPMD link-sampling program (reference:
+  distributed/dist_link_neighbor_loader.py:1-158; the sampling itself is
+  dist_neighbor_sampler.py:369-496).
 
-  def __init__(self, data: DistDataset, num_neighbors: List[int],
-               input_nodes, batch_size: int = 64, shuffle: bool = False,
+  Args:
+    edge_label_index: [2, E] seed edges, or (edge_type, [2, E]) for
+      hetero.
+    edge_label: optional [E] labels for the positives.
+    neg_sampling: optional NegativeSampling ('binary'/'triplet').
+  """
+
+  def __init__(self, data: DistDataset, num_neighbors, edge_label_index,
+               edge_label=None, batch_size: int = 64,
+               shuffle: bool = False, drop_last: bool = True,
+               neg_sampling=None, with_edge: bool = False,
+               collect_features: bool = True, seed: Optional[int] = None,
+               node_budget: Optional[int] = None, mesh=None,
+               with_weight: bool = False):
+    if mesh is None:
+      from .dist_context import get_context
+      ctx = get_context()
+      mesh = ctx.mesh if ctx else None
+    if isinstance(edge_label_index, tuple) and \
+        isinstance(edge_label_index[0], tuple):
+      input_type, edge_label_index = edge_label_index
+    else:
+      input_type = None
+    ei = np.asarray(edge_label_index)
+    self.seed_rows = ei[0].reshape(-1)
+    self.seed_cols = ei[1].reshape(-1)
+    self.edge_label = (np.asarray(edge_label).reshape(-1)
+                       if edge_label is not None else None)
+    self.neg_sampling = neg_sampling
+    sampler = DistNeighborSampler(
+        data.graph, num_neighbors, mesh,
+        dist_feature=data.node_features, with_edge=with_edge, seed=seed,
+        node_budget=node_budget, collect_features=collect_features,
+        with_weight=with_weight)
+    super().__init__(data, sampler, np.zeros(0, np.int64), batch_size,
+                     shuffle, drop_last, collect_features, seed)
+    self.input_type = input_type  # EdgeType for hetero link sampling
+
+  def _num_seeds(self):
+    return self.seed_rows.shape[0]
+
+  def __iter__(self):
+    from ..sampler import EdgeSamplerInput
+    for idx, mask in self._index_blocks():
+      out = self.sampler.sample_from_edges(
+          EdgeSamplerInput(
+              self.seed_rows[idx], self.seed_cols[idx],
+              label=(self.edge_label[idx]
+                     if self.edge_label is not None else None),
+              input_type=self.input_type,
+              neg_sampling=self.neg_sampling),
+          seed_mask=mask)
+      yield self._collate_fn(out)
+
+
+class DistSubGraphLoader(DistLoader):
+  """Distributed induced-subgraph loader (reference:
+  distributed/dist_subgraph_loader.py:1-93; sampling is
+  dist_neighbor_sampler.py:499-559). ``num_neighbors=None`` induces over
+  the seed set alone; otherwise seeds are hop-expanded first."""
+
+  def __init__(self, data: DistDataset, num_neighbors, input_nodes,
+               batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = True, with_edge: bool = False,
                collect_features: bool = True, seed: Optional[int] = None,
-               node_budget: Optional[int] = None, mesh=None):
+               max_degree: Optional[int] = None, mesh=None):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -241,6 +312,35 @@ class DistNeighborLoader(DistLoader):
     sampler = DistNeighborSampler(
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
-        node_budget=node_budget, collect_features=collect_features)
+        collect_features=collect_features)
+    super().__init__(data, sampler, input_nodes, batch_size, shuffle,
+                     drop_last, collect_features, seed)
+    self.max_degree = max_degree
+
+  def __iter__(self):
+    for idx, mask in self._index_blocks():
+      out = self.sampler.subgraph(self.input_seeds[idx], seed_mask=mask,
+                                  max_degree=self.max_degree)
+      yield self._collate_fn(out)
+
+
+class DistNeighborLoader(DistLoader):
+  """Reference: dist_neighbor_loader.py:104-112."""
+
+  def __init__(self, data: DistDataset, num_neighbors: List[int],
+               input_nodes, batch_size: int = 64, shuffle: bool = False,
+               drop_last: bool = True, with_edge: bool = False,
+               collect_features: bool = True, seed: Optional[int] = None,
+               node_budget: Optional[int] = None, mesh=None,
+               with_weight: bool = False):
+    if mesh is None:
+      from .dist_context import get_context
+      ctx = get_context()
+      mesh = ctx.mesh if ctx else None
+    sampler = DistNeighborSampler(
+        data.graph, num_neighbors, mesh,
+        dist_feature=data.node_features, with_edge=with_edge, seed=seed,
+        node_budget=node_budget, collect_features=collect_features,
+        with_weight=with_weight)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, collect_features, seed)

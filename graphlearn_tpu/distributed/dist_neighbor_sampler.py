@@ -1,4 +1,4 @@
-"""Distributed multi-hop neighbor sampling over a mesh-sharded graph.
+"""Distributed sampling over a mesh-sharded graph: node, link, subgraph.
 
 TPU-native re-design of
 /root/reference/graphlearn_torch/python/distributed/dist_neighbor_sampler.py.
@@ -13,7 +13,8 @@ mesh axis 'g' (one graph partition per chip). Per hop, per shard:
   1. dest = node_pb[frontier]                       (replicated PB lookup)
   2. pack frontier into [P, C] buckets              (ops.route_slots/scatter)
   3. lax.all_to_all                                 (requests ride ICI)
-  4. local fanout sample over the shard's CSR       (ops.uniform_sample_local)
+  4. local fanout sample over the shard's CSR       (ops.uniform_sample_local
+                                                     or weighted_sample_local)
   5. lax.all_to_all back                            (responses)
   6. unpermute into frontier order                  (ops.gather_from_buckets)
   7. dedup/relabel into the shard's batch           (ops.induce_next)
@@ -22,27 +23,38 @@ No asyncio, no RPC, no stitch kernels: the collectives are compiled into the
 step and XLA overlaps them with compute. Every shard builds its own batch
 from its own seed block — the SPMD equivalent of the reference's
 one-batch-per-worker model.
+
+Link sampling (reference _sample_from_edges, dist_neighbor_sampler.py:369-496)
+and subgraph sampling (reference _subgraph, :499-559) are additional program
+builders over the same hop engine: negatives are drawn shard-locally inside
+the program (non-strict, like the reference's local-only distributed negative
+sampling, :380-383), and the induced-subgraph edge extraction is an
+all_gather of the node set + per-shard local extraction + all_to_all of the
+results — the collective analog of the reference's subgraph RPC fan-out.
 """
 from typing import Dict, List, Optional, Union
 
 import numpy as np
 
 from .. import ops
-from ..sampler import (HeteroSamplerOutput, NodeSamplerInput, SamplerOutput)
+from ..sampler import (EdgeSamplerInput, HeteroSamplerOutput,
+                       NodeSamplerInput, SamplerOutput)
 from ..typing import reverse_edge_type
 from .dist_feature import DistFeature
 from .dist_graph import DistGraph, DistHeteroGraph
 
 
 def _exchange_hop(garr, pb, frontier, fmask, k, key, nparts: int,
-                  with_edge: bool):
+                  with_edge: bool, weighted: bool = False):
   """One cross-shard hop, shared by the homo and hetero engines:
   route frontier ids by partition book -> all_to_all request ->
   local fanout sample over this shard's CSR -> all_to_all response ->
   unpermute into frontier order.
 
   Runs inside shard_map; all values are per-shard. ``garr`` holds the
-  shard's stacked local CSR (row_ids/indptr/indices/eids).
+  shard's stacked local CSR (row_ids/indptr/indices/eids, plus wcum when
+  ``weighted``). Bucket capacity equals the frontier width, so routing can
+  NEVER overflow/drop ids — see ops.route_slots' contract.
   """
   import jax
   import jax.numpy as jnp
@@ -54,8 +66,13 @@ def _exchange_hop(garr, pb, frontier, fmask, k, key, nparts: int,
   req = jax.lax.all_to_all(send, 'g', 0, 0)
   flat = req.reshape(-1)
   fm = flat >= 0
-  nbrs, epos, m = ops.uniform_sample_local(
-      garr['row_ids'], garr['indptr'], garr['indices'], flat, fm, k, key)
+  if weighted:
+    nbrs, epos, m = ops.weighted_sample_local(
+        garr['row_ids'], garr['indptr'], garr['indices'], garr['wcum'],
+        flat, fm, k, key)
+  else:
+    nbrs, epos, m = ops.uniform_sample_local(
+        garr['row_ids'], garr['indptr'], garr['indices'], flat, fm, k, key)
   resp_n = jax.lax.all_to_all(nbrs.reshape(nparts, bf, k), 'g', 0, 0)
   resp_m = jax.lax.all_to_all(m.reshape(nparts, bf, k), 'g', 0, 0)
   back_n = ops.gather_from_buckets(resp_n, dest, slot, ok)
@@ -69,15 +86,74 @@ def _exchange_hop(garr, pb, frontier, fmask, k, key, nparts: int,
   return back_n, back_m, back_e
 
 
+def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
+                   node_cap: int, nparts: int, with_edge: bool,
+                   weighted: bool):
+  """Multi-hop homo engine body (traced inside shard_map): dedup seeds,
+  expand hop by hop via _exchange_hop + induce_next. Returns the per-shard
+  result dict (no leading axis)."""
+  import jax
+  import jax.numpy as jnp
+  b = seeds.shape[0]
+  hop_keys = jax.random.split(key, max(1, len(fanouts)))
+  state, uniq, umask, inv = ops.init_node(seeds, smask, capacity=node_cap)
+  frontier, fidx, fmask = uniq, jnp.arange(b, dtype=jnp.int32), umask
+  rows, cols, edges, emasks = [], [], [], []
+  nodes_per_hop = [state.num_nodes]
+  edges_per_hop = []
+  for i, k in enumerate(fanouts):
+    nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
+                               hop_keys[i], nparts, with_edge, weighted)
+    state, out = ops.induce_next(state, fidx, nbrs, m)
+    rows.append(out['cols'])   # message direction: neighbor -> seed
+    cols.append(out['rows'])
+    emasks.append(out['edge_mask'])
+    if with_edge:
+      edges.append(jnp.where(out['edge_mask'], e.reshape(-1), -1))
+    nodes_per_hop.append(out['num_new'])
+    edges_per_hop.append(out['edge_mask'].sum())
+    nxt = caps[i + 1]
+    frontier = out['frontier'][:nxt]
+    fidx = out['frontier_idx'][:nxt]
+    fmask = out['frontier_mask'][:nxt]
+  if not fanouts:
+    rows = [jnp.zeros((0,), jnp.int32)]
+    cols = [jnp.zeros((0,), jnp.int32)]
+    emasks = [jnp.zeros((0,), bool)]
+    edges_per_hop = [jnp.asarray(0, jnp.int32)]
+    if with_edge:
+      edges = [jnp.zeros((0,), jnp.int64)]
+  res = dict(
+      node=state.nodes, num_nodes=state.num_nodes,
+      row=jnp.concatenate(rows),
+      col=jnp.concatenate(cols),
+      edge_mask=jnp.concatenate(emasks),
+      seed_inverse=inv,
+      num_sampled_nodes=jnp.stack(nodes_per_hop),
+      num_sampled_edges=jnp.stack(edges_per_hop))
+  if with_edge:
+    res['edge'] = jnp.concatenate(edges)
+  return res
+
+
+def _lift(res):
+  """Add the per-shard leading axis shard_map's P('g') out_specs expect."""
+  import jax
+  return jax.tree.map(lambda x: x[None], res)
+
+
 class DistNeighborSampler:
-  """Reference: dist_neighbor_sampler.py:95-744 (homogeneous path).
+  """Reference: dist_neighbor_sampler.py:95-744.
 
   Args:
     dist_graph: DistGraph (stacked sharded partitions + node_pb).
-    num_neighbors: per-hop fanouts.
+    num_neighbors: per-hop fanouts (None for pure induced subgraphs).
     mesh: jax Mesh with axis 'g' of size num_partitions.
     dist_feature: optional DistFeature for fused feature collection.
     with_edge: emit global edge ids.
+    with_weight: edge-weight-biased sampling (works in the sharded engine;
+      the reference GPU path falls back to uniform here,
+      sampler/neighbor_sampler.py:86-91).
     seed: PRNG seed.
   """
 
@@ -86,21 +162,61 @@ class DistNeighborSampler:
                dist_feature: Optional[DistFeature] = None,
                with_edge: bool = False, seed: Optional[int] = None,
                node_budget: Optional[int] = None,
-               collect_features: bool = False):
+               collect_features: bool = False,
+               with_weight: bool = False):
     import jax
     self.graph = dist_graph
     self.is_hetero = dist_graph.is_hetero
-    self.num_neighbors = (dict(num_neighbors)
-                          if isinstance(num_neighbors, dict)
-                          else list(num_neighbors))
+    if num_neighbors is None:
+      self.num_neighbors = []
+    else:
+      self.num_neighbors = (dict(num_neighbors)
+                            if isinstance(num_neighbors, dict)
+                            else list(num_neighbors))
     self.mesh = mesh
     self.dist_feature = dist_feature
     self.with_edge = with_edge
+    self.with_weight = with_weight
     self.collect_features = collect_features and dist_feature is not None
     self.node_budget = node_budget
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     self._dev = dist_graph.device_arrays(mesh)
+    if with_weight:
+      self._attach_wcum()
     self._fns = {}
+
+  def _attach_wcum(self):
+    """Upload the per-shard weighted-sampling CDF tables."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    shard = NamedSharding(self.mesh, P('g'))
+    if self.is_hetero:
+      for et, g in self.graph.sub.items():
+        if g.weights is not None:
+          self._dev[et]['wcum'] = jax.device_put(g.row_cumsum_stacked(),
+                                                 shard)
+    else:
+      self._dev['wcum'] = jax.device_put(self.graph.row_cumsum_stacked(),
+                                         shard)
+
+  def _weighted_for(self, etype=None) -> bool:
+    if not self.with_weight:
+      return False
+    if self.is_hetero:
+      return 'wcum' in self._dev[etype]
+    return 'wcum' in self._dev
+
+  def _sorted_loc_dev(self, etype=None):
+    """Lazily uploaded [P, E] segment-sorted local indices (negative
+    sampling membership table)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    key = ('#sorted', etype)
+    if key not in self._dev:
+      g = self.graph.sub[etype] if etype is not None else self.graph
+      shard = NamedSharding(self.mesh, P('g'))
+      self._dev[key] = jax.device_put(g.sorted_local_indices(), shard)
+    return self._dev[key]
 
   def _next_keys(self):
     import jax
@@ -122,16 +238,18 @@ class DistNeighborSampler:
     nn = self.num_neighbors
     return list(nn[et]) if isinstance(nn, dict) else list(nn)
 
-  def _hetero_plan(self, b: int, input_ntype):
+  def _hetero_plan(self, seed_widths: Dict):
     """Static per-hop capacity schedule (mirror of the single-machine
-    sampler's plan, sampler/neighbor_sampler.py hetero path)."""
+    sampler's plan, sampler/neighbor_sampler.py hetero path), generalized
+    to multi-type seed sets (link sampling seeds both endpoint types)."""
     g = self.graph
     etypes = g.etypes
     edge_dir = g.edge_dir
     num_hops = max(len(self._etype_fanouts(et)) for et in etypes)
     ntypes = g.ntypes
     frontier_cap = {t: 0 for t in ntypes}
-    frontier_cap[input_ntype] = b
+    for t, w in seed_widths.items():
+      frontier_cap[t] = w
     node_caps = dict(frontier_cap)
     hop_caps = []
     for hop in range(num_hops):
@@ -160,6 +278,144 @@ class DistNeighborSampler:
 
   def _build_fn(self, b: int):
     import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    nparts = self.graph.num_partitions
+    fanouts = tuple(self.num_neighbors)
+    caps = self._capacities(b)
+    node_cap = sum(caps)
+    with_edge = self.with_edge
+    weighted = self._weighted_for()
+
+    def body(row_ids, indptr, indices, eids, wcum, pb, seeds, smask, keys):
+      gdev = dict(row_ids=row_ids[0], indptr=indptr[0],
+                  indices=indices[0], eids=eids[0])
+      if weighted:
+        gdev['wcum'] = wcum[0]
+      res = _homo_hop_loop(gdev, pb, seeds[0], smask[0], keys[0], fanouts,
+                           caps, node_cap, nparts, with_edge, weighted)
+      return _lift(res)
+
+    out_specs = dict(node=P('g'), num_nodes=P('g'), row=P('g'),
+                     col=P('g'), edge_mask=P('g'), seed_inverse=P('g'),
+                     num_sampled_nodes=P('g'), num_sampled_edges=P('g'))
+    if with_edge:
+      out_specs['edge'] = P('g')
+    fn = shard_map(
+        body, mesh=self.mesh,
+        in_specs=(P('g'), P('g'), P('g'), P('g'), P('g'), P(), P('g'),
+                  P('g'), P('g')),
+        out_specs=out_specs)
+    jfn = jax.jit(fn)
+    d = self._dev
+
+    def run(seeds, smask, keys):
+      return jfn(d['row_ids'], d['indptr'], d['indices'], d['eids'],
+                 d.get('wcum', d['eids']), d['node_pb'], seeds, smask,
+                 keys)
+
+    return run
+
+  # ----------------------------------------------------------- link build
+
+  def _build_link_fn(self, b: int, num_neg: int, mode: str):
+    """Distributed sample_from_edges program (reference:
+    dist_neighbor_sampler.py:369-496 homo branch): shard-local negatives
+    + seed union + multi-hop engine + label-index metadata, all inside
+    one SPMD program."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    nparts = self.graph.num_partitions
+    fanouts = tuple(self.num_neighbors)
+    with_edge = self.with_edge
+    weighted = self._weighted_for()
+    edge_dir = self.graph.edge_dir
+    num_nodes = self.graph.num_nodes
+    if mode == 'none':
+      width = 2 * b
+    elif mode == 'binary':
+      width = 2 * b + 2 * num_neg
+    else:  # triplet
+      width = 2 * b + num_neg
+    caps = self._capacities(width)
+    node_cap = sum(caps)
+
+    def body(row_ids, indptr, indices, eids, wcum, sorted_loc, pb,
+             rows, cols, smask, keys):
+      gdev = dict(row_ids=row_ids[0], indptr=indptr[0],
+                  indices=indices[0], eids=eids[0])
+      if weighted:
+        gdev['wcum'] = wcum[0]
+      rows_, cols_, sm, key = rows[0], cols[0], smask[0], keys[0]
+      kneg, kloop = jax.random.split(key)
+      if mode == 'none':
+        seeds = jnp.concatenate([rows_, cols_])
+        seed_mask = jnp.concatenate([sm, sm])
+      else:
+        nr, nc, nvalid = ops.random_negative_sample_local(
+            gdev['row_ids'], gdev['indptr'], sorted_loc[0], num_nodes,
+            num_neg, kneg)
+        # CSR key side vs user-facing (src, dst): flip for CSC ('in')
+        neg_src, neg_dst = (nr, nc) if edge_dir == 'out' else (nc, nr)
+        if mode == 'binary':
+          seeds = jnp.concatenate([rows_, cols_, neg_src, neg_dst])
+          seed_mask = jnp.concatenate([sm, sm, nvalid, nvalid])
+        else:
+          seeds = jnp.concatenate([rows_, cols_, neg_dst])
+          seed_mask = jnp.concatenate([sm, sm, nvalid])
+      res = _homo_hop_loop(gdev, pb, seeds, seed_mask, kloop, fanouts,
+                           caps, node_cap, nparts, with_edge, weighted)
+      inv = res['seed_inverse']
+      if mode == 'none':
+        res['edge_label_index'] = jnp.stack([inv[:b], inv[b:2 * b]])
+      elif mode == 'binary':
+        src = jnp.concatenate([inv[:b], inv[2 * b:2 * b + num_neg]])
+        dst = jnp.concatenate([inv[b:2 * b],
+                               inv[2 * b + num_neg:2 * b + 2 * num_neg]])
+        res['edge_label_index'] = jnp.stack([src, dst])
+      else:
+        res['src_index'] = inv[:b]
+        res['dst_pos_index'] = inv[b:2 * b]
+        res['dst_neg_index'] = inv[2 * b:2 * b + num_neg]
+      return _lift(res)
+
+    out_keys = ['node', 'num_nodes', 'row', 'col', 'edge_mask',
+                'seed_inverse', 'num_sampled_nodes', 'num_sampled_edges']
+    if with_edge:
+      out_keys.append('edge')
+    if mode in ('none', 'binary'):
+      out_keys.append('edge_label_index')
+    else:
+      out_keys += ['src_index', 'dst_pos_index', 'dst_neg_index']
+    out_specs = {k: P('g') for k in out_keys}
+    fn = shard_map(
+        body, mesh=self.mesh,
+        in_specs=(P('g'),) * 6 + (P(),) + (P('g'),) * 4,
+        out_specs=out_specs)
+    jfn = jax.jit(fn)
+    d = self._dev
+
+    def run(rows, cols, smask, keys):
+      sorted_loc = (self._sorted_loc_dev() if mode != 'none'
+                    else d['eids'])
+      return jfn(d['row_ids'], d['indptr'], d['indices'], d['eids'],
+                 d.get('wcum', d['eids']), sorted_loc, d['node_pb'],
+                 rows, cols, smask, keys)
+
+    return run
+
+  # ------------------------------------------------------- subgraph build
+
+  def _build_subgraph_fn(self, b: int, max_degree: int):
+    """Distributed induced-subgraph program (reference: _subgraph,
+    dist_neighbor_sampler.py:499-559): optional hop expansion, then
+    all_gather the node set, extract local induced edges per shard, and
+    all_to_all the relabeled results back to the owning shard."""
+    import jax
     import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -169,54 +425,62 @@ class DistNeighborSampler:
     caps = self._capacities(b)
     node_cap = sum(caps)
     with_edge = self.with_edge
+    weighted = self._weighted_for()
 
     def body(row_ids, indptr, indices, eids, pb, seeds, smask, keys):
       gdev = dict(row_ids=row_ids[0], indptr=indptr[0],
                   indices=indices[0], eids=eids[0])
-      seeds, smask, key = seeds[0], smask[0], keys[0]
-      hop_keys = jax.random.split(key, len(fanouts))
-      state, uniq, umask, inv = ops.init_node(seeds, smask,
+      seeds_, sm, key = seeds[0], smask[0], keys[0]
+      node_buf, nvalid = seeds_, sm
+      if fanouts:
+        hop_keys = jax.random.split(key, len(fanouts))
+        state, uniq, umask, _ = ops.init_node(seeds_, sm,
                                               capacity=node_cap)
-      frontier, fidx, fmask = uniq, jnp.arange(b, dtype=jnp.int32), umask
-      rows, cols, edges, emasks = [], [], [], []
-      nodes_per_hop = [state.num_nodes]
-      edges_per_hop = []
-      for i, k in enumerate(fanouts):
-        nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
-                                   hop_keys[i], nparts, with_edge)
-        state, out = ops.induce_next(state, fidx, nbrs, m)
-        rows.append(out['cols'])   # message direction: neighbor -> seed
-        cols.append(out['rows'])
-        emasks.append(out['edge_mask'])
-        if with_edge:
-          edges.append(jnp.where(out['edge_mask'], e.reshape(-1), -1))
-        nodes_per_hop.append(out['num_new'])
-        edges_per_hop.append(out['edge_mask'].sum())
-        nxt = caps[i + 1]
-        frontier = out['frontier'][:nxt]
-        fidx = out['frontier_idx'][:nxt]
-        fmask = out['frontier_mask'][:nxt]
-      res = dict(
-          node=state.nodes[None], num_nodes=state.num_nodes[None],
-          row=jnp.concatenate(rows)[None],
-          col=jnp.concatenate(cols)[None],
-          edge_mask=jnp.concatenate(emasks)[None],
-          seed_inverse=inv[None],
-          num_sampled_nodes=jnp.stack(nodes_per_hop)[None],
-          num_sampled_edges=jnp.stack(edges_per_hop)[None])
+        frontier = uniq
+        fidx = jnp.arange(b, dtype=jnp.int32)
+        fmask = umask
+        for i, k in enumerate(fanouts):
+          nbrs, m, _ = _exchange_hop(gdev, pb, frontier, fmask, k,
+                                     hop_keys[i], nparts, False, weighted)
+          state, out = ops.induce_next(state, fidx, nbrs, m)
+          nxt = caps[i + 1]
+          frontier = out['frontier'][:nxt]
+          fidx = out['frontier_idx'][:nxt]
+          fmask = out['frontier_mask'][:nxt]
+        node_buf = state.nodes
+        nvalid = jnp.arange(node_cap) < state.num_nodes
+      nodes, num_nodes, _ = ops.masked_unique(node_buf, nvalid,
+                                              size=node_cap)
+      big = jnp.iinfo(nodes.dtype).max
+      nkeys = jnp.where(jnp.arange(node_cap) < num_nodes, nodes, big)
+      all_keys = jax.lax.all_gather(nkeys, 'g')          # [P, cap]
+      sub = jax.vmap(lambda nk: ops.node_subgraph_local(
+          gdev['row_ids'], gdev['indptr'], gdev['indices'], nk,
+          max_degree))(all_keys)
+      r = jax.lax.all_to_all(sub['rows'], 'g', 0, 0).reshape(-1)
+      c = jax.lax.all_to_all(sub['cols'], 'g', 0, 0).reshape(-1)
+      em = jax.lax.all_to_all(sub['edge_mask'], 'g', 0, 0).reshape(-1)
+      res = dict(node=nodes, num_nodes=num_nodes, row=r, col=c,
+                 edge_mask=em,
+                 num_edges=em.sum().astype(jnp.int32))
       if with_edge:
-        res['edge'] = jnp.concatenate(edges)[None]
-      return res
+        e = jnp.where(sub['edge_mask'],
+                      gdev['eids'][sub['epos']], -1)
+        res['edge'] = jax.lax.all_to_all(e, 'g', 0, 0).reshape(-1)
+      # seed positions in the deduped node set
+      spos = jnp.clip(jnp.searchsorted(nkeys, seeds_), 0, node_cap - 1)
+      res['mapping'] = jnp.where(sm & (nkeys[spos] == seeds_),
+                                 spos.astype(jnp.int32), -1)
+      return _lift(res)
 
-    out_specs = dict(node=P('g'), num_nodes=P('g'), row=P('g'),
-                     col=P('g'), edge_mask=P('g'), seed_inverse=P('g'),
-                     num_sampled_nodes=P('g'), num_sampled_edges=P('g'))
+    out_keys = ['node', 'num_nodes', 'row', 'col', 'edge_mask',
+                'num_edges', 'mapping']
     if with_edge:
-      out_specs['edge'] = P('g')
+      out_keys.append('edge')
+    out_specs = {k: P('g') for k in out_keys}
     fn = shard_map(
         body, mesh=self.mesh,
-        in_specs=(P('g'), P('g'), P('g'), P('g'), P(), P('g'), P('g'),
-                  P('g')),
+        in_specs=(P('g'),) * 4 + (P(),) + (P('g'),) * 3,
         out_specs=out_specs)
     jfn = jax.jit(fn)
     d = self._dev
@@ -227,155 +491,298 @@ class DistNeighborSampler:
 
     return run
 
-  # ------------------------------------------------------- hetero build fn
+  # ------------------------------------------------------- hetero engine
 
-  def _build_hetero_fn(self, b: int, input_ntype):
-    """Typed shard_map engine: per-hop, per-edge-type route -> all_to_all
-    -> local sample -> all_to_all back -> per-node-type induce.
+  def _hetero_engine(self, garr, pbs, seed_arrays, key, plan):
+    """Typed multi-hop engine body (traced inside shard_map): per-hop,
+    per-edge-type route -> all_to_all -> local sample -> all_to_all back
+    -> per-node-type induce.
 
     Reference: dist_neighbor_sampler.py:287-319 (hetero hop fan-out via
     asyncio tasks per etype + RPC); here each etype's exchange is a pair
     of collectives inside ONE jitted SPMD program.
+
+    Args:
+      seed_arrays: ordered {ntype: (seeds [w], mask [w])} traced arrays.
+      plan: (num_hops, hop_caps, node_caps) from _hetero_plan.
+
+    Returns (res dict — per-shard, unwrapped — and inv_dict per seed
+    ntype).
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
     g = self.graph
     nparts = g.num_partitions
     etypes = list(g.etypes)
     ntypes = list(g.ntypes)
     edge_dir = g.edge_dir
     with_edge = self.with_edge
-    num_hops, hop_caps, node_caps = self._hetero_plan(b, input_ntype)
+    num_hops, hop_caps, node_caps = plan
     out_et_of = {et: (reverse_edge_type(et) if edge_dir == 'out' else et)
                  for et in etypes}
 
-    def body(*flat_args):
-      # unflatten: 4 arrays per etype, then per-ntype pbs, seeds, mask, key
-      i = 0
-      garr = {}
-      for et in etypes:
-        garr[et] = dict(row_ids=flat_args[i][0], indptr=flat_args[i + 1][0],
-                        indices=flat_args[i + 2][0],
-                        eids=flat_args[i + 3][0])
-        i += 4
-      pbs = {}
-      for nt in ntypes:
-        pbs[nt] = flat_args[i]
-        i += 1
-      seeds, smask, key = (flat_args[i][0], flat_args[i + 1][0],
-                           flat_args[i + 2][0])
+    states, frontier, inv_dict = {}, {}, {}
+    for t in ntypes:
+      if node_caps[t] == 0:
+        continue
+      if t in seed_arrays:
+        s, m = seed_arrays[t]
+        states[t], uniq, umask, inv_dict[t] = ops.init_node(
+            s, m, capacity=node_caps[t])
+        frontier[t] = (uniq, jnp.arange(s.shape[0], dtype=jnp.int32),
+                       umask)
+      else:
+        states[t] = ops.init_empty(node_caps[t])
 
-      states = {}
+    rows, cols, edges, emasks = {}, {}, {}, {}
+    nodes_per_hop = {t: [states[t].num_nodes if t in states
+                         else jnp.asarray(0, jnp.int32)] for t in ntypes}
+    edges_per_hop = {}
+    keys = jax.random.split(key, max(1, num_hops * max(1, len(etypes))))
+    ki = 0
+    for hop in range(num_hops):
+      new_parts = {t: [] for t in ntypes}
+      for et, (fcap, k) in hop_caps[hop].items():
+        key_t = et[0] if edge_dir == 'out' else et[2]
+        res_t = et[2] if edge_dir == 'out' else et[0]
+        out_et = out_et_of[et]
+        f, fidx, fmask = frontier[key_t]
+        f, fidx, fmask = f[:fcap], fidx[:fcap], fmask[:fcap]
+        nbrs, m, e = _exchange_hop(garr[et], pbs[key_t], f, fmask, k,
+                                   keys[ki], nparts, with_edge,
+                                   self._weighted_for(et))
+        ki += 1
+        states[res_t], iout = ops.induce_next(states[res_t], fidx, nbrs,
+                                              m)
+        rows.setdefault(out_et, []).append(iout['cols'])
+        cols.setdefault(out_et, []).append(iout['rows'])
+        emasks.setdefault(out_et, []).append(iout['edge_mask'])
+        if with_edge:
+          edges.setdefault(out_et, []).append(
+              jnp.where(iout['edge_mask'], e.reshape(-1), -1))
+        edges_per_hop.setdefault(out_et, []).append(
+            iout['edge_mask'].sum())
+        new_parts[res_t].append((iout['frontier'], iout['frontier_idx'],
+                                 iout['frontier_mask']))
       for t in ntypes:
-        if node_caps[t] == 0:
+        parts = new_parts[t]
+        if not parts:
+          frontier[t] = (jnp.zeros((0,), jnp.int32),
+                         jnp.zeros((0,), jnp.int32),
+                         jnp.zeros((0,), bool))
+          nodes_per_hop[t].append(jnp.asarray(0, jnp.int32))
           continue
-        if t == input_ntype:
-          states[t], uniq, umask, inv = ops.init_node(
-              seeds, smask, capacity=node_caps[t])
-        else:
-          states[t] = ops.init_empty(node_caps[t])
-      frontier = {input_ntype: (uniq, jnp.arange(b, dtype=jnp.int32),
-                                umask)}
+        frontier[t] = (jnp.concatenate([p[0] for p in parts]),
+                       jnp.concatenate([p[1] for p in parts]),
+                       jnp.concatenate([p[2] for p in parts]))
+        nodes_per_hop[t].append(frontier[t][2].sum().astype(jnp.int32))
 
-      rows, cols, edges, emasks = {}, {}, {}, {}
-      nodes_per_hop = {t: [states[t].num_nodes if t in states
-                           else jnp.asarray(0, jnp.int32)] for t in ntypes}
-      edges_per_hop = {}
-      keys = jax.random.split(key, num_hops * max(1, len(etypes)))
-      ki = 0
-      for hop in range(num_hops):
-        new_parts = {t: [] for t in ntypes}
-        for et, (fcap, k) in hop_caps[hop].items():
-          key_t = et[0] if edge_dir == 'out' else et[2]
-          res_t = et[2] if edge_dir == 'out' else et[0]
-          out_et = out_et_of[et]
-          f, fidx, fmask = frontier[key_t]
-          f, fidx, fmask = f[:fcap], fidx[:fcap], fmask[:fcap]
-          nbrs, m, e = _exchange_hop(garr[et], pbs[key_t], f, fmask, k,
-                                     keys[ki], nparts, with_edge)
-          ki += 1
-          states[res_t], iout = ops.induce_next(states[res_t], fidx, nbrs,
-                                                m)
-          rows.setdefault(out_et, []).append(iout['cols'])
-          cols.setdefault(out_et, []).append(iout['rows'])
-          emasks.setdefault(out_et, []).append(iout['edge_mask'])
-          if with_edge:
-            edges.setdefault(out_et, []).append(
-                jnp.where(iout['edge_mask'], e.reshape(-1), -1))
-          edges_per_hop.setdefault(out_et, []).append(
-              iout['edge_mask'].sum())
-          new_parts[res_t].append((iout['frontier'], iout['frontier_idx'],
-                                   iout['frontier_mask']))
-        for t in ntypes:
-          parts = new_parts[t]
-          if not parts:
-            frontier[t] = (jnp.zeros((0,), jnp.int32),
-                           jnp.zeros((0,), jnp.int32),
-                           jnp.zeros((0,), bool))
-            nodes_per_hop[t].append(jnp.asarray(0, jnp.int32))
-            continue
-          frontier[t] = (jnp.concatenate([p[0] for p in parts]),
-                         jnp.concatenate([p[1] for p in parts]),
-                         jnp.concatenate([p[2] for p in parts]))
-          nodes_per_hop[t].append(frontier[t][2].sum().astype(jnp.int32))
+    res = dict(
+        node={t: s.nodes for t, s in states.items()},
+        num_nodes={t: s.num_nodes for t, s in states.items()},
+        row={et: jnp.concatenate(v) for et, v in rows.items()},
+        col={et: jnp.concatenate(v) for et, v in cols.items()},
+        edge_mask={et: jnp.concatenate(v) for et, v in emasks.items()},
+        num_sampled_nodes={t: jnp.stack(v)
+                           for t, v in nodes_per_hop.items()},
+        num_sampled_edges={et: jnp.stack(v)
+                           for et, v in edges_per_hop.items()})
+    if with_edge:
+      res['edge'] = {et: jnp.concatenate(v) for et, v in edges.items()}
+    return res, inv_dict
 
-      res = dict(
-          node={t: s.nodes[None] for t, s in states.items()},
-          num_nodes={t: s.num_nodes[None] for t, s in states.items()},
-          row={et: jnp.concatenate(v)[None] for et, v in rows.items()},
-          col={et: jnp.concatenate(v)[None] for et, v in cols.items()},
-          edge_mask={et: jnp.concatenate(v)[None]
-                     for et, v in emasks.items()},
-          num_sampled_nodes={t: jnp.stack(v)[None]
-                             for t, v in nodes_per_hop.items()},
-          num_sampled_edges={et: jnp.stack(v)[None]
-                             for et, v in edges_per_hop.items()},
-          seed_inverse=inv[None])
-      if with_edge:
-        res['edge'] = {et: jnp.concatenate(v)[None]
-                       for et, v in edges.items()}
-      return res
-
-    n_args = 4 * len(etypes) + len(ntypes) + 3
-    in_specs = tuple([P('g')] * (4 * len(etypes)) + [P()] * len(ntypes) +
-                     [P('g'), P('g'), P('g')])
-    # out_specs must mirror the result pytree with P('g') everywhere
-    out_specs = dict(
-        node={t: P('g') for t in ntypes if node_caps[t] > 0},
-        num_nodes={t: P('g') for t in ntypes if node_caps[t] > 0},
-        row={}, col={}, edge_mask={}, num_sampled_nodes={},
-        num_sampled_edges={}, seed_inverse=P('g'))
+  def _hetero_out_specs(self, seed_widths, with_extra=()):
+    """out_specs pytree mirroring _hetero_engine's result dict."""
+    from jax.sharding import PartitionSpec as P
+    g = self.graph
+    _, hop_caps, node_caps = self._hetero_plan(seed_widths)
+    edge_dir = g.edge_dir
+    out_et_of = {et: (reverse_edge_type(et) if edge_dir == 'out' else et)
+                 for et in g.etypes}
     touched = []
     for hop in hop_caps:
       for et in hop:
         if out_et_of[et] not in touched:
           touched.append(out_et_of[et])
+    out_specs = dict(
+        node={t: P('g') for t in g.ntypes if node_caps[t] > 0},
+        num_nodes={t: P('g') for t in g.ntypes if node_caps[t] > 0},
+        row={}, col={}, edge_mask={}, num_sampled_nodes={},
+        num_sampled_edges={})
     for oet in touched:
       for k in ('row', 'col', 'edge_mask', 'num_sampled_edges'):
         out_specs[k][oet] = P('g')
-    out_specs['num_sampled_nodes'] = {t: P('g') for t in ntypes}
-    if with_edge:
+    out_specs['num_sampled_nodes'] = {t: P('g') for t in g.ntypes}
+    if self.with_edge:
       out_specs['edge'] = {oet: P('g') for oet in touched}
+    for k in with_extra:
+      out_specs[k] = P('g')
+    return out_specs
 
-    fn = shard_map(body, mesh=self.mesh, in_specs=in_specs,
+  def _hetero_graph_args(self):
+    """(flat device args, unflatten) for the per-etype CSRs + per-ntype
+    partition books feeding a hetero shard_map program."""
+    d = self._dev
+    etypes = list(self.graph.etypes)
+    ntypes = list(self.graph.ntypes)
+    args = []
+    for et in etypes:
+      ga = d[et]
+      args.extend([ga['row_ids'], ga['indptr'], ga['indices'],
+                   ga['eids'],
+                   ga.get('wcum', ga['eids'])])
+    for nt in ntypes:
+      args.append(d['#pb'][nt])
+    return args
+
+  def _unpack_hetero_graph(self, flat_args):
+    etypes = list(self.graph.etypes)
+    ntypes = list(self.graph.ntypes)
+    i = 0
+    garr = {}
+    for et in etypes:
+      garr[et] = dict(row_ids=flat_args[i][0], indptr=flat_args[i + 1][0],
+                      indices=flat_args[i + 2][0],
+                      eids=flat_args[i + 3][0])
+      if self._weighted_for(et):
+        garr[et]['wcum'] = flat_args[i + 4][0]
+      i += 5
+    pbs = {}
+    for nt in ntypes:
+      pbs[nt] = flat_args[i]
+      i += 1
+    return garr, pbs, i
+
+  def _hetero_in_specs(self, n_tail: int):
+    from jax.sharding import PartitionSpec as P
+    n_et = len(self.graph.etypes)
+    n_nt = len(self.graph.ntypes)
+    return tuple([P('g')] * (5 * n_et) + [P()] * n_nt +
+                 [P('g')] * n_tail)
+
+  # ------------------------------------------------------- hetero build fn
+
+  def _build_hetero_fn(self, b: int, input_ntype):
+    import jax
+    from jax import shard_map
+
+    plan = self._hetero_plan({input_ntype: b})
+
+    def body(*flat_args):
+      garr, pbs, i = self._unpack_hetero_graph(flat_args)
+      seeds, smask, key = (flat_args[i][0], flat_args[i + 1][0],
+                           flat_args[i + 2][0])
+      res, inv_dict = self._hetero_engine(
+          garr, pbs, {input_ntype: (seeds, smask)}, key, plan)
+      res['seed_inverse'] = inv_dict[input_ntype]
+      return _lift(res)
+
+    out_specs = self._hetero_out_specs({input_ntype: b},
+                                       with_extra=('seed_inverse',))
+    fn = shard_map(body, mesh=self.mesh,
+                   in_specs=self._hetero_in_specs(3),
                    out_specs=out_specs)
     jfn = jax.jit(fn)
-    d = self._dev
 
     def run(seeds, smask, keys):
-      args = []
-      for et in etypes:
-        ga = d[et]
-        args.extend([ga['row_ids'], ga['indptr'], ga['indices'],
-                     ga['eids']])
-      for nt in ntypes:
-        args.append(d['#pb'][nt])
-      args.extend([seeds, smask, keys])
-      assert len(args) == n_args
-      return jfn(*args)
+      return jfn(*self._hetero_graph_args(), seeds, smask, keys)
+
+    return run
+
+  # -------------------------------------------------- hetero link build fn
+
+  def _build_hetero_link_fn(self, b: int, num_neg: int, mode: str, etype):
+    """Distributed hetero sample_from_edges (reference:
+    dist_neighbor_sampler.py:424-474): typed seed sets for both endpoint
+    types (+ shard-local negatives against the seed edge type's CSR),
+    multi-type engine, per-type label-index metadata."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    g = self.graph
+    src_t, _, dst_t = etype
+    edge_dir = g.edge_dir
+    # the candidate ids drawn against the CSR's column side belong to the
+    # NON-key endpoint type: dst for CSR ('out'), src for CSC ('in') —
+    # parity with the single-machine num_other derivation
+    # (sampler/neighbor_sampler.py:570-574)
+    num_other = g.num_nodes(dst_t if edge_dir == 'out' else src_t)
+    # seed widths per endpoint type
+    if mode == 'binary':
+      ws, wd = b + num_neg, b + num_neg
+    elif mode == 'triplet':
+      ws, wd = b, b + num_neg
+    else:
+      ws, wd = b, b
+    if src_t == dst_t:
+      seed_widths = {src_t: ws + wd}
+    else:
+      seed_widths = {src_t: ws, dst_t: wd}
+    plan = self._hetero_plan(seed_widths)
+
+    def body(*flat_args):
+      garr, pbs, i = self._unpack_hetero_graph(flat_args)
+      sorted_loc = flat_args[i][0]
+      rows_, cols_, sm, key = (flat_args[i + 1][0], flat_args[i + 2][0],
+                               flat_args[i + 3][0], flat_args[i + 4][0])
+      kneg, kloop = jax.random.split(key)
+      if mode == 'none':
+        src_seeds, src_m = rows_, sm
+        dst_seeds, dst_m = cols_, sm
+      else:
+        gd = garr[etype]
+        nr, nc, nvalid = ops.random_negative_sample_local(
+            gd['row_ids'], gd['indptr'], sorted_loc, num_other, num_neg,
+            kneg)
+        neg_src, neg_dst = (nr, nc) if edge_dir == 'out' else (nc, nr)
+        if mode == 'binary':
+          src_seeds = jnp.concatenate([rows_, neg_src])
+          src_m = jnp.concatenate([sm, nvalid])
+          dst_seeds = jnp.concatenate([cols_, neg_dst])
+          dst_m = jnp.concatenate([sm, nvalid])
+        else:
+          src_seeds, src_m = rows_, sm
+          dst_seeds = jnp.concatenate([cols_, neg_dst])
+          dst_m = jnp.concatenate([sm, nvalid])
+      if src_t == dst_t:
+        seed_arrays = {src_t: (jnp.concatenate([src_seeds, dst_seeds]),
+                               jnp.concatenate([src_m, dst_m]))}
+      else:
+        seed_arrays = {src_t: (src_seeds, src_m),
+                       dst_t: (dst_seeds, dst_m)}
+      res, inv_dict = self._hetero_engine(garr, pbs, seed_arrays, kloop,
+                                          plan)
+      if src_t == dst_t:
+        inv = inv_dict[src_t]
+        inv_src, inv_dst = inv[:ws], inv[ws:ws + wd]
+      else:
+        inv_src, inv_dst = inv_dict[src_t], inv_dict[dst_t]
+      if mode in ('none', 'binary'):
+        res['edge_label_index'] = jnp.stack(
+            [jnp.concatenate([inv_src[:b], inv_src[b:b + num_neg]])
+             if mode == 'binary' else inv_src[:b],
+             jnp.concatenate([inv_dst[:b], inv_dst[b:b + num_neg]])
+             if mode == 'binary' else inv_dst[:b]])
+      else:
+        res['src_index'] = inv_src[:b]
+        res['dst_pos_index'] = inv_dst[:b]
+        res['dst_neg_index'] = inv_dst[b:b + num_neg]
+      return _lift(res)
+
+    extra = (('edge_label_index',) if mode in ('none', 'binary')
+             else ('src_index', 'dst_pos_index', 'dst_neg_index'))
+    out_specs = self._hetero_out_specs(seed_widths, with_extra=extra)
+    fn = shard_map(body, mesh=self.mesh,
+                   in_specs=self._hetero_in_specs(5),
+                   out_specs=out_specs)
+    jfn = jax.jit(fn)
+
+    def run(rows, cols, smask, keys):
+      sorted_loc = (self._sorted_loc_dev(etype) if mode != 'none'
+                    else self._dev[etype]['eids'])
+      return jfn(*self._hetero_graph_args(), sorted_loc, rows, cols,
+                 smask, keys)
 
     return run
 
@@ -445,6 +852,137 @@ class DistNeighborSampler:
         num_sampled_edges=res['num_sampled_edges'],
         metadata={'seed_inverse': res['seed_inverse'],
                   'seed_mask': jnp.asarray(smask)})
+
+  def sample_from_edges(self, inputs: EdgeSamplerInput, seed_mask=None,
+                        **kwargs):
+    """Distributed link sampling: seed edges [P, B] per shard (reference:
+    _sample_from_edges, dist_neighbor_sampler.py:369-496).
+
+    Negatives are shard-local (non-strict — the reference's distributed
+    negative sampling likewise cannot see remote positives, :380-383).
+    Metadata carries edge_label_index/edge_label (binary) or
+    src/dst_pos/dst_neg indices (triplet), per shard.
+    """
+    import jax.numpy as jnp
+    etype = inputs.input_type
+    rows = np.asarray(inputs.row)
+    cols = np.asarray(inputs.col)
+    p = self.graph.num_partitions
+    if rows.ndim == 1:
+      assert rows.shape[0] % p == 0, 'flat seed edges must split evenly'
+      rows = rows.reshape(p, -1)
+      cols = cols.reshape(p, -1)
+    b = rows.shape[1]
+    smask = (np.ones_like(rows, bool) if seed_mask is None
+             else np.asarray(seed_mask).reshape(rows.shape))
+    neg = inputs.neg_sampling
+    mode = 'none' if neg is None else neg.mode
+    num_neg = 0 if neg is None else neg.num_negatives(b)
+
+    if self.is_hetero:
+      assert etype is not None, 'hetero link sampling requires input_type'
+      sig = ('hlink', b, num_neg, mode, etype)
+      if sig not in self._fns:
+        self._fns[sig] = self._build_hetero_link_fn(b, num_neg, mode,
+                                                    etype)
+      res = self._fns[sig](jnp.asarray(rows, jnp.int32),
+                           jnp.asarray(cols, jnp.int32),
+                           jnp.asarray(smask), self._next_keys())
+      out = HeteroSamplerOutput(
+          node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
+          col=res['col'], edge=res.get('edge'),
+          edge_mask=res['edge_mask'],
+          batch=None, batch_size=b,
+          num_sampled_nodes=res['num_sampled_nodes'],
+          num_sampled_edges=res['num_sampled_edges'],
+          input_type=etype, metadata={'seed_mask': jnp.asarray(smask)})
+    else:
+      sig = ('link', b, num_neg, mode)
+      if sig not in self._fns:
+        self._fns[sig] = self._build_link_fn(b, num_neg, mode)
+      res = self._fns[sig](jnp.asarray(rows, jnp.int32),
+                           jnp.asarray(cols, jnp.int32),
+                           jnp.asarray(smask), self._next_keys())
+      out = SamplerOutput(
+          node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
+          col=res['col'], edge=res.get('edge'),
+          edge_mask=res['edge_mask'],
+          batch=jnp.stack([jnp.asarray(rows), jnp.asarray(cols)], axis=1),
+          batch_size=b,
+          num_sampled_nodes=res['num_sampled_nodes'],
+          num_sampled_edges=res['num_sampled_edges'],
+          metadata={'seed_inverse': res['seed_inverse'],
+                    'seed_mask': jnp.asarray(smask)})
+
+    if mode in ('none', 'binary'):
+      label = (jnp.asarray(np.asarray(inputs.label).reshape(p, b))
+               if inputs.label is not None
+               else jnp.ones((p, b), jnp.int32))
+      if mode == 'binary':
+        label = jnp.concatenate(
+            [label, jnp.zeros((p, num_neg), label.dtype)], axis=1)
+      out.metadata['edge_label'] = label
+      out.metadata['edge_label_index'] = res['edge_label_index']
+    else:
+      out.metadata['src_index'] = res['src_index']
+      out.metadata['dst_pos_index'] = res['dst_pos_index']
+      out.metadata['dst_neg_index'] = res['dst_neg_index']
+    return out
+
+  def subgraph(self, inputs, seed_mask=None,
+               max_degree: Optional[int] = None, **kwargs):
+    """Distributed induced subgraph over per-shard seed blocks [P, B]
+    (reference: _subgraph, dist_neighbor_sampler.py:499-559; hetero
+    unsupported there too — :505 raises NotImplementedError).
+    """
+    import jax.numpy as jnp
+    if self.is_hetero:
+      raise NotImplementedError(
+          'hetero distributed subgraph sampling (reference parity: '
+          'dist_neighbor_sampler.py:505 raises NotImplementedError)')
+    if isinstance(inputs, NodeSamplerInput):
+      raw = inputs.node
+    else:
+      raw = inputs
+    seeds = np.asarray(raw)
+    p = self.graph.num_partitions
+    if seeds.ndim == 1:
+      assert seeds.shape[0] % p == 0, 'flat seeds must split evenly'
+      seeds = seeds.reshape(p, -1)
+    b = seeds.shape[1]
+    smask = (np.ones_like(seeds, bool) if seed_mask is None
+             else np.asarray(seed_mask).reshape(seeds.shape))
+    if max_degree is None:
+      max_degree = self._global_max_degree()
+    node_cap = sum(self._capacities(b))
+    buf_elems = self.graph.num_partitions * node_cap * max_degree
+    if buf_elems > (1 << 25):
+      import warnings
+      warnings.warn(
+          f'distributed subgraph buffers are [P={self.graph.num_partitions}'
+          f' x node_cap={node_cap} x max_degree={max_degree}] = '
+          f'{buf_elems / 1e6:.0f}M elements per shard; on power-law '
+          'graphs pass an explicit max_degree cap (edges beyond the cap '
+          'per row are dropped) to bound HBM',
+          stacklevel=2)
+    sig = ('sub', b, max_degree)
+    if sig not in self._fns:
+      self._fns[sig] = self._build_subgraph_fn(b, max_degree)
+    res = self._fns[sig](jnp.asarray(seeds, jnp.int32),
+                         jnp.asarray(smask), self._next_keys())
+    return SamplerOutput(
+        node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
+        col=res['col'], edge=res.get('edge'), edge_mask=res['edge_mask'],
+        batch=jnp.asarray(seeds), batch_size=b,
+        num_sampled_nodes=None, num_sampled_edges=None,
+        metadata={'mapping': res['mapping'],
+                  'seed_mask': jnp.asarray(smask)})
+
+  def _global_max_degree(self) -> int:
+    if not hasattr(self, '_max_deg'):
+      self._max_deg = max(
+          1, int(np.max(np.diff(self.graph.indptr, axis=1))))
+    return self._max_deg
 
   def collate(self, out, node_labels=None):
     """Attach features (sharded all_to_all gather) and labels.

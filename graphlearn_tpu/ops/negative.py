@@ -65,3 +65,38 @@ def random_negative_sample(indptr, sorted_indices, num_src, num_dst,
   if padding:
     out_mask = jnp.ones_like(out_mask)
   return out_rows, out_cols, out_mask
+
+
+def random_negative_sample_local(row_ids, indptr_loc, sorted_indices,
+                                 num_dst: int, num_samples: int, key,
+                                 trials: int = 5):
+  """Shard-local negative sampling for the distributed engine.
+
+  Each shard draws source rows from ITS OWN partition's local CSR (the
+  reference's distributed negative sampling is likewise local-only and
+  therefore non-strict: dist_neighbor_sampler.py:380-383 "unable to fetch
+  positive edges from remote"). Candidate (local_row, dst) pairs are
+  rejected when present in the local CSR segment; survivors map to global
+  ids via ``row_ids``. Padding semantics: the output is always full.
+
+  Traced inside shard_map (no jit wrapper; the caller's program compiles
+  it). Returns (src_global [num_samples], dst [num_samples],
+  valid [num_samples]) — ``valid`` is all-False on a shard that owns zero
+  rows of this CSR (skewed partitioning of a rare edge type), so callers
+  must mask those slots out of the seed union instead of treating the
+  INT_MAX row padding as node ids.
+  """
+  num_actual = jnp.sum(row_ids != jnp.iinfo(row_ids.dtype).max
+                       ).astype(jnp.int32)
+  num_rows = jnp.maximum(num_actual, 1)
+  total = num_samples * trials
+  kr, kc = jax.random.split(key)
+  u = jax.random.randint(kr, (total,), 0, jnp.int32(2 ** 30),
+                         dtype=jnp.int32) % num_rows
+  cols = jax.random.randint(kc, (total,), 0, num_dst, dtype=jnp.int32)
+  is_edge = edge_in_csr(indptr_loc, sorted_indices, u, cols)
+  order = jnp.argsort(jnp.where(is_edge, 1, 0), stable=True)
+  take = order[:num_samples]
+  valid = jnp.broadcast_to(num_actual > 0, (num_samples,))
+  src = jnp.where(valid, row_ids[u[take]].astype(jnp.int32), -1)
+  return src, jnp.where(valid, cols[take], -1), valid

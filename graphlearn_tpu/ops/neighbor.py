@@ -147,6 +147,53 @@ def uniform_sample_local(row_ids, indptr_loc, indices, seeds, seed_mask,
   return nbrs, jnp.where(mask, epos, 0), mask
 
 
+@functools.partial(jax.jit, static_argnames=('k',))
+def weighted_sample_local(row_ids, indptr_loc, indices, row_cumsum, seeds,
+                          seed_mask, k: int, key):
+  """Edge-weight-biased fanout sampling over a *partition-local* CSR.
+
+  Distributed counterpart of :func:`weighted_sample` (the reference's GPU
+  path falls back to uniform for distributed weighted sampling,
+  sampler/neighbor_sampler.py:86-91 — here the weighted path works in the
+  sharded engine too). ``row_cumsum`` is the per-shard row-restarting
+  cumulative weight array (:func:`build_row_cumsum` over the local CSR).
+  Same output contract as :func:`uniform_sample_local`.
+  """
+  b = seeds.shape[0]
+  pos = jnp.searchsorted(row_ids, seeds)
+  pos = jnp.clip(pos, 0, row_ids.shape[0] - 1)
+  found = (row_ids[pos] == seeds) & seed_mask
+  start = indptr_loc[pos]
+  end = indptr_loc[pos + 1]
+  deg = jnp.where(found, end - start, 0)
+  end = start + deg
+  total = row_cumsum[jnp.maximum(end - 1, 0)]
+  total = jnp.where(deg > 0, total, 1.0)
+  u = jax.random.uniform(key, (b, k)) * total[:, None]
+
+  lo = jnp.broadcast_to(start[:, None], (b, k))
+  hi = jnp.broadcast_to(end[:, None], (b, k))
+
+  def body(_, carry):
+    lo, hi = carry
+    mid = (lo + hi) // 2
+    go_right = row_cumsum[jnp.clip(mid, 0, row_cumsum.shape[0] - 1)] < u
+    lo = jnp.where(go_right, mid + 1, lo)
+    hi = jnp.where(go_right, hi, mid)
+    return lo, hi
+
+  lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+  wpos = jnp.minimum(lo, jnp.maximum(end[:, None] - 1, 0))
+
+  seq_off = jnp.arange(k, dtype=start.dtype)[None, :]
+  epos = jnp.where(deg[:, None] > k, wpos, start[:, None] + seq_off)
+  mask = found[:, None] & (
+      jnp.where(deg[:, None] > k, 0, seq_off) < deg[:, None])
+  safe_epos = jnp.where(mask, epos, 0)
+  nbrs = jnp.where(mask, indices[safe_epos], FILL)
+  return nbrs, jnp.where(mask, epos, 0), mask
+
+
 def edge_in_csr(indptr, indices, rows, cols):
   """Vectorized membership test: is (rows[i], cols[i]) an edge?
 

@@ -53,3 +53,42 @@ def node_subgraph(indptr, indices, srcs, src_mask, max_degree: int):
               rows=rows.reshape(-1), cols=cols.reshape(-1),
               epos=jnp.where(member, epos, 0).reshape(-1),
               edge_mask=member.reshape(-1))
+
+
+def node_subgraph_local(row_ids, indptr_loc, indices, node_keys,
+                        max_degree: int):
+  """Induced-subgraph extraction over a *partition-local* CSR.
+
+  Distributed counterpart of :func:`node_subgraph` (reference: each
+  partition answers a subgraph RPC from its local graph,
+  dist_neighbor_sampler.py:499-559 / rpc_sample_callee). ``node_keys`` is
+  the ascending node set with padding mapped to int-max (searchsorted
+  keys); the shard finds which of those nodes it owns (binary search on
+  ``row_ids``), scans each owned row to ``max_degree``, and keeps edges
+  whose endpoint is also in the set — relabeled to positions in
+  ``node_keys``.
+
+  Traced inside shard_map. Returns dict rows/cols [B*max_degree] (-1
+  invalid), epos [B*max_degree] local CSR edge positions, edge_mask.
+  """
+  b = node_keys.shape[0]
+  big = jnp.iinfo(node_keys.dtype).max
+  node_valid = node_keys != big
+  # which set nodes does this shard own?
+  rpos = jnp.clip(jnp.searchsorted(row_ids, node_keys), 0,
+                  row_ids.shape[0] - 1)
+  owned = node_valid & (row_ids[rpos] == node_keys)
+  start = jnp.where(owned, indptr_loc[rpos], 0)
+  deg = jnp.where(owned, indptr_loc[rpos + 1] - start, 0)
+  off = jnp.arange(max_degree, dtype=start.dtype)[None, :]
+  in_row = off < deg[:, None]
+  epos = jnp.where(in_row, start[:, None] + off, 0)
+  nbr = jnp.where(in_row, indices[epos], big)
+  pos = jnp.clip(jnp.searchsorted(node_keys, nbr), 0, b - 1)
+  member = in_row & (node_keys[pos] == nbr)
+  rows = jnp.where(member, jnp.broadcast_to(
+      jnp.arange(b, dtype=jnp.int32)[:, None], (b, max_degree)), -1)
+  cols = jnp.where(member, pos.astype(jnp.int32), -1)
+  return dict(rows=rows.reshape(-1), cols=cols.reshape(-1),
+              epos=jnp.where(member, epos, 0).reshape(-1),
+              edge_mask=member.reshape(-1))

@@ -166,6 +166,285 @@ def test_dist_dataset_load_from_partition_dir(tmp_path):
     np.testing.assert_allclose(x[p, :nn, 0], node[p, :nn])
 
 
+# ------------------------------------------------------------ link + subgraph
+
+def test_dist_link_sampler_binary():
+  from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
+  num_parts = 2
+  parts, _, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2, 2], mesh, seed=0)
+  rows = np.array([[0, 4], [1, 5]], np.int32)
+  cols = (rows + 1) % N
+  out = sampler.sample_from_edges(EdgeSamplerInput(
+      rows, cols, neg_sampling=NegativeSampling('binary', 1)))
+  node = np.asarray(out.node)
+  eli = np.asarray(out.metadata['edge_label_index'])
+  label = np.asarray(out.metadata['edge_label'])
+  b = 2
+  assert eli.shape == (num_parts, 2, 2 * b)
+  for p in range(num_parts):
+    # positives relocate to the original seed pairs
+    for i in range(b):
+      assert node[p][eli[p, 0, i]] == rows[p, i]
+      assert node[p][eli[p, 1, i]] == cols[p, i]
+    # negatives: src is shard-local, and (src, dst) is a true non-edge
+    # here because each node's out-edges are all owned by its partition
+    for i in range(b, 2 * b):
+      u = int(node[p][eli[p, 0, i]])
+      v = int(node[p][eli[p, 1, i]])
+      assert v not in ((u + 1) % N, (u + 2) % N), (u, v)
+    np.testing.assert_array_equal(label[p], [1, 1, 0, 0])
+
+
+def test_dist_link_sampler_triplet():
+  from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
+  num_parts = 2
+  parts, _, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2], mesh, seed=1)
+  rows = np.array([[0, 4], [1, 5]], np.int32)
+  cols = (rows + 2) % N
+  out = sampler.sample_from_edges(EdgeSamplerInput(
+      rows, cols, neg_sampling=NegativeSampling('triplet', 2)))
+  node = np.asarray(out.node)
+  si = np.asarray(out.metadata['src_index'])
+  dp = np.asarray(out.metadata['dst_pos_index'])
+  dn = np.asarray(out.metadata['dst_neg_index'])
+  assert dn.shape == (num_parts, 4)
+  for p in range(num_parts):
+    np.testing.assert_array_equal(node[p][si[p]], rows[p])
+    np.testing.assert_array_equal(node[p][dp[p]], cols[p])
+    # negative dsts are real node ids present in the batch
+    assert (dn[p] >= 0).all()
+    assert (node[p][dn[p]] >= 0).all()
+
+
+def test_dist_hetero_link_sampler():
+  from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
+  num_parts = 2
+  parts, _, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, {et1: [2], et2: [1]}, mesh, seed=0)
+  rows = np.array([[0, 4], [1, 5]], np.int32)
+  cols = rows.copy()   # u_i -> v_i are real et1 edges
+  out = sampler.sample_from_edges(EdgeSamplerInput(
+      rows, cols, input_type=et1,
+      neg_sampling=NegativeSampling('binary', 1)))
+  nu = np.asarray(out.node['u'])
+  nv = np.asarray(out.node['v'])
+  eli = np.asarray(out.metadata['edge_label_index'])
+  for p in range(num_parts):
+    for i in range(2):
+      assert nu[p][eli[p, 0, i]] == rows[p, i]
+      assert nv[p][eli[p, 1, i]] == cols[p, i]
+  np.testing.assert_array_equal(
+      np.asarray(out.metadata['edge_label'])[0], [1, 1, 0, 0])
+  # triplet mode
+  out = sampler.sample_from_edges(EdgeSamplerInput(
+      rows, cols, input_type=et1,
+      neg_sampling=NegativeSampling('triplet', 1)))
+  nu = np.asarray(out.node['u'])
+  nv = np.asarray(out.node['v'])
+  si = np.asarray(out.metadata['src_index'])
+  dp = np.asarray(out.metadata['dst_pos_index'])
+  for p in range(num_parts):
+    np.testing.assert_array_equal(nu[p][si[p]], rows[p])
+    np.testing.assert_array_equal(nv[p][dp[p]], cols[p])
+
+
+def test_dist_link_negatives_empty_shard():
+  """A shard owning ZERO rows of the seed edge type must emit masked-out
+  negatives, not INT_MAX padding ids (ops.random_negative_sample_local's
+  validity contract)."""
+  from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
+  num_parts = 2
+  # all edges owned by partition 0: node_pb sends every src to 0
+  rows = np.arange(N)
+  cols = (np.arange(N) + 1) % N
+  node_pb = np.zeros(N, np.int32)
+  parts = [GraphPartitionData(edge_index=np.stack([rows, cols]),
+                              eids=np.arange(N)),
+           GraphPartitionData(edge_index=np.zeros((2, 0), np.int64),
+                              eids=np.zeros((0,), np.int64))]
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2], mesh, seed=0)
+  seed_r = np.array([[0, 2], [4, 6]], np.int32)
+  seed_c = (seed_r + 1) % N
+  out = sampler.sample_from_edges(EdgeSamplerInput(
+      seed_r, seed_c, neg_sampling=NegativeSampling('binary', 1)))
+  node = np.asarray(out.node)
+  eli = np.asarray(out.metadata['edge_label_index'])
+  big = np.iinfo(np.int32).max
+  # no INT_MAX id anywhere in either shard's node buffer
+  assert (node < big).all()
+  # shard 1 owns no rows: its negative slots are masked (-1 indices)
+  assert (eli[1, :, 2:] == -1).all()
+  # shard 0 has valid negatives
+  assert (eli[0, :, 2:] >= 0).all()
+
+
+def test_dist_subgraph():
+  num_parts = 2
+  parts, _, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, None, mesh, seed=0,
+                                                with_edge=True)
+  seeds = np.array([[0, 1, 2, 10], [3, 4, 5, 11]], np.int32)
+  out = sampler.subgraph(seeds)
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  em = np.asarray(out.edge_mask)
+  edge = np.asarray(out.edge)
+  mapping = np.asarray(out.metadata['mapping'])
+  # induced edges among {a, a+1, a+2}: a->a+1, a->a+2, a+1->a+2
+  for p, a in ((0, 0), (1, 3)):
+    got = set()
+    for r, c, e, m in zip(row[p], col[p], edge[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][r]), int(node[p][c])
+      got.add((u, v))
+      # edge ids: 0..N-1 are +1 edges, N..2N-1 are +2 edges
+      assert (v == (u + 1) % N and e == u) or \
+          (v == (u + 2) % N and e == N + u), (u, v, e)
+    assert got == {(a, a + 1), (a, a + 2), (a + 1, a + 2)}
+    # every seed maps to its position in the deduped node set
+    for i, sd in enumerate(seeds[p]):
+      assert node[p][mapping[p, i]] == sd
+
+
+def test_dist_subgraph_with_expansion():
+  num_parts = 2
+  parts, _, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2], mesh, seed=0)
+  seeds = np.array([[0], [20]], np.int32)
+  out = sampler.subgraph(seeds)
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  em = np.asarray(out.edge_mask)
+  for p, a in ((0, 0), (1, 20)):
+    nn = int(np.asarray(out.num_nodes)[p])
+    # 1-hop expansion of {a} with fanout 2 reaches {a, a+1, a+2}
+    assert set(node[p][:nn].tolist()) == {a, a + 1, a + 2}
+    got = {(int(node[p][r]), int(node[p][c]))
+           for r, c, m in zip(row[p], col[p], em[p]) if m}
+    assert got == {(a, a + 1), (a, a + 2), (a + 1, a + 2)}
+
+
+def test_dist_weighted_sampling():
+  """Edge-weight bias must survive the sharded engine (the reference GPU
+  path falls back to uniform here — sampler/neighbor_sampler.py:86-91)."""
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  eids = np.arange(2 * N)
+  w = np.concatenate([np.full(N, 1000.0),
+                      np.full(N, 1e-3)]).astype(np.float32)
+  pb = (np.arange(N) % 2).astype(np.int32)
+  epb = pb[rows]
+  parts = []
+  for p in range(2):
+    m = epb == p
+    parts.append(GraphPartitionData(
+        edge_index=np.stack([rows[m], cols[m]]), eids=eids[m],
+        weights=w[m]))
+  mesh = make_mesh(2)
+  dg = glt.distributed.DistGraph(2, 0, parts, pb, epb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [1], mesh, seed=0,
+                                                with_weight=True)
+  seeds = np.arange(N, dtype=np.int32).reshape(2, N // 2)
+  n1 = n2 = 0
+  for _ in range(10):
+    out = sampler.sample_from_nodes(seeds)
+    node = np.asarray(out.node)
+    row = np.asarray(out.row)
+    col = np.asarray(out.col)
+    em = np.asarray(out.edge_mask)
+    for p in range(2):
+      for r, c, m in zip(row[p], col[p], em[p]):
+        if not m:
+          continue
+        u, v = int(node[p][c]), int(node[p][r])
+        if v == (u + 1) % N:
+          n1 += 1
+        else:
+          assert v == (u + 2) % N
+          n2 += 1
+  assert n1 + n2 > 0
+  assert n1 / (n1 + n2) > 0.95, (n1, n2)
+
+
+def test_dist_link_loader_end_to_end():
+  from graphlearn_tpu.sampler import NegativeSampling
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  df = glt.distributed.DistFeature(num_parts, feats, node_pb, mesh)
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df)
+  eli_seed = np.stack([np.arange(N), (np.arange(N) + 1) % N])
+  loader = glt.distributed.DistLinkNeighborLoader(
+      ds, [2, 2], eli_seed, batch_size=4, shuffle=True, seed=0,
+      neg_sampling=NegativeSampling('binary', 1), mesh=mesh)
+  steps = 0
+  for batch in loader:
+    steps += 1
+    node = np.asarray(batch.node)
+    x = np.asarray(batch.x)
+    eli = np.asarray(batch.metadata['edge_label_index'])
+    label = np.asarray(batch.metadata['edge_label'])
+    assert label.shape == (num_parts, 8)
+    for p in range(num_parts):
+      nn = int(np.asarray(batch.num_nodes)[p])
+      np.testing.assert_allclose(x[p, :nn, 0], node[p, :nn])
+      # every positive pair is a +1 ring edge
+      for i in range(4):
+        u = int(node[p][eli[p, 0, i]])
+        v = int(node[p][eli[p, 1, i]])
+        assert v == (u + 1) % N
+  assert steps == len(loader) == N // (num_parts * 4)
+
+
+def test_dist_subgraph_loader_end_to_end():
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  df = glt.distributed.DistFeature(num_parts, feats, node_pb, mesh)
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df)
+  loader = glt.distributed.DistSubGraphLoader(
+      ds, None, np.arange(N), batch_size=5, seed=0, mesh=mesh)
+  steps = 0
+  for batch in loader:
+    steps += 1
+    node = np.asarray(batch.node)
+    x = np.asarray(batch.x)
+    ei = np.asarray(batch.edge_index)
+    em = np.asarray(batch.edge_mask)
+    mapping = np.asarray(batch.metadata['mapping'])
+    for p in range(num_parts):
+      nn = int(np.asarray(batch.num_nodes)[p])
+      np.testing.assert_allclose(x[p, :nn, 0], node[p, :nn])
+      # all emitted edges are ring edges between batch nodes
+      for r, c, m in zip(ei[p, 0], ei[p, 1], em[p]):
+        if not m:
+          continue
+        u, v = int(node[p][r]), int(node[p][c])
+        assert v in ((u + 1) % N, (u + 2) % N)
+      assert (mapping[p] >= 0).all()
+  assert steps == len(loader) == N // (num_parts * 5)
+
+
 # ---------------------------------------------------------------- hetero
 
 def hetero_ring_fixture(num_parts=2):
