@@ -19,9 +19,12 @@ from .unified_tensor import UnifiedTensor
 class DeviceGroup:
   """A group of devices that jointly hold one replica of the hot rows.
 
-  Reference: data/feature.py:31-44 (NVLink p2p groups). On TPU the group is
-  a set of mesh devices the hot table is sharded over; the gather resolves
-  the shard through XLA instead of p2p pointers.
+  Reference: data/feature.py:31-44 (NVLink p2p groups: the hot table is
+  sharded across the group's GPUs and gathered via p2p pointers,
+  unified_tensor.cu:233-269). On TPU the group becomes a row-sharding of
+  the hot block over the group's devices — ``sharding()`` builds the
+  1-axis mesh placement and XLA's gather resolves the owning shard
+  (collectives over ICI) instead of p2p pointer chasing.
   """
 
   def __init__(self, group_id: int, device_list: Sequence):
@@ -31,6 +34,13 @@ class DeviceGroup:
   @property
   def size(self):
     return len(self.device_list)
+
+  def sharding(self):
+    """NamedSharding that row-shards a [H, F] table over this group."""
+    import numpy as np_
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np_.array(self.device_list), ('f',))
+    return NamedSharding(mesh, P('f'))
 
 
 class Feature:
@@ -74,8 +84,31 @@ class Feature:
       hot = 0
     else:
       hot = int(n * self.split_ratio)
-    ut = UnifiedTensor(device=self.device, dtype=self.dtype)
-    ut.init_from(self.feature_array[:hot] if hot else None,
+    place = self.device
+    hot_block = self.feature_array[:hot] if hot else None
+    if self.device_group_list:
+      # shard the hot block over the (first) device group; further groups
+      # are replicas, which multi-host placement handles upstream
+      # (reference: one replica per NVLink group, feature.py:177-205)
+      group = self.device_group_list[0]
+      if group.size > 1:
+        place = group.sharding()
+        rem = hot % group.size
+        if rem and hot == n:
+          # full-HBM split: pad UP with masked rows so no tail strands on
+          # host (which would disable the fused device_table path)
+          pad = np.zeros((group.size - rem,) + self.feature_array.shape[1:],
+                         self.feature_array.dtype)
+          hot_block = np.concatenate([self.feature_array, pad])
+          hot += group.size - rem
+        elif rem:
+          # mixed split: round DOWN (the few demoted rows stay cold)
+          hot -= rem
+          hot_block = self.feature_array[:hot] if hot else None
+      elif group.device_list:
+        place = group.device_list[0]
+    ut = UnifiedTensor(device=place, dtype=self.dtype)
+    ut.init_from(hot_block,
                  self.feature_array[hot:] if hot < n else None)
     self._unified = ut
     if self._id2index is not None:

@@ -7,15 +7,28 @@ several p2p GPUs plus a pinned-CPU zero-copy shard, and a warp-per-row gather
 kernel resolves the owning device by binary search over an offset table.
 
 On TPU there is no UVA: device reads cannot page host memory. The equivalent
-split is *hot rows resident in HBM* (optionally sharded over a mesh axis —
+split is *hot rows resident in HBM* (optionally sharded over a device group —
 XLA's gather resolves the shard, replacing the reference's device binary
-search) and *cold rows in host RAM*, gathered on host and shipped once per
-batch. The row order is [device rows 0..H) | host rows H..N), matching the
-reference's offset-table layout with a single device "group".
+search) and *cold rows in host RAM*. The mixed gather ships ONLY cold rows
+across the bus (the whole point of the reference's split: only misses touch
+the UVA path, unified_tensor.cu:48-81):
+
+  1. the cold subset is computed on host and gathered there — in a worker
+     thread, overlapping the device-side hot gather's async dispatch;
+  2. the cold block is padded to a power-of-two row count (bounds the number
+     of distinct compiled scatter shapes) and shipped once;
+  3. a jitted scatter drops the cold rows into their batch positions.
+
+Transfer per batch is O(miss_count * F), not O(B * F).
 """
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+  return 1 << max(0, (n - 1).bit_length())
 
 
 class UnifiedTensor:
@@ -25,6 +38,10 @@ class UnifiedTensor:
   AppendSharedTensor / operator[] (unified_tensor.cu:168-338). The device
   part plays the role of the GPU shards; the host part replaces the
   pinned-CPU zero-copy shard.
+
+  ``device`` may be a jax.Device or a jax.sharding.Sharding — the latter
+  row-shards the hot block over a device group (reference DeviceGroup
+  placement, unified_tensor.cu:233-269).
   """
 
   def __init__(self, device=None, dtype=None):
@@ -33,6 +50,10 @@ class UnifiedTensor:
     self._device_part = None   # jax.Array [H, F] in HBM
     self._host_part = None     # np.ndarray [N-H, F] in host RAM
     self._device_rows = 0
+    self._pool = None          # lazy host-gather worker
+    self._hot_fn = None        # jitted hot gather (dispatched pre-block)
+    self._scatter_fn = None    # jitted cold-row scatter
+    self._last_cold_cap = None  # introspection for tests/benchmarks
 
   def init_from(self, device_rows: Optional[np.ndarray],
                 host_rows: Optional[np.ndarray]):
@@ -76,31 +97,84 @@ class UnifiedTensor:
   def size(self) -> int:
     return self.shape[0]
 
+  def _fns(self):
+    """(hot gather, cold scatter) jitted fns — jit's own shape-keyed cache
+    handles distinct (B, cold_cap) combinations."""
+    import jax
+    import jax.numpy as jnp
+    if self._hot_fn is None:
+      self._hot_fn = jax.jit(
+          lambda table, hot_ids: jnp.take(table, hot_ids, axis=0))
+      # positions beyond the cold count are padded to b -> dropped
+      self._scatter_fn = jax.jit(
+          lambda out, pos, rows: out.at[pos].set(rows, mode='drop'))
+    return self._hot_fn, self._scatter_fn
+
   def __getitem__(self, ids):
     """Gather rows by global row index; returns a device array.
 
-    Hot rows come straight from HBM; cold rows are gathered on host and
-    shipped in one transfer (replacement for the reference's UVA reads
-    inside GatherTensorKernel, unified_tensor.cu:48-81).
+    Hot rows come straight from HBM; ONLY cold rows cross the bus, padded
+    to a power-of-two count (bounded recompiles). The hot gather is
+    dispatched (async) BEFORE blocking on the worker-thread host gather,
+    so the device works while the host collects the misses. Cold ids
+    require host knowledge of ``ids`` — callers on the all-hot path
+    (Feature.device_table) never reach this.
     """
     import jax
     import jax.numpy as jnp
-    ids = jnp.asarray(ids)
     if self._host_part is None:
-      return jnp.take(self._device_part, ids, axis=0)
-    if self._device_part is None:
-      host = np.take(self._host_part, np.asarray(ids) - self._device_rows,
-                     axis=0)
-      return jax.device_put(host, self.device)
-    # Mixed: one device gather + one host gather, then select.
+      if self._pallas_ok():
+        from ..ops import gather_rows_hbm
+        return gather_rows_hbm(self._device_part, jnp.asarray(ids))
+      return jnp.take(self._device_part, jnp.asarray(ids), axis=0)
     ids_np = np.asarray(ids)
+    if self._device_part is None:
+      host = np.take(self._host_part, ids_np - self._device_rows, axis=0)
+      return jax.device_put(host, self._small_block_target())
+    # Mixed: ship only the cold rows.
+    b = ids_np.shape[0]
     is_hot = ids_np < self._device_rows
-    host_ids = np.where(is_hot, 0, ids_np - self._device_rows)
-    host_rows = jax.device_put(
-        np.take(self._host_part, host_ids, axis=0), self.device)
-    hot_ids = jnp.where(jnp.asarray(is_hot), ids, 0)
-    dev_rows = jnp.take(self._device_part, hot_ids, axis=0)
-    return jnp.where(jnp.asarray(is_hot)[:, None], dev_rows, host_rows)
+    cold_pos = np.nonzero(~is_hot)[0]
+    n_cold = int(cold_pos.shape[0])
+    cold_cap = min(b, max(1, _next_pow2(n_cold)))
+    self._last_cold_cap = cold_cap
+    if self._pool is None:
+      self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def host_gather():
+      rows = np.take(self._host_part,
+                     ids_np[cold_pos] - self._device_rows, axis=0)
+      if n_cold < cold_cap:
+        pad = np.zeros((cold_cap - n_cold,) + rows.shape[1:], rows.dtype)
+        rows = np.concatenate([rows, pad]) if n_cold else pad
+      return rows
+
+    fut = self._pool.submit(host_gather)
+    hot_fn, scatter_fn = self._fns()
+    hot_ids = jnp.asarray(np.where(is_hot, ids_np, 0))
+    out = hot_fn(self._device_part, hot_ids)   # async; overlaps host work
+    pos = np.full((cold_cap,), b, np.int32)    # pad positions drop
+    pos[:n_cold] = cold_pos
+    cold_rows = jax.device_put(fut.result(), self._small_block_target())
+    return scatter_fn(out, jnp.asarray(pos), cold_rows)
+
+  def _pallas_ok(self) -> bool:
+    """All-hot gathers use the Pallas row-DMA kernel when the table is
+    single-device TPU-resident with a 128-lane-aligned feature dim."""
+    import jax
+    t = self._device_part
+    return (jax.default_backend() == 'tpu' and t is not None and
+            t.shape[1] % 128 == 0 and
+            len(t.sharding.device_set) == 1)
+
+  def _small_block_target(self):
+    """Placement for per-batch blocks: replicated when the hot table is
+    group-sharded (a cold block's row count need not divide the group)."""
+    import jax
+    if isinstance(self.device, jax.sharding.Sharding):
+      from jax.sharding import NamedSharding, PartitionSpec as P
+      return NamedSharding(self.device.mesh, P())
+    return self.device
 
   def share_ipc(self):
     """Single-process-per-host on TPU: sharing = handing over host arrays

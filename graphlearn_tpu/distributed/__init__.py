@@ -1,5 +1,5 @@
 from .dist_context import (DistContext, DistRole, get_context,
-                           init_worker_group)
+                           init_multihost, init_worker_group)
 from .dist_dataset import DistDataset
 from .dist_feature import DistFeature
 from .dist_graph import DistGraph, DistHeteroGraph, build_local_csr

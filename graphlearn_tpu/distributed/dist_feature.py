@@ -57,15 +57,15 @@ class DistFeature:
 
   def device_arrays(self):
     if self._dev is None:
-      import jax
       from jax.sharding import NamedSharding, PartitionSpec as P
+      from ..utils import global_device_put
       shard = NamedSharding(self.mesh, P('g'))
       repl = NamedSharding(self.mesh, P())
       self._dev = dict(
-          feat_ids=jax.device_put(self.feat_ids, shard),
-          feats=jax.device_put(self.feats, shard),
-          feature_pb=jax.device_put(self.feature_pb.astype(np.int32),
-                                    repl))
+          feat_ids=global_device_put(self.feat_ids, shard),
+          feats=global_device_put(self.feats, shard),
+          feature_pb=global_device_put(self.feature_pb.astype(np.int32),
+                                       repl))
     return self._dev
 
   def _build_fn(self, b: int):

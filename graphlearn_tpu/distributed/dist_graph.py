@@ -138,20 +138,21 @@ class DistGraph:
 
   def device_arrays(self, mesh):
     """Place the stacked arrays on the mesh: leading axis sharded over 'g',
-    partition book replicated."""
-    import jax
+    partition book replicated. Works on multi-host meshes (only this
+    process's shards are placed — utils.global_device_put)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..utils import global_device_put
     shard = NamedSharding(mesh, P('g'))
     repl = NamedSharding(mesh, P())
     out = dict(
-        row_ids=jax.device_put(self.row_ids, shard),
-        indptr=jax.device_put(self.indptr, shard),
-        indices=jax.device_put(self.indices, shard),
-        eids=jax.device_put(self.eids, shard),
-        node_pb=jax.device_put(self.node_pb.astype(np.int32), repl),
+        row_ids=global_device_put(self.row_ids, shard),
+        indptr=global_device_put(self.indptr, shard),
+        indices=global_device_put(self.indices, shard),
+        eids=global_device_put(self.eids, shard),
+        node_pb=global_device_put(self.node_pb.astype(np.int32), repl),
     )
     if self.weights is not None:
-      out['weights'] = jax.device_put(self.weights, shard)
+      out['weights'] = global_device_put(self.weights, shard)
     return out
 
 

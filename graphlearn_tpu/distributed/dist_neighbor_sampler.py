@@ -987,9 +987,11 @@ class DistNeighborSampler:
   def collate(self, out, node_labels=None):
     """Attach features (sharded all_to_all gather) and labels.
 
-    Reference: _colloate_fn (dist_neighbor_sampler.py:650-744). Label
-    gather goes through the jitted ops.gather_rows (no eager op may touch
-    the still-pending sampler outputs — PERF.md).
+    Reference: _colloate_fn (dist_neighbor_sampler.py:650-744). Labels
+    are PARTITIONED like features — each shard holds only its owned
+    nodes' labels as a 1-wide sharded table and the gather rides the same
+    all_to_all path — not replicated per device (which at papers100M
+    scale would put the full [N] array on every chip).
     """
     if isinstance(out, HeteroSamplerOutput):
       x = y = None
@@ -997,8 +999,8 @@ class DistNeighborSampler:
         x = {t: self.dist_feature[t].get(out.node[t])
              for t in out.node if t in self.dist_feature}
       if node_labels is not None:
-        y = {t: ops.gather_rows(self._label_dev(node_labels[t], t), None,
-                                out.node[t])
+        y = {t: self._label_dist(node_labels[t], t).get(
+                out.node[t])[..., 0]
              for t in out.node if t in node_labels}
       return x, y
     x = None
@@ -1006,18 +1008,31 @@ class DistNeighborSampler:
       x = self.dist_feature.get(out.node)
     y = None
     if node_labels is not None:
-      y = ops.gather_rows(self._label_dev(node_labels), None, out.node)
+      y = self._label_dist(node_labels).get(out.node)[..., 0]
     return x, y
 
-  def _label_dev(self, labels, key=None):
-    """Device label table, uploaded once per distinct array (keyed by the
-    array's identity, so swapping in different labels is picked up while
-    repeated batches reuse the upload)."""
-    import jax.numpy as jnp
+  def _label_dist(self, labels, key=None):
+    """Sharded label store, built once per distinct label array (keyed by
+    identity, so swapping in different labels is picked up while repeated
+    batches reuse the shards)."""
+    from .dist_feature import DistFeature
     if not hasattr(self, '_labels_cache'):
-      self._labels_cache = {}  # key -> (id(labels), device table)
+      self._labels_cache = {}  # key -> (id(labels), DistFeature)
     hit = self._labels_cache.get(key)
     if hit is None or hit[0] != id(labels):
-      hit = (id(labels), jnp.asarray(np.asarray(labels)))
+      lab = np.asarray(labels).reshape(-1)
+      if lab.dtype == np.int64:     # TPU-native widths
+        lab = lab.astype(np.int32)
+      elif lab.dtype == np.float64:
+        lab = lab.astype(np.float32)
+      pb = (self.graph.node_pb[key] if self.is_hetero
+            else self.graph.node_pb)
+      blocks = []
+      for p in range(self.graph.num_partitions):
+        ids = np.nonzero(pb == p)[0].astype(np.int64)
+        blocks.append((ids, lab[ids][:, None]))
+      hit = (id(labels), DistFeature(self.graph.num_partitions, blocks,
+                                     pb, mesh=self.mesh,
+                                     dtype=lab.dtype))
       self._labels_cache[key] = hit
     return hit[1]

@@ -20,7 +20,8 @@ class SubGraphLoader(NodeLoader):
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
-               max_degree: Optional[int] = None):
+               max_degree: Optional[int] = None, bucketed: bool = False,
+               cap_large: Optional[int] = None):
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         edge_dir=data.edge_dir, seed=seed)
@@ -28,11 +29,14 @@ class SubGraphLoader(NodeLoader):
                      drop_last, with_edge, collect_features, to_device,
                      seed)
     self.max_degree = max_degree
+    self.bucketed = bucketed
+    self.cap_large = cap_large
 
   def __iter__(self):
     for idx in self._batcher:
       seeds = self.input_seeds[idx]
       out = self.sampler.subgraph(
           NodeSamplerInput(seeds, self.input_type),
-          max_degree=self.max_degree)
+          max_degree=self.max_degree, bucketed=self.bucketed,
+          cap_large=self.cap_large)
       yield self._collate_fn(out)

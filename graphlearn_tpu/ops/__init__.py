@@ -1,5 +1,6 @@
 from .collate import (collate_batch, gather_rows, stack2, stack2_batched,
                       valid_mask)
+from .gather_pallas import gather_rows_hbm
 from .induce import InducerState, induce_next, init_empty, init_node
 from .induce_map import (MapInducerState, induce_next_map, init_node_map)
 from .negative import (random_negative_sample, random_negative_sample_local,
@@ -9,5 +10,6 @@ from .neighbor import (build_row_cumsum, edge_in_csr, uniform_sample,
                        weighted_sample_local)
 from .route import gather_from_buckets, route_slots, scatter_to_buckets
 from .stitch import stitch_rows
-from .subgraph import node_subgraph, node_subgraph_local
+from .subgraph import (node_subgraph, node_subgraph_bucketed,
+                       node_subgraph_local)
 from .unique import FILL, masked_unique, searchsorted_membership

@@ -1,0 +1,103 @@
+"""Pallas TPU kernel: random row gather from an HBM-resident table.
+
+TPU-native replacement for the reference's UnifiedTensor gather kernel
+(/root/reference/graphlearn_torch/csrc/cuda/unified_tensor.cu:48-81, a
+warp-per-row UVA gather). The feature lookup is the biggest per-batch byte
+mover in GNN training (PERF.md: ~40x the sampler's budget), and XLA lowers
+`jnp.take` over a large HBM table through generic dynamic-gather machinery.
+This kernel instead keeps the table in HBM untouched and issues one async
+row DMA per output row, many in flight at once:
+
+  grid step i owns output rows [i*G, (i+1)*G); the row ids arrive via
+  scalar prefetch (known before the body runs), the body starts G
+  concurrent HBM->VMEM row copies straight into the output block, then
+  waits. Pallas' pipeline machinery double-buffers the output blocks, so
+  step i+1's DMAs issue while step i's block flushes.
+
+Falls back to `jnp.take` off-TPU (interpret mode exists but is orders of
+magnitude slower; tests exercise the kernel via interpret=True on small
+shapes).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _gather_kernel(ids_ref, table_ref, out_ref, sems):
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+  i = pl.program_id(0)
+  g = out_ref.shape[0]
+
+  def dma(slot):
+    rid = ids_ref[i * g + slot]
+    return pltpu.make_async_copy(table_ref.at[rid], out_ref.at[slot],
+                                 sems.at[slot])
+
+  def issue(slot, _):
+    dma(slot).start()
+    return _
+
+  jax.lax.fori_loop(0, g, issue, None, unroll=True)
+
+  def drain(slot, _):
+    dma(slot).wait()
+    return _
+
+  jax.lax.fori_loop(0, g, drain, None, unroll=True)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('block_rows', 'interpret', 'force'))
+def gather_rows_hbm(table, ids, block_rows: int = 64,
+                    interpret: bool = False, force: bool = False):
+  """Gather ``table[ids]`` via per-row async DMAs.
+
+  Args:
+    table: [N, F] device array (HBM-resident; never copied wholesale).
+    ids: [B] int32 row indices (clamped to [0, N)).
+    block_rows: rows per grid step == concurrent DMAs in flight.
+      Measured on v5e-1 (1M x 128 f32 table, 131k random ids): 64 -> 10.8
+      GB/s vs 9.9 for XLA's take; 128/256 regress to ~7.8 (grid-step
+      drain beats DMA-queue pressure), and a grid-free rotation variant
+      that never drains measured 8.1 (scalar loop overhead) — see
+      benchmarks/prof_gather.py.
+    interpret: run the Pallas interpreter (CPU tests).
+    force: run the kernel even off-TPU (tests); default falls back to
+      jnp.take when the backend isn't TPU.
+
+  Returns [B, F] gathered rows.
+  """
+  if ids.shape[0] == 0 or (
+      not (interpret or force) and (jax.default_backend() != 'tpu' or
+                                    table.shape[1] % 128 != 0)):
+    # Mosaic HBM row slices must be 128-lane aligned — misaligned tables
+    # fall back to XLA's take (UnifiedTensor._pallas_ok routes accordingly)
+    return jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  b = ids.shape[0]
+  g = min(block_rows, b)
+  pad = (-b) % g
+  ids = jnp.clip(ids, 0, table.shape[0] - 1).astype(jnp.int32)
+  if pad:
+    ids = jnp.concatenate([ids, jnp.zeros((pad,), jnp.int32)])
+  grid = (b + pad) // g
+
+  out = pl.pallas_call(
+      _gather_kernel,
+      grid_spec=pltpu.PrefetchScalarGridSpec(
+          num_scalar_prefetch=1,
+          grid=(grid,),
+          in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+          out_specs=pl.BlockSpec((g, table.shape[1]),
+                                 lambda i, ids_ref: (i, 0)),
+          scratch_shapes=[pltpu.SemaphoreType.DMA((g,))],
+      ),
+      out_shape=jax.ShapeDtypeStruct((b + pad, table.shape[1]),
+                                     table.dtype),
+      interpret=interpret,
+  )(ids, table)
+  return out[:b] if pad else out

@@ -5,9 +5,18 @@ The reference routes data-dependent id sets between workers over RPC
 On TPU the exchange is a fixed-shape `all_to_all` over the mesh: each shard
 packs its outgoing ids into a dense [num_parts, capacity] bucket buffer
 (FILL-padded), the collective transposes shard<->bucket, and responses are
-un-permuted with the remembered (dest, slot) coordinates. Capacity is
-static; overflow beyond `capacity` per destination is masked out (the
-SURVEY §7 "per-partition capacity padding + overflow handling" point).
+un-permuted with the remembered (dest, slot) coordinates.
+
+Overflow contract (SURVEY §7 "per-partition capacity padding + overflow
+handling"; reference splits exactly and never drops,
+dist_neighbor_sampler.py:585-648): a bucket only overflows when more than
+``capacity`` elements target one destination, so callers that size
+``capacity`` to the frontier width — as every engine in
+distributed/dist_neighbor_sampler.py does — are loss-free BY CONSTRUCTION
+even under pathologically skewed partition books (every id on one
+partition). :func:`route_slots` also returns the overflow count so callers
+that trade capacity for all_to_all volume can detect (and assert on) any
+drop instead of losing samples silently.
 """
 import functools
 
@@ -17,16 +26,20 @@ import jax.numpy as jnp
 from .unique import FILL
 
 
-@functools.partial(jax.jit, static_argnames=('capacity',))
-def route_slots(dest, mask, capacity: int):
+@functools.partial(jax.jit, static_argnames=('capacity', 'with_overflow'))
+def route_slots(dest, mask, capacity: int, with_overflow: bool = False):
   """Assign each element a slot within its destination bucket.
 
   Args:
     dest: [B] destination partition per element.
     mask: [B] validity.
-    capacity: bucket capacity (static).
+    capacity: bucket capacity (static). ``capacity >= B`` can never
+      overflow (see module docstring).
+    with_overflow: also return the number of valid elements that did NOT
+      get a slot (overflow beyond ``capacity`` in their bucket).
 
-  Returns (slot [B], ok [B]): ``ok`` = valid and not overflowed.
+  Returns (slot [B], ok [B]) — ``ok`` = valid and not overflowed — plus
+  ``num_overflow`` (scalar int32) when ``with_overflow``.
   """
   b = dest.shape[0]
   big = jnp.int32(2 ** 30)
@@ -40,6 +53,8 @@ def route_slots(dest, mask, capacity: int):
   rank_sorted = idx - group_start
   slot = jnp.zeros((b,), jnp.int32).at[order].set(rank_sorted)
   ok = mask & (slot < capacity)
+  if with_overflow:
+    return slot, ok, jnp.sum(mask & ~ok).astype(jnp.int32)
   return slot, ok
 
 

@@ -55,6 +55,73 @@ def node_subgraph(indptr, indices, srcs, src_mask, max_degree: int):
               edge_mask=member.reshape(-1))
 
 
+@functools.partial(jax.jit, static_argnames=('deg_small', 'cap_large',
+                                             'max_degree'))
+def node_subgraph_bucketed(indptr, indices, srcs, src_mask,
+                           deg_small: int, cap_large: int,
+                           max_degree: int):
+  """Degree-bucketed induced-subgraph extraction.
+
+  :func:`node_subgraph` scans EVERY row to the graph's max degree, so one
+  celebrity vertex makes every batch ``[B, max_degree]``-sized. Here rows
+  are split into two static buckets: low-degree rows (deg <= deg_small,
+  the vast majority on power-law graphs) scan only ``deg_small`` columns,
+  and up to ``cap_large`` high-degree rows scan ``max_degree``. The output
+  buffer shrinks from ``B * max_degree`` to
+  ``B * deg_small + cap_large * max_degree``. High-degree rows beyond
+  ``cap_large`` are NOT silently lost: they are counted in
+  ``num_dropped_rows`` so callers can grow the cap (reference slices
+  exactly per row — subgraph_op.cu:133-242 — which a static-shape program
+  cannot; this is the TPU-native trade).
+
+  Returns the :func:`node_subgraph` dict plus ``num_dropped_rows``.
+  """
+  b = srcs.shape[0]
+  nodes, num_nodes, _ = masked_unique(srcs, src_mask, size=b)
+  node_valid = jnp.arange(b) < num_nodes
+  safe_nodes = jnp.where(node_valid, nodes, 0)
+  start = indptr[safe_nodes]
+  deg = jnp.where(node_valid, indptr[safe_nodes + 1] - start, 0)
+  big = jnp.iinfo(nodes.dtype).max
+  skeys = jnp.where(node_valid, nodes, big)
+
+  def extract(row_pos, row_mask, cap):
+    """Scan rows ``nodes[row_pos]`` to ``cap`` columns, relabel."""
+    n = row_pos.shape[0]
+    st = jnp.where(row_mask, start[row_pos], 0)
+    dg = jnp.where(row_mask, deg[row_pos], 0)
+    off = jnp.arange(cap, dtype=st.dtype)[None, :]
+    in_row = off < dg[:, None]
+    epos = jnp.where(in_row, st[:, None] + off, 0)
+    nbr = jnp.where(in_row, indices[epos], FILL)
+    pos = jnp.clip(jnp.searchsorted(skeys, nbr), 0, b - 1)
+    member = in_row & (skeys[pos] == nbr)
+    rows = jnp.where(member, jnp.broadcast_to(
+        row_pos.astype(jnp.int32)[:, None], (n, cap)), -1)
+    cols = jnp.where(member, pos.astype(jnp.int32), -1)
+    return (rows.reshape(-1), cols.reshape(-1),
+            jnp.where(member, epos, 0).reshape(-1), member.reshape(-1))
+
+  is_small = node_valid & (deg <= deg_small)
+  is_large = node_valid & (deg > deg_small)
+  # small pass covers all B positions; large rows masked out of it
+  all_pos = jnp.arange(b, dtype=jnp.int32)
+  r1, c1, e1, m1 = extract(all_pos, is_small, deg_small)
+  # compact high-degree row positions into cap_large slots
+  order = jnp.argsort(jnp.where(is_large, 0, 1), stable=True)
+  lpos = order[:cap_large].astype(jnp.int32)
+  lmask = is_large[lpos]
+  r2, c2, e2, m2 = extract(lpos, lmask, max_degree)
+  num_large = jnp.sum(is_large).astype(jnp.int32)
+  dropped = jnp.maximum(num_large - cap_large, 0)
+  return dict(nodes=nodes, num_nodes=num_nodes,
+              rows=jnp.concatenate([r1, r2]),
+              cols=jnp.concatenate([c1, c2]),
+              epos=jnp.concatenate([e1, e2]),
+              edge_mask=jnp.concatenate([m1, m2]),
+              num_dropped_rows=dropped)
+
+
 def node_subgraph_local(row_ids, indptr_loc, indices, node_keys,
                         max_degree: int):
   """Induced-subgraph extraction over a *partition-local* CSR.

@@ -676,17 +676,35 @@ class NeighborSampler(BaseSampler):
   # --------------------------------------------------------------- subgraph
 
   def subgraph(self, inputs: NodeSamplerInput,
-               max_degree: Optional[int] = None, **kwargs):
+               max_degree: Optional[int] = None, bucketed: bool = False,
+               cap_large: Optional[int] = None, **kwargs):
     """k-hop induced subgraph (reference: neighbor_sampler.py:456-480):
-    expand seeds by the fanouts, then keep ALL edges among collected nodes."""
+    expand seeds by the fanouts, then keep ALL edges among collected nodes.
+
+    The default is EXACT (every row scanned to ``max_degree``, defaulting
+    to the graph's global max — lossless but ``[B, max_deg]``-sized, so
+    one celebrity vertex inflates every batch). ``bucketed=True`` trades
+    bounded loss for memory: most rows scan only the graph's ~p90 degree
+    and up to ``cap_large`` high-degree rows (default B//8) scan the max;
+    high-degree rows beyond the cap LOSE their out-edges, with the count
+    reported in ``metadata['num_dropped_rows']`` — size ``cap_large`` from
+    that signal.
+    """
     import jax.numpy as jnp
     g = self._get_graph()
     nodes_out = self.sample_from_nodes(inputs)
     node_buf = nodes_out.node
     nmask = jnp.arange(node_buf.shape[0]) < nodes_out.num_nodes
-    md = max_degree or int(g.topo.max_degree)
-    sub = ops.node_subgraph(g.indptr, g.indices, node_buf, nmask,
-                            max_degree=md)
+    if bucketed:
+      deg_small, dmax = self._degree_buckets()
+      cap = cap_large or max(8, node_buf.shape[0] // 8)
+      sub = ops.node_subgraph_bucketed(
+          g.indptr, g.indices, node_buf, nmask, deg_small=deg_small,
+          cap_large=cap, max_degree=max_degree or dmax)
+    else:
+      sub = ops.node_subgraph(
+          g.indptr, g.indices, node_buf, nmask,
+          max_degree=max_degree or int(g.topo.max_degree))
     eids = None
     if self.with_edge:
       e = g.edge_ids
@@ -700,11 +718,26 @@ class NeighborSampler(BaseSampler):
                       sub['nodes'], jnp.iinfo(jnp.int32).max)
     pos = jnp.clip(jnp.searchsorted(skeys, seeds), 0, skeys.shape[0] - 1)
     mapping = jnp.where(skeys[pos] == seeds, pos, -1)
+    md = {'mapping': mapping}
+    if 'num_dropped_rows' in sub:
+      md['num_dropped_rows'] = sub['num_dropped_rows']
     return SamplerOutput(
         node=sub['nodes'], num_nodes=sub['num_nodes'], row=sub['rows'],
         col=sub['cols'], edge=eids, edge_mask=sub['edge_mask'],
         batch=seeds, batch_size=int(seeds.shape[0]),
-        input_type=inputs.input_type, metadata={'mapping': mapping})
+        input_type=inputs.input_type, metadata=md)
+
+  def _degree_buckets(self):
+    """(p90 degree rounded up to a multiple of 8, max degree) — the
+    static bucket plan for ops.node_subgraph_bucketed."""
+    if not hasattr(self, '_deg_buckets'):
+      g = self._get_graph()
+      deg = np.diff(np.asarray(g.indptr))
+      dmax = max(1, int(deg.max())) if deg.size else 1
+      p90 = int(np.quantile(deg, 0.9)) if deg.size else 1
+      small = min(dmax, max(8, -(-p90 // 8) * 8))
+      self._deg_buckets = (small, dmax)
+    return self._deg_buckets
 
   # ----------------------------------------------- pre-sampling probability
 

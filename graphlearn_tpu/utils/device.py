@@ -25,6 +25,25 @@ def ensure_device(device=None):
   return devs[0] if devs else None
 
 
+def global_device_put(arr, sharding):
+  """device_put that also works on multi-host meshes.
+
+  On a single-host mesh this is `jax.device_put`. When ``sharding`` spans
+  devices this process cannot address (a multi-host mesh from
+  dist_context.init_multihost), the array is assembled from the locally
+  addressable shards via `make_array_from_callback` — every process passes
+  the same full host array (the "each host loads what it serves" model;
+  the callback touches only this process's shard slices).
+  """
+  import jax
+  if getattr(sharding, 'is_fully_addressable', True):
+    return jax.device_put(arr, sharding)
+  import numpy as np
+  arr = np.asarray(arr)
+  return jax.make_array_from_callback(arr.shape, sharding,
+                                      lambda idx: arr[idx])
+
+
 def enable_compilation_cache(path: Optional[str] = None,
                              min_compile_secs: float = 1.0):
   """Persist XLA executables to disk so repeated process runs warm-start.

@@ -166,6 +166,56 @@ def test_dist_dataset_load_from_partition_dir(tmp_path):
     np.testing.assert_allclose(x[p, :nn, 0], node[p, :nn])
 
 
+def test_route_overflow_counter():
+  """ops.route_slots reports overflow instead of losing it silently."""
+  import jax.numpy as jnp
+  from graphlearn_tpu import ops
+  dest = jnp.zeros((8,), jnp.int32)          # everything to bucket 0
+  mask = jnp.ones((8,), bool)
+  slot, ok, nov = ops.route_slots(dest, mask, capacity=3,
+                                  with_overflow=True)
+  assert int(nov) == 5
+  assert int(ok.sum()) == 3
+  # frontier-width capacity can never overflow
+  _, ok, nov = ops.route_slots(dest, mask, capacity=8, with_overflow=True)
+  assert int(nov) == 0 and bool(ok.all())
+
+
+def test_dist_sampler_skewed_partition_book_no_loss():
+  """Pathologically skewed node_pb (every node owned by partition 0):
+  the frontier-width bucket capacity guarantees zero sample loss — every
+  valid seed yields min(degree, k) edges (reference contract: the exact
+  split never drops, dist_neighbor_sampler.py:585-648)."""
+  num_parts = 2
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  eids = np.arange(2 * N)
+  node_pb = np.zeros(N, np.int32)            # ALL nodes on partition 0
+  parts = [GraphPartitionData(edge_index=np.stack([rows, cols]),
+                              eids=eids),
+           GraphPartitionData(edge_index=np.zeros((2, 0), np.int64),
+                              eids=np.zeros((0,), np.int64))]
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2], mesh, seed=0)
+  b = 8
+  seeds = np.arange(2 * b, dtype=np.int32).reshape(num_parts, b)
+  out = sampler.sample_from_nodes(seeds)
+  em = np.asarray(out.edge_mask)
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  for p in range(num_parts):
+    # ring degree is 2, fanout 2 -> keep-all: exactly 2 edges per seed,
+    # even though every request funnels to shard 0
+    assert int(em[p].sum()) == 2 * b, int(em[p].sum())
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N)
+
+
 # ------------------------------------------------------------ link + subgraph
 
 def test_dist_link_sampler_binary():

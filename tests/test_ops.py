@@ -232,6 +232,81 @@ def test_node_subgraph():
   assert got == {(0, 2), (0, 3), (2, 3)}
 
 
+def test_node_subgraph_bucketed_celebrity():
+  """One celebrity vertex must not force every row to its degree: the
+  bucketed op matches the exact op's edge set while scanning most rows
+  only to deg_small."""
+  # star: node 0 -> 1..49 (deg 49); chain 1->2->...->49 (deg 1 each)
+  n = 50
+  rows = np.concatenate([np.zeros(n - 1, np.int64),
+                         np.arange(1, n - 1)])
+  cols = np.concatenate([np.arange(1, n), np.arange(2, n)])
+  order = np.lexsort((cols, rows))
+  rows, cols = rows[order], cols[order]
+  indptr_np = np.zeros(n + 1, np.int32)
+  np.add.at(indptr_np, rows + 1, 1)
+  indptr = jnp.asarray(np.cumsum(indptr_np).astype(np.int32))
+  indices = jnp.asarray(cols.astype(np.int32))
+  srcs = jnp.asarray(np.arange(16, dtype=np.int32))  # {0..15}
+  mask = jnp.ones(16, bool)
+  exact = ops.node_subgraph(indptr, indices, srcs, mask, max_degree=49)
+  buck = ops.node_subgraph_bucketed(indptr, indices, srcs, mask,
+                                    deg_small=8, cap_large=4,
+                                    max_degree=49)
+  assert int(buck['num_dropped_rows']) == 0
+
+  def edge_set(out):
+    nodes = np.asarray(out['nodes'])
+    return {(int(nodes[r]), int(nodes[c]))
+            for r, c, v in zip(np.asarray(out['rows']),
+                               np.asarray(out['cols']),
+                               np.asarray(out['edge_mask'])) if v}
+
+  es = edge_set(buck)
+  assert es == edge_set(exact)
+  # the celebrity's edges into the set are all present
+  assert {(0, i) for i in range(1, 16)} <= es
+  # buffer is the bucketed size, far below B * max_degree
+  assert buck['rows'].shape[0] == 16 * 8 + 4 * 49 < 16 * 49
+
+  # overflow reporting: two celebrities, cap_large=1
+  rows2 = np.concatenate([rows, np.full(n - 2, n, np.int64)])
+  cols2 = np.concatenate([cols, np.arange(1, n - 1)])
+  order = np.lexsort((cols2, rows2))
+  rows2, cols2 = rows2[order], cols2[order]
+  ip = np.zeros(n + 2, np.int32)
+  np.add.at(ip, rows2 + 1, 1)
+  indptr2 = jnp.asarray(np.cumsum(ip).astype(np.int32))
+  indices2 = jnp.asarray(cols2.astype(np.int32))
+  srcs2 = jnp.asarray(np.array([0, n, 1, 2], np.int32))
+  buck2 = ops.node_subgraph_bucketed(indptr2, indices2, srcs2,
+                                     jnp.ones(4, bool), deg_small=2,
+                                     cap_large=1, max_degree=49)
+  assert int(buck2['num_dropped_rows']) == 1
+
+
+# ---------------------------------------------------------------- pallas
+
+def test_gather_rows_hbm_interpret():
+  """Pallas row-gather kernel vs numpy, via the interpreter (no TPU in
+  the test env); exercises non-128-aligned F, duplicate ids, and padding
+  of B to the block size."""
+  rng = np.random.default_rng(0)
+  table = rng.random((97, 100), np.float32)
+  tdev = jnp.asarray(table)
+  ids = np.array([0, 96, 7, 7, 45, 3, 8, 12, 1, 0, 33], np.int32)
+  out = ops.gather_rows_hbm(tdev, jnp.asarray(ids), block_rows=4,
+                            interpret=True)
+  np.testing.assert_allclose(np.asarray(out), table[ids])
+  # fallback path off-TPU without interpret
+  out = ops.gather_rows_hbm(tdev, jnp.asarray(ids))
+  np.testing.assert_allclose(np.asarray(out), table[ids])
+  # out-of-range ids clamp instead of faulting
+  out = ops.gather_rows_hbm(tdev, jnp.asarray(np.array([200, -5], np.int32)),
+                            block_rows=2, interpret=True)
+  np.testing.assert_allclose(np.asarray(out), table[[96, 0]])
+
+
 # ---------------------------------------------------------------- stitch
 
 def test_stitch_rows():
