@@ -71,6 +71,7 @@ def main():
   # per-hop edge counts (num_sampled_edges) on device; collect those
   # handles, block once (the sync bracketing the reference also uses,
   # bench_sampler.py:48-53), and fetch the ints after the clock stops.
+  glt.utils.maybe_start_trace()   # GLT_PROFILE_DIR -> jax.profiler trace
   t0 = time.perf_counter()
   counts = []
   for i in range(ITERS):
@@ -78,6 +79,7 @@ def main():
     counts.append(out.num_sampled_edges)
   jax.block_until_ready(counts)
   dt = time.perf_counter() - t0
+  glt.utils.stop_trace()
   total_edges = sum(int(c) for hop in counts for c in hop)
 
   edges_per_sec_m = total_edges / dt / 1e6

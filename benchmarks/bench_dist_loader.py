@@ -1,0 +1,84 @@
+"""Benchmark: distributed loader scaling over mesh sizes.
+
+Counterpart of /root/reference/benchmarks/api/bench_dist_neighbor_loader.py
+(batches/s per worker count over its RPC mesh). Here the scaling axis is
+the graph-partition mesh axis 'g': one SPMD program samples P per-shard
+batches per step, so throughput is measured in SEED BATCHES (P * batch) per
+second at P = 1, 2, 4, 8.
+
+Runs on the virtual CPU device mesh by default (validates the scaling
+SHAPE of the collective sampling path — absolute numbers are CPU-bound;
+run on a real pod slice for chip figures).
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-nodes', type=int, default=200_000)
+  ap.add_argument('--avg-deg', type=int, default=15)
+  ap.add_argument('--batch-size', type=int, default=256)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
+  ap.add_argument('--mesh-sizes', default='1,2,4,8')
+  ap.add_argument('--iters', type=int, default=20)
+  ap.add_argument('--cpu-devices', type=int, default=8)
+  ap.add_argument('--tpu', action='store_true',
+                  help='use the attached TPU devices instead of the '
+                       'virtual CPU mesh (single-chip rigs only reach '
+                       'mesh_size=1)')
+  args = ap.parse_args()
+
+  import jax
+  if not args.tpu:
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', args.cpu_devices)
+  from jax.sharding import Mesh
+
+  sys.path.insert(0, __file__.rsplit('/', 2)[0])
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.typing import GraphPartitionData
+
+  n = args.num_nodes
+  rng = np.random.default_rng(0)
+  rows = rng.integers(0, n, n * args.avg_deg)
+  cols = rng.integers(0, n, n * args.avg_deg)
+  eids = np.arange(rows.shape[0])
+
+  for p in [int(x) for x in args.mesh_sizes.split(',')]:
+    if p > len(jax.devices()):
+      continue
+    node_pb = (np.arange(n) % p).astype(np.int32)
+    epb = node_pb[rows]
+    parts = []
+    for q in range(p):
+      m = epb == q
+      parts.append(GraphPartitionData(
+          edge_index=np.stack([rows[m], cols[m]]), eids=eids[m]))
+    mesh = Mesh(np.array(jax.devices()[:p]), ('g',))
+    dg = glt.distributed.DistGraph(p, 0, parts, node_pb)
+    sampler = glt.distributed.DistNeighborSampler(
+        dg, list(args.fanout), mesh, seed=0)
+    seeds = rng.integers(0, n, (p, args.batch_size)).astype(np.int32)
+    outs = [sampler.sample_from_nodes(seeds) for _ in range(3)]
+    jax.block_until_ready([o.edge_mask for o in outs])
+    t0 = time.perf_counter()
+    outs = [sampler.sample_from_nodes(seeds) for _ in range(args.iters)]
+    jax.block_until_ready([o.edge_mask for o in outs])
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        'metric': 'dist_loader_seed_batches_per_sec',
+        'mesh_size': p,
+        'value': round(args.iters * p / dt, 2),
+        'seeds_per_sec': round(args.iters * p * args.batch_size / dt, 1),
+        'secs': round(dt, 4),
+        'backend': jax.default_backend(),
+    }), flush=True)
+
+
+if __name__ == '__main__':
+  main()

@@ -31,35 +31,41 @@ N, F, B = 1_000_000, 128, 131072
 
 
 def main():
-  print('backend:', jax.default_backend(), flush=True)
+  # NO device->host fetch before the timed loops: the first D2H flips the
+  # axon runtime into its degraded synchronous dispatch mode (PERF.md) and
+  # every later timing measures per-call overhead, not the gather.
+  # Correctness checks run AFTER all timing.
   rng = np.random.default_rng(0)
   table = jnp.asarray(rng.random((N, F), np.float32))
   ids_np = rng.integers(0, N, B).astype(np.int32)
   ids = jnp.asarray(ids_np)
   take = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
 
-  small = gather_rows_hbm(table, ids[:256], block_rows=64, force=True)
-  np.testing.assert_allclose(np.asarray(small),
-                             np.asarray(table)[ids_np[:256]])
-  print('correctness OK', flush=True)
-
   cases = [('xla_take', lambda: take(table, ids))]
   for g in (64, 128, 256):
     cases.append((f'pallas_{g}',
                   lambda g=g: gather_rows_hbm(table, ids, block_rows=g,
                                               force=True)))
+  results = []
   for name, fn in cases:
     try:
       jax.block_until_ready(fn())
       t0 = time.perf_counter()
-      outs = [fn() for _ in range(20)]
+      outs = [fn() for _ in range(50)]
       jax.block_until_ready(outs)
       dt = time.perf_counter() - t0
-      gb = 20 * B * F * 4 / dt / (1024 ** 3)
-      print(f'{name}: {dt * 50:.2f} ms/call, {gb:.1f} GB/s', flush=True)
+      gb = 50 * B * F * 4 / dt / (1024 ** 3)
+      results.append(f'{name}: {dt * 20:.3f} ms/call, {gb:.1f} GB/s')
     except Exception as e:  # noqa: BLE001 — report and continue profiling
-      print(f'{name}: FAILED {type(e).__name__}: {str(e)[:200]}',
-            flush=True)
+      results.append(f'{name}: FAILED {type(e).__name__}: {str(e)[:200]}')
+
+  small = gather_rows_hbm(table, ids[:256], block_rows=64, force=True)
+  np.testing.assert_allclose(np.asarray(small),
+                             np.asarray(table)[ids_np[:256]])
+  print('backend:', jax.default_backend())
+  print('correctness OK')
+  for line in results:
+    print(line)
 
 
 if __name__ == '__main__':

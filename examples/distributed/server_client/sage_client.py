@@ -1,0 +1,65 @@
+"""Training CLIENT for server-client mode.
+
+Counterpart of /root/reference/examples/distributed/server_client_mode/
+sage_supervised_client.py: connects to the sampling server, streams
+sampled batches through a RemoteDistNeighborLoader, and trains locally.
+
+Run (after sage_server.py): \
+  python examples/distributed/server_client/sage_client.py --port 18777
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..',
+                                '..'))
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.models import GraphSAGE, train as train_lib
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--host', default='127.0.0.1')
+  ap.add_argument('--port', type=int, default=18777)
+  ap.add_argument('--num-nodes', type=int, default=20_000)
+  ap.add_argument('--epochs', type=int, default=1)
+  ap.add_argument('--batch-size', type=int, default=128)
+  args = ap.parse_args()
+
+  import jax
+  glt.distributed.init_client(
+      num_servers=1, num_clients=1, client_rank=0,
+      server_addrs=[(args.host, args.port)])
+
+  opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+      server_rank=0, num_workers=1, prefetch_size=2)
+  loader = glt.distributed.RemoteDistNeighborLoader(
+      [10, 5], np.arange(args.num_nodes), batch_size=args.batch_size,
+      collect_features=True, worker_options=opts, seed=0)
+
+  model = GraphSAGE(hidden_dim=128, out_dim=16, num_layers=2)
+  first = train_lib.batch_to_dict(next(iter(loader)))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  train_step, _ = train_lib.make_train_step(model, tx, 16)
+
+  losses = []
+  for epoch in range(args.epochs):
+    for batch in loader:
+      state, loss, acc = train_step(state, train_lib.batch_to_dict(batch))
+      losses.append(loss)
+  jax.block_until_ready(state)
+  print(json.dumps({'batches': len(losses),
+                    'first_loss': round(float(losses[0]), 4),
+                    'final_loss': round(float(losses[-1]), 4)}),
+        flush=True)
+  loader.shutdown()
+  glt.distributed.shutdown_client()
+
+
+if __name__ == '__main__':
+  main()

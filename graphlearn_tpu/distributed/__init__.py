@@ -1,6 +1,8 @@
 from .dist_context import (DistContext, DistRole, get_context,
                            init_multihost, init_worker_group)
 from .dist_dataset import DistDataset
+from .dist_random_partitioner import DistRandomPartitioner, shared_node_pb
+from .dist_table_dataset import DistTableDataset
 from .dist_feature import DistFeature
 from .dist_graph import DistGraph, DistHeteroGraph, build_local_csr
 from .dist_loader import (DistLinkNeighborLoader, DistLoader,

@@ -106,7 +106,17 @@ def init_multihost(coordinator_address: Optional[str] = None,
   from jax.sharding import Mesh
   devs = jax.devices()   # global: all processes' devices
   nparts = num_partitions or len(devs)
-  mesh = Mesh(np.array(devs[:nparts]), ('g',))
+  mesh_devs = devs[:nparts]
+  # every process must address at least one mesh device, or its
+  # global_device_put/shard_map calls have nothing local to run on
+  procs_in_mesh = {d.process_index for d in mesh_devs}
+  if len(procs_in_mesh) < jax.process_count():
+    raise ValueError(
+        f'num_partitions={nparts} selects devices from only '
+        f'{len(procs_in_mesh)}/{jax.process_count()} processes; use a '
+        'multiple of the per-process device count (or omit it) so every '
+        'host participates in the mesh')
+  mesh = Mesh(np.array(mesh_devs), ('g',))
   _dist_context = DistContext(jax.process_count(), jax.process_index(),
                               DistRole.WORKER, group_name, nparts, mesh)
   return _dist_context

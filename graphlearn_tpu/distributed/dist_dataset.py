@@ -24,11 +24,13 @@ class DistDataset:
   def __init__(self, num_partitions: int = 1, partition_idx: int = 0,
                dist_graph: Optional[DistGraph] = None,
                dist_feature: Optional[DistFeature] = None,
-               node_labels=None, node_feat_pb=None, edge_dir: str = 'out'):
+               node_labels=None, node_feat_pb=None, edge_dir: str = 'out',
+               edge_features: Optional[DistFeature] = None):
     self.num_partitions = num_partitions
     self.partition_idx = partition_idx
     self.graph = dist_graph
     self.node_features = dist_feature
+    self.edge_features = edge_features
     self.node_labels = node_labels
     self.node_feat_pb = node_feat_pb
     self.edge_dir = edge_dir
@@ -37,7 +39,9 @@ class DistDataset:
            edge_dir: str = 'out', feature_dtype=None,
            feature_with_cache: bool = True):
     """Load all partitions of `root_dir` and shard them over `mesh`
-    (reference: DistDataset.load, dist_dataset.py:78-167)."""
+    (reference: DistDataset.load, dist_dataset.py:78-167). Handles both
+    the homogeneous and the heterogeneous (per-type) partition layouts of
+    partition/base.py."""
     num_parts, g0, nf0, ef0, node_pb, edge_pb = load_partition(root_dir, 0)
     if mesh is None:
       from .dist_context import get_context
@@ -45,34 +49,71 @@ class DistDataset:
       mesh = ctx.mesh if ctx else None
     parts = [g0]
     nfeats = [nf0]
+    efeats = [ef0]
     for p in range(1, num_parts):
-      _, g, nf, _, _, _ = load_partition(root_dir, p)
+      _, g, nf, ef, _, _ = load_partition(root_dir, p)
       parts.append(g)
       nfeats.append(nf)
+      efeats.append(ef)
 
     self.num_partitions = num_parts
     self.edge_dir = edge_dir
-    self.graph = DistGraph(num_parts, 0, parts, node_pb, edge_pb,
-                           edge_dir)
-
-    if nf0 is not None:
-      feat_pb = node_pb.astype(np.int32).copy()
-      blocks = []
-      for p, nf in enumerate(nfeats):
-        if feature_with_cache and nf.cache_feats is not None:
-          feats, ids, feat_pb = cat_feature_cache(p, nf, feat_pb)
-        else:
-          feats, ids = nf.feats, nf.ids
-        blocks.append((ids, feats))
-      self.node_feat_pb = feat_pb
-      self.node_features = DistFeature(num_parts, blocks, node_pb,
-                                       mesh=mesh, dtype=feature_dtype)
-      # note: lookups route by the *graph* node_pb (each id's canonical
-      # owner); the cache raises the chance the row is also local, but
-      # canonical routing keeps responses unique. The feature pb with cache
-      # entries is kept for host-side locality decisions.
+    if isinstance(g0, dict):
+      from .dist_graph import DistHeteroGraph
+      self.graph = DistHeteroGraph(num_parts, 0, parts, node_pb,
+                                   edge_pb or None, edge_dir)
+      if nf0:
+        self.node_features = {}
+        self.node_feat_pb = {}
+        for nt in nf0:
+          feat_pb = node_pb[nt].astype(np.int32).copy()
+          blocks = []
+          for p, nf in enumerate(nfeats):
+            nft = nf[nt]
+            if feature_with_cache and nft.cache_feats is not None:
+              feats, ids, feat_pb = cat_feature_cache(p, nft, feat_pb)
+            else:
+              feats, ids = nft.feats, nft.ids
+            blocks.append((ids, feats))
+          self.node_feat_pb[nt] = feat_pb
+          self.node_features[nt] = DistFeature(
+              num_parts, blocks, node_pb[nt], mesh=mesh,
+              dtype=feature_dtype)
+      if ef0:
+        self.edge_features = {}
+        for et in ef0:
+          self.edge_features[et] = DistFeature(
+              num_parts,
+              [(ef[et].ids, ef[et].feats) for ef in efeats],
+              edge_pb[et], mesh=mesh, dtype=feature_dtype)
+    else:
+      self.graph = DistGraph(num_parts, 0, parts, node_pb, edge_pb,
+                             edge_dir)
+      if nf0 is not None:
+        feat_pb = node_pb.astype(np.int32).copy()
+        blocks = []
+        for p, nf in enumerate(nfeats):
+          if feature_with_cache and nf.cache_feats is not None:
+            feats, ids, feat_pb = cat_feature_cache(p, nf, feat_pb)
+          else:
+            feats, ids = nf.feats, nf.ids
+          blocks.append((ids, feats))
+        self.node_feat_pb = feat_pb
+        self.node_features = DistFeature(num_parts, blocks, node_pb,
+                                         mesh=mesh, dtype=feature_dtype)
+        # note: lookups route by the *graph* node_pb (each id's canonical
+        # owner); the cache raises the chance the row is also local, but
+        # canonical routing keeps responses unique. The feature pb with
+        # cache entries is kept for host-side locality decisions.
+      if ef0 is not None:
+        # edge features: sharded by the edge book (reference DistDataset
+        # keeps an edge Feature + edge_feat_pb, dist_dataset.py:149-162)
+        self.edge_features = DistFeature(
+            num_parts, [(ef.ids, ef.feats) for ef in efeats], edge_pb,
+            mesh=mesh, dtype=feature_dtype)
     if node_labels is not None:
-      self.node_labels = np.asarray(node_labels)
+      self.node_labels = (node_labels if isinstance(node_labels, dict)
+                          else np.asarray(node_labels))
     return self
 
   @property

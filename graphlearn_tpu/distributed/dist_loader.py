@@ -73,11 +73,13 @@ class DistLoader:
       yield idx.reshape(shape), mask
 
   def __iter__(self):
-    for idx, mask in self._index_blocks():
-      out = self.sampler.sample_from_nodes(
-          NodeSamplerInput(self.input_seeds[idx], self.input_type),
-          seed_mask=mask)
-      yield self._collate_fn(out)
+    from ..utils import step_annotation
+    for i, (idx, mask) in enumerate(self._index_blocks()):
+      with step_annotation('glt_dist_batch', i):
+        out = self.sampler.sample_from_nodes(
+            NodeSamplerInput(self.input_seeds[idx], self.input_type),
+            seed_mask=mask)
+        yield self._collate_fn(out)
 
   def _collate_fn(self, out):
     """SamplerOutput [P, ...] -> stacked Data/HeteroData (reference:
@@ -92,17 +94,35 @@ class DistLoader:
     if isinstance(out, HeteroSamplerOutput):
       ei = {et: ops.stack2_batched(out.row[et], out.col[et])
             for et in out.row}
+      edge_attr = None
+      efs = getattr(self.data, 'edge_features', None)
+      if out.edge is not None and efs:
+        # batches key edges by the message-direction (reversed) type; the
+        # ids belong to the ORIGINAL edge type's id space
+        from ..typing import reverse_edge_type
+        edge_attr = {}
+        for et in out.edge:
+          src_et = (reverse_edge_type(et) if self.data.edge_dir == 'out'
+                    else et)
+          if src_et in efs:
+            edge_attr[et] = efs[src_et].get(out.edge[et])
+        edge_attr = edge_attr or None
       return HeteroData(node=out.node, num_nodes=out.num_nodes,
                         edge_index=ei, edge_mask=out.edge_mask, x=x, y=y,
-                        edge_ids=out.edge, batch=out.batch,
+                        edge_ids=out.edge, edge_attr=edge_attr,
+                        batch=out.batch,
                         batch_size=out.batch_size,
                         num_sampled_nodes=out.num_sampled_nodes,
                         num_sampled_edges=out.num_sampled_edges,
                         metadata=dict(out.metadata))
+    edge_attr = None
+    if out.edge is not None and \
+        getattr(self.data, 'edge_features', None) is not None:
+      edge_attr = self.data.edge_features.get(out.edge)
     ei = ops.stack2_batched(out.row, out.col)  # [P, 2, E]
     return Data(node=out.node, num_nodes=out.num_nodes,
                 edge_index=ei, edge_mask=out.edge_mask, x=x, y=y,
-                edge_ids=out.edge, batch=out.batch,
+                edge_ids=out.edge, edge_attr=edge_attr, batch=out.batch,
                 batch_size=out.batch_size,
                 num_sampled_nodes=out.num_sampled_nodes,
                 num_sampled_edges=out.num_sampled_edges,
@@ -147,6 +167,8 @@ class MpDistNeighborLoader:
       try:
         msg = self.channel.recv(timeout_ms=60000)
       except self._timeout_error:
+        self.producer.check_worker_health()   # crashed worker -> raise,
+        # don't spin on an empty channel forever
         if self.producer.is_all_sampling_completed() and \
             self.channel.empty():
           break

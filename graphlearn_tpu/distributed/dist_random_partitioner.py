@@ -1,4 +1,4 @@
-"""Parallel (multi-rank) random partitioning.
+"""Parallel (multi-rank) random partitioning, homo + hetero.
 
 TPU-native re-design of
 /root/reference/graphlearn_torch/python/distributed/dist_random_partitioner.py:
@@ -11,13 +11,17 @@ filesystem (each rank writes its chunks into the target partition's spool
 dir — the reference's on-disk layout already assumes a shared/collected
 view); (c) a light TCP barrier (distributed/rpc.py) sequences the phases.
 
+Heterogeneous inputs (dicts keyed by node/edge type, reference
+:299-538) produce the hetero layout of partition/base.py: per-type books
+under node_pb/ + edge_pb/, per-type payloads under part{p}/graph/ etc.
+
 Output layout matches partition/base.py exactly, so DistDataset.load reads
 it unchanged.
 """
 import json
 import os
 import shutil
-from typing import Optional
+from typing import Dict, Optional, Union
 
 import numpy as np
 
@@ -38,31 +42,32 @@ def shared_node_pb(num_nodes: int, num_parts: int, seed: int) -> np.ndarray:
 
 
 class DistRandomPartitioner:
-  """Reference: dist_random_partitioner.py:129-538 (homogeneous path).
+  """Reference: dist_random_partitioner.py:129-538.
 
   Args:
     output_dir: shared filesystem target.
-    num_nodes: global node count.
-    edge_index / edge_ids / node_feat / node_feat_ids: THIS RANK's slice.
+    num_nodes: global node count (dict per ntype for hetero).
+    edge_index / edge_ids / node_feat / node_feat_ids: THIS RANK's slice
+      (dicts keyed by edge/node type for hetero).
     num_parts: partition count (defaults to world_size).
     rank / world_size: this rank's coordinates.
     master_addr/master_port: rank-0 barrier endpoint (None => single rank).
   """
 
-  def __init__(self, output_dir: str, num_nodes: int, edge_index,
+  def __init__(self, output_dir: str,
+               num_nodes: Union[int, Dict], edge_index,
                edge_ids=None, node_feat=None, node_feat_ids=None,
                num_parts: Optional[int] = None, rank: int = 0,
                world_size: int = 1, master_addr: str = '127.0.0.1',
                master_port: Optional[int] = None, seed: int = 0,
                edge_assign_strategy: str = 'by_src'):
     self.output_dir = output_dir
+    self.is_hetero = isinstance(edge_index, dict)
     self.num_nodes = num_nodes
-    self.edge_index = np.asarray(edge_index)
-    self.edge_ids = (np.asarray(edge_ids) if edge_ids is not None
-                     else None)
+    self.edge_index = edge_index
+    self.edge_ids = edge_ids
     self.node_feat = node_feat
-    self.node_feat_ids = (np.asarray(node_feat_ids)
-                          if node_feat_ids is not None else None)
+    self.node_feat_ids = node_feat_ids
     self.num_parts = num_parts or world_size
     self.rank = rank
     self.world_size = world_size
@@ -72,6 +77,7 @@ class DistRandomPartitioner:
     self.edge_assign_strategy = edge_assign_strategy
     self._server = None
     self._client = None
+    self._phase = 0
 
   # -- barrier plumbing ----------------------------------------------------
 
@@ -79,92 +85,199 @@ class DistRandomPartitioner:
     if self.world_size <= 1:
       return
     if self.rank == 0:
-      self._server = RpcServer(self.master_addr, self.master_port or 0)
       barrier = Barrier(self.world_size)
-      self._server.register('partition_barrier', barrier.arrive)
+      self._server = RpcServer(
+          self.master_addr, self.master_port or 0,
+          handlers={'partition_barrier': barrier.arrive})
       self.master_port = self._server.port
     self._client = RpcClient()
     self._client.add_target(0, self.master_addr, self.master_port)
 
   def _barrier(self):
-    if self._client is not None:
-      self._client.request_sync(0, 'partition_barrier', self.rank)
+    if self._client is None:
+      return
+    # rank 0 binds its barrier server concurrently with the other ranks'
+    # first arrival — retry refused connections instead of dying (which
+    # would strand rank 0 in a 180 s barrier timeout). The phase counter
+    # makes retries idempotent across generations (rpc.Barrier.arrive).
+    import time
+    phase = self._phase
+    self._phase += 1
+    deadline = time.monotonic() + 60
+    while True:
+      try:
+        self._client.request_sync(0, 'partition_barrier', self.rank,
+                                  phase=phase)
+        return
+      except (ConnectionError, OSError):
+        if time.monotonic() > deadline:
+          raise
+        time.sleep(0.2)
+
+  # -- typed views ---------------------------------------------------------
+
+  def _ntypes(self):
+    if not self.is_hetero:
+      return [None]
+    return sorted({t for et in self.edge_index for t in (et[0], et[2])})
+
+  def _etypes(self):
+    return list(self.edge_index) if self.is_hetero else [None]
+
+  def _node_pb_for(self, ntype):
+    """Every rank derives the same per-type book from (seed, type)."""
+    n = (self.num_nodes[ntype] if self.is_hetero else self.num_nodes)
+    off = 0 if ntype is None else self._ntypes().index(ntype)
+    return shared_node_pb(n, self.num_parts, self.seed + off)
+
+  def _sel(self, maybe_dict, key):
+    if maybe_dict is None:
+      return None
+    return maybe_dict.get(key) if isinstance(maybe_dict, dict) \
+        else (maybe_dict if key is None else None)
+
+  def _tag(self, type_):
+    return '' if type_ is None else '_' + _type_str(type_).replace(
+        os.sep, '-')
 
   # -- partitioning --------------------------------------------------------
 
   def partition(self) -> str:
     self._init_comm()
-    node_pb = shared_node_pb(self.num_nodes, self.num_parts, self.seed)
+    ntypes, etypes = self._ntypes(), self._etypes()
+    node_pbs = {nt: self._node_pb_for(nt) for nt in ntypes}
 
     # phase 1: every rank spools its slice's chunks into target partitions
-    rows, cols = self.edge_index[0], self.edge_index[1]
-    eids = (self.edge_ids if self.edge_ids is not None
-            else np.arange(rows.shape[0], dtype=np.int64))
-    key = rows if self.edge_assign_strategy == 'by_src' else cols
-    edge_owner = node_pb[key]
-    for p in range(self.num_parts):
-      spool = os.path.join(self.output_dir, f'part{p}', '_spool')
-      os.makedirs(spool, exist_ok=True)
-      m = edge_owner == p
-      np.savez(os.path.join(spool, f'graph_rank{self.rank}.npz'),
-               rows=rows[m], cols=cols[m], eids=eids[m])
-      if self.node_feat is not None:
-        fids = (self.node_feat_ids if self.node_feat_ids is not None
-                else np.arange(np.asarray(self.node_feat).shape[0]))
-        fm = node_pb[fids] == p
-        np.savez(os.path.join(spool, f'feat_rank{self.rank}.npz'),
-                 feats=np.asarray(self.node_feat)[fm], ids=fids[fm])
+    for et in etypes:
+      ei = np.asarray(self.edge_index[et] if self.is_hetero
+                      else self.edge_index)
+      rows, cols = ei[0].reshape(-1), ei[1].reshape(-1)
+      eids = self._sel(self.edge_ids, et)
+      eids = (np.asarray(eids) if eids is not None
+              else np.arange(rows.shape[0], dtype=np.int64))
+      if self.is_hetero:
+        key_pb = node_pbs[et[0] if self.edge_assign_strategy == 'by_src'
+                          else et[2]]
+      else:
+        key_pb = node_pbs[None]
+      key = rows if self.edge_assign_strategy == 'by_src' else cols
+      edge_owner = key_pb[key]
+      for p in range(self.num_parts):
+        spool = os.path.join(self.output_dir, f'part{p}', '_spool')
+        os.makedirs(spool, exist_ok=True)
+        m = edge_owner == p
+        np.savez(os.path.join(
+            spool, f'graph{self._tag(et)}_rank{self.rank}.npz'),
+            rows=rows[m], cols=cols[m], eids=eids[m])
+    for nt in ntypes:
+      feat = self._sel(self.node_feat, nt)
+      if feat is None:
+        continue
+      feat = np.asarray(feat)
+      fids = self._sel(self.node_feat_ids, nt)
+      fids = (np.asarray(fids) if fids is not None
+              else np.arange(feat.shape[0]))
+      pb = node_pbs[nt]
+      for p in range(self.num_parts):
+        spool = os.path.join(self.output_dir, f'part{p}', '_spool')
+        os.makedirs(spool, exist_ok=True)
+        fm = pb[fids] == p
+        np.savez(os.path.join(
+            spool, f'feat{self._tag(nt)}_rank{self.rank}.npz'),
+            feats=feat[fm], ids=fids[fm])
     self._barrier()
 
     # phase 2: each rank merges the partitions it owns (round-robin)
     for p in range(self.rank, self.num_parts, self.world_size):
       part_dir = os.path.join(self.output_dir, f'part{p}')
       spool = os.path.join(part_dir, '_spool')
-      g_chunks = sorted(f for f in os.listdir(spool)
-                        if f.startswith('graph_rank'))
-      rows_l, cols_l, eids_l = [], [], []
-      for f in g_chunks:
-        with np.load(os.path.join(spool, f)) as z:
-          rows_l.append(z['rows'])
-          cols_l.append(z['cols'])
-          eids_l.append(z['eids'])
-      np.savez(os.path.join(part_dir, 'graph.npz'),
-               rows=np.concatenate(rows_l), cols=np.concatenate(cols_l),
-               eids=np.concatenate(eids_l))
-      f_chunks = sorted(f for f in os.listdir(spool)
-                        if f.startswith('feat_rank'))
-      if f_chunks:
+      for et in etypes:
+        pre = f'graph{self._tag(et)}_rank'
+        chunks = sorted(f for f in os.listdir(spool) if f.startswith(pre))
+        rows_l, cols_l, eids_l = [], [], []
+        for f in chunks:
+          with np.load(os.path.join(spool, f)) as z:
+            rows_l.append(z['rows'])
+            cols_l.append(z['cols'])
+            eids_l.append(z['eids'])
+        payload = dict(rows=np.concatenate(rows_l),
+                       cols=np.concatenate(cols_l),
+                       eids=np.concatenate(eids_l))
+        if et is None:
+          np.savez(os.path.join(part_dir, 'graph.npz'), **payload)
+        else:
+          d = os.path.join(part_dir, 'graph')
+          os.makedirs(d, exist_ok=True)
+          np.savez(os.path.join(d, f'{_type_str(et)}.npz'), **payload)
+      for nt in ntypes:
+        pre = f'feat{self._tag(nt)}_rank'
+        chunks = sorted(f for f in os.listdir(spool) if f.startswith(pre))
+        if not chunks:
+          continue
         feats_l, ids_l = [], []
-        for f in f_chunks:
+        for f in chunks:
           with np.load(os.path.join(spool, f)) as z:
             feats_l.append(z['feats'])
             ids_l.append(z['ids'])
         ids = np.concatenate(ids_l)
         order = np.argsort(ids)
-        np.savez(os.path.join(part_dir, 'node_feat.npz'),
-                 feats=np.concatenate(feats_l)[order], ids=ids[order])
+        payload = dict(feats=np.concatenate(feats_l)[order],
+                       ids=ids[order])
+        if nt is None:
+          np.savez(os.path.join(part_dir, 'node_feat.npz'), **payload)
+        else:
+          d = os.path.join(part_dir, 'node_feat')
+          os.makedirs(d, exist_ok=True)
+          np.savez(os.path.join(d, f'{nt}.npz'), **payload)
       shutil.rmtree(spool)
+    # all ranks must finish merging before rank 0 reads the merged graphs
+    # to rebuild the edge books
+    self._barrier()
 
     if self.rank == 0:
-      np.save(os.path.join(self.output_dir, 'node_pb.npy'), node_pb)
-      # edge book: derived per-rank slices are merged implicitly; rebuild
-      # from the merged graphs for exactness
-      total_edges = 0
-      for p in range(self.num_parts):
-        with np.load(os.path.join(self.output_dir, f'part{p}',
-                                  'graph.npz')) as z:
-          total_edges = max(total_edges,
-                            int(z['eids'].max()) + 1 if z['eids'].size
-                            else 0)
-      edge_pb = np.zeros(total_edges, dtype=np.int32)
-      for p in range(self.num_parts):
-        with np.load(os.path.join(self.output_dir, f'part{p}',
-                                  'graph.npz')) as z:
-          edge_pb[z['eids']] = p
-      np.save(os.path.join(self.output_dir, 'edge_pb.npy'), edge_pb)
-      with open(os.path.join(self.output_dir, 'META.json'), 'w') as f:
-        json.dump(dict(num_parts=self.num_parts, hetero=False), f)
+      self._write_books_and_meta(node_pbs, ntypes, etypes)
     self._barrier()
     if self._server is not None:
       self._server.shutdown()
     return self.output_dir
+
+  def _edge_pb_from_merged(self, et) -> np.ndarray:
+    """Rebuild the edge book from the merged per-partition graphs."""
+    total = 0
+    loads = []
+    for p in range(self.num_parts):
+      path = (os.path.join(self.output_dir, f'part{p}', 'graph.npz')
+              if et is None else
+              os.path.join(self.output_dir, f'part{p}', 'graph',
+                           f'{_type_str(et)}.npz'))
+      with np.load(path) as z:
+        eids = z['eids']
+      loads.append(eids)
+      total = max(total, int(eids.max()) + 1 if eids.size else 0)
+    pb = np.zeros(total, dtype=np.int32)
+    for p, eids in enumerate(loads):
+      pb[eids] = p
+    return pb
+
+  def _write_books_and_meta(self, node_pbs, ntypes, etypes):
+    if self.is_hetero:
+      nd = os.path.join(self.output_dir, 'node_pb')
+      os.makedirs(nd, exist_ok=True)
+      for nt in ntypes:
+        np.save(os.path.join(nd, f'{nt}.npy'), node_pbs[nt])
+      ed = os.path.join(self.output_dir, 'edge_pb')
+      os.makedirs(ed, exist_ok=True)
+      for et in etypes:
+        np.save(os.path.join(ed, f'{_type_str(et)}.npy'),
+                self._edge_pb_from_merged(et))
+      meta = dict(num_parts=self.num_parts, hetero=True,
+                  node_types=ntypes,
+                  edge_types=[list(et) for et in etypes])
+    else:
+      np.save(os.path.join(self.output_dir, 'node_pb.npy'),
+              node_pbs[None])
+      np.save(os.path.join(self.output_dir, 'edge_pb.npy'),
+              self._edge_pb_from_merged(None))
+      meta = dict(num_parts=self.num_parts, hetero=False)
+    with open(os.path.join(self.output_dir, 'META.json'), 'w') as f:
+      json.dump(meta, f)

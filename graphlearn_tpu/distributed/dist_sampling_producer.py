@@ -134,6 +134,16 @@ class DistMpSamplingProducer:
     with self._done.get_lock():
       return self._done.value == self.num_workers
 
+  def check_worker_health(self):
+    """Raise if a sampling subprocess died abnormally (failure detection —
+    the reference's mp workers likewise surface nonzero exits,
+    dist_sampling_producer.py worker join handling)."""
+    for p in self._procs:
+      if p.exitcode is not None and p.exitcode != 0:
+        raise RuntimeError(
+            f'sampling worker pid={p.pid} died with exit code '
+            f'{p.exitcode}')
+
   def num_expected(self) -> int:
     bs = self.config.batch_size
     total = 0
@@ -151,6 +161,10 @@ class DistMpSamplingProducer:
     for p in self._procs:
       p.join(timeout=5)
       if p.is_alive():
+        import logging
+        logging.getLogger('graphlearn_tpu.producer').warning(
+            'sampling worker %s did not exit within 5s; terminating',
+            p.pid)
         p.terminate()
 
 

@@ -157,21 +157,23 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
   global _server, _rpc_server
   _set_server_context(num_servers, num_clients, server_rank)
   _server = DistServer(dataset)
-  _rpc_server = RpcServer(master_addr, server_client_master_port)
   s = _server
-  _rpc_server.register('create_sampling_producer',
-                       s.create_sampling_producer)
-  _rpc_server.register('producer_num_expected', s.producer_num_expected)
-  _rpc_server.register('start_new_epoch_sampling',
-                       s.start_new_epoch_sampling)
-  _rpc_server.register('fetch_one_sampled_message',
-                       s.fetch_one_sampled_message)
-  _rpc_server.register('destroy_sampling_producer',
-                       s.destroy_sampling_producer)
-  _rpc_server.register('get_dataset_meta', s.get_dataset_meta)
-  _rpc_server.register('exit', s.exit)
   barrier = Barrier(num_clients)
-  _rpc_server.register('client_barrier', barrier.arrive)
+  # handlers registered at construction: the server accepts connections
+  # the moment it binds, and a fast client must not see a half-registered
+  # callee table (see RpcServer docstring)
+  _rpc_server = RpcServer(
+      master_addr, server_client_master_port,
+      handlers={
+          'create_sampling_producer': s.create_sampling_producer,
+          'producer_num_expected': s.producer_num_expected,
+          'start_new_epoch_sampling': s.start_new_epoch_sampling,
+          'fetch_one_sampled_message': s.fetch_one_sampled_message,
+          'destroy_sampling_producer': s.destroy_sampling_producer,
+          'get_dataset_meta': s.get_dataset_meta,
+          'exit': s.exit,
+          'client_barrier': barrier.arrive,
+      })
   return _rpc_server.host, _rpc_server.port
 
 

@@ -21,6 +21,7 @@ only on trusted, isolated cluster networks. The default bind is loopback;
 when passing a routable ``master_addr``, the network boundary (VPC /
 firewall / pod network policy) IS the security boundary.
 """
+import logging
 import pickle
 import socket
 import socketserver
@@ -28,6 +29,8 @@ import struct
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger('graphlearn_tpu.rpc')
 
 _HDR = struct.Struct('<Q')
 
@@ -63,8 +66,12 @@ class RpcCalleeBase:
 class RpcServer:
   """Threaded socket server dispatching registered callees."""
 
-  def __init__(self, host: str = '127.0.0.1', port: int = 0):
-    self._handlers: Dict[str, Callable] = {}
+  def __init__(self, host: str = '127.0.0.1', port: int = 0,
+               handlers: Optional[Dict[str, Callable]] = None):
+    # handlers passed here are registered BEFORE the server starts
+    # accepting — register() after construction races incoming requests
+    self._handlers: Dict[str, Callable] = dict(handlers) if handlers \
+        else {}
     outer = self
 
     class Handler(socketserver.BaseRequestHandler):
@@ -133,14 +140,48 @@ class RpcClient:
       conns[rank] = s
     return conns[rank]
 
-  def request_sync(self, rank: int, func: str, *args, **kwargs):
-    """reference: rpc_request / _rpc_call sync path (rpc.py:422-447)"""
-    sock = self._conn(rank)
-    _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs})
-    resp = _recv_frame(sock)
-    if not resp['ok']:
-      raise RuntimeError(f'remote error from rank {rank}: {resp["error"]}')
-    return resp['result']
+  def _drop_conn(self, rank: int):
+    conns = getattr(self._local, 'conns', None)
+    if conns and rank in conns:
+      try:
+        conns.pop(rank).close()
+      except OSError:
+        pass
+
+  def request_sync(self, rank: int, func: str, *args,
+                   timeout: Optional[float] = None, retries: int = 0,
+                   **kwargs):
+    """reference: rpc_request / _rpc_call sync path (rpc.py:422-447).
+
+    ``timeout`` bounds each attempt (socket-level, seconds; the reference
+    wraps every RPC in rpc_timeout, rpc.py:92-117); ``retries`` re-sends
+    on connection failure/timeout over a FRESH connection. Retries are
+    only safe for idempotent callees.
+    """
+    last_err = None
+    for attempt in range(retries + 1):
+      try:
+        sock = self._conn(rank)
+        if timeout is not None:
+          sock.settimeout(timeout)
+        _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs})
+        resp = _recv_frame(sock)
+        if timeout is not None:
+          sock.settimeout(180)
+        if not resp['ok']:
+          raise RuntimeError(
+              f'remote error from rank {rank}: {resp["error"]}')
+        return resp['result']
+      except (ConnectionError, EOFError, socket.timeout, OSError) as e:
+        last_err = e
+        self._drop_conn(rank)
+        if attempt >= retries:
+          raise TimeoutError(
+              f'rpc to rank {rank} func {func!r} failed after '
+              f'{attempt + 1} attempt(s): {e}') from e
+        logger.warning('rpc to rank %d func %r failed (%s); retrying '
+                       '(%d/%d)', rank, func, e, attempt + 1, retries)
+    raise last_err  # unreachable
 
   def request_async(self, rank: int, func: str, *args, **kwargs) -> Future:
     """reference: rpc_request_async (rpc.py:422-447)"""
@@ -182,14 +223,32 @@ class Barrier:
     self._gen = 0
     self._cv = threading.Condition()
     self._values: Dict[int, Any] = {}
+    self._arrived = set()
 
-  def arrive(self, rank: int, value: Any = None, timeout: float = 180.0):
+  def arrive(self, rank: int, value: Any = None, timeout: float = 180.0,
+             phase: Optional[int] = None):
+    """``phase`` (optional, monotonically increasing per caller) makes
+    retries fully idempotent: a retry of an ALREADY-RELEASED phase
+    returns immediately instead of being miscounted into the next
+    generation (a retry can arrive late when only the response was
+    lost)."""
     with self._cv:
       gen = self._gen
+      if phase is not None and phase < gen:
+        return dict(self._values)   # stale retry of a released phase
+      if rank in self._arrived:
+        # duplicate arrival within a generation (client retried after a
+        # lost response): wait for the release, don't double-count
+        if not self._cv.wait_for(lambda: self._gen > gen,
+                                 timeout=timeout):
+          raise TimeoutError('barrier timeout')
+        return dict(self._values)
+      self._arrived.add(rank)
       self._values[rank] = value
       self._count += 1
       if self._count == self._world:
         self._count = 0
+        self._arrived.clear()
         self._gen += 1
         self._cv.notify_all()
       else:

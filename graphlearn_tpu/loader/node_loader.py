@@ -71,12 +71,14 @@ class NodeLoader:
     return len(self._batcher)
 
   def __iter__(self):
-    for idx in self._batcher:
-      seeds = self.input_seeds[idx]
-      out = self.sampler.sample_from_nodes(
-          NodeSamplerInput(seeds, self.input_type),
-          batch_cap=self.batch_size)
-      yield self._collate_fn(out)
+    from ..utils import step_annotation
+    for i, idx in enumerate(self._batcher):
+      with step_annotation('glt_batch', i):
+        seeds = self.input_seeds[idx]
+        out = self.sampler.sample_from_nodes(
+            NodeSamplerInput(seeds, self.input_type),
+            batch_cap=self.batch_size)
+        yield self._collate_fn(out)
 
   # -- collate (reference: node_loader.py:85-113) --------------------------
   #

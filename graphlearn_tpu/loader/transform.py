@@ -92,6 +92,7 @@ class HeteroData:
   x: Dict[NodeType, Any] = None
   y: Dict[NodeType, Any] = None
   edge_ids: Dict[EdgeType, Any] = None
+  edge_attr: Dict[EdgeType, Any] = None
   batch: Dict[NodeType, Any] = None
   batch_size: Optional[int] = None
   num_sampled_nodes: Any = None

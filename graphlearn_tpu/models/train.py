@@ -80,6 +80,52 @@ def make_eval_counts(model):
   return eval_counts
 
 
+def make_link_train_step(model, tx):
+  """Jitted unsupervised/link-prediction step: dot-product scores on the
+  batch's ``edge_label_index`` pairs, sigmoid BCE against ``edge_label``
+  (1 for positives, 0 for the sampled negatives — the reference's
+  unsupervised SAGE objective, examples/graph_sage_unsup_ppi.py loss).
+  Pairs with -1 indices (masked negatives / pad seeds) are excluded."""
+
+  def loss_fn(params, batch):
+    h = model.apply(params, batch['x'], batch['edge_index'],
+                    batch['edge_mask'])
+    eli = batch['edge_label_index']
+    lab = batch['edge_label'].astype(jnp.float32)
+    valid = (eli[0] >= 0) & (eli[1] >= 0)
+    src = h[jnp.maximum(eli[0], 0)]
+    dst = h[jnp.maximum(eli[1], 0)]
+    score = (src * dst).sum(-1)
+    bce = optax.sigmoid_binary_cross_entropy(score, lab)
+    bce = jnp.where(valid, bce, 0.0)
+    loss = bce.sum() / jnp.maximum(valid.sum(), 1)
+    hit = ((score > 0) == (lab > 0.5)) & valid
+    acc = hit.sum() / jnp.maximum(valid.sum(), 1)
+    return loss, acc
+
+  @jax.jit
+  def train_step(state: TrainState, batch):
+    (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params, batch)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss, acc
+
+  @jax.jit
+  def eval_step(state: TrainState, batch):
+    return loss_fn(state.params, batch)[1]
+
+  return train_step, eval_step
+
+
+def link_batch_to_dict(batch):
+  """`loader.Data` from a Link(Neighbor)Loader -> jitted-step dict."""
+  return dict(x=batch.x, edge_index=batch.edge_index,
+              edge_mask=batch.edge_mask,
+              edge_label_index=batch.metadata['edge_label_index'],
+              edge_label=batch.metadata['edge_label'])
+
+
 def batch_to_dict(batch):
   """`loader.Data` -> the flat dict the jitted step consumes."""
   num_seed = (batch.num_sampled_nodes[0]

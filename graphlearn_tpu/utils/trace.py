@@ -1,0 +1,64 @@
+"""Profiling/tracing hooks over jax.profiler.
+
+The reference leans on torch.profiler + nvtx ranges in its benchmarks; the
+TPU equivalents are XLA's profiler traces (viewable in TensorBoard /
+Perfetto). These helpers are no-ops when no trace is active, so loaders
+annotate unconditionally.
+
+Usage:
+    with glt.utils.profile_trace('/tmp/glt_trace'):
+      for batch in loader:   # each batch shows up as a named step
+        train_step(batch)
+
+or env-driven: set GLT_PROFILE_DIR and call maybe_start_trace() /
+stop_trace() around the region of interest (bench.py honors it).
+"""
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str) -> Iterator[None]:
+  """Capture a jax.profiler trace for the enclosed region."""
+  import jax
+  jax.profiler.start_trace(logdir)
+  try:
+    yield
+  finally:
+    jax.profiler.stop_trace()
+
+
+def annotate(name: str, **kwargs):
+  """Named range inside an active trace (no-op otherwise)."""
+  import jax
+  return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def step_annotation(name: str, step: int):
+  """Step-numbered range (loader batches, train steps)."""
+  import jax
+  return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+_active = False
+
+
+def maybe_start_trace(env_var: str = 'GLT_PROFILE_DIR') -> Optional[str]:
+  """Start a trace if ``env_var`` names a directory; returns the dir."""
+  global _active
+  logdir = os.environ.get(env_var)
+  if logdir and not _active:
+    import jax
+    jax.profiler.start_trace(logdir)
+    _active = True
+    return logdir
+  return None
+
+
+def stop_trace():
+  global _active
+  if _active:
+    import jax
+    jax.profiler.stop_trace()
+    _active = False

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Deterministic native build (the runtime also builds lazily on first
+# import via graphlearn_tpu.utils.build). Mirrors the reference's
+# install.sh native step.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python - <<'EOF'
+from graphlearn_tpu.utils.build import build_native
+print('built:', build_native(force=True))
+EOF

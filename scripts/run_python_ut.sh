@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# CI entry: full unit-test suite on the virtual CPU mesh (the reference's
+# scripts/run_python_ut.sh equivalent). Safe on machines without a TPU —
+# tests/conftest.py forces the CPU backend with 8 virtual devices.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q "$@"

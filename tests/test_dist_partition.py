@@ -1,0 +1,169 @@
+"""Parallel partitioner + tabular ingestion tests (reference:
+test/python/test_dist_random_partitioner.py + dist_table_dataset.py usage).
+
+Multi-rank is exercised with threads over a shared tmp dir — the
+partitioner is pure numpy + a TCP barrier, so threads model separate
+processes faithfully."""
+import threading
+
+import numpy as np
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.distributed import (DistDataset, DistRandomPartitioner,
+                                        DistTableDataset)
+from graphlearn_tpu.partition import load_partition
+from graphlearn_tpu.utils import get_free_port
+
+N = 40
+
+
+def ring(n=N):
+  rows = np.concatenate([np.arange(n), np.arange(n)])
+  cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n])
+  return rows, cols
+
+
+def make_mesh(num_parts):
+  import jax
+  from jax.sharding import Mesh
+  return Mesh(np.array(jax.devices()[:num_parts]), ('g',))
+
+
+def test_dist_random_partitioner_homo_2ranks(tmp_path):
+  rows, cols = ring()
+  eids = np.arange(2 * N)
+  feat = np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  port = get_free_port()
+
+  def run(rank):
+    sl = slice(rank, None, 2)
+    DistRandomPartitioner(
+        str(tmp_path), N, np.stack([rows[sl], cols[sl]]), eids[sl],
+        feat[rank::2], np.arange(N)[rank::2], num_parts=2, rank=rank,
+        world_size=2, master_port=port, seed=0).partition()
+
+  ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+  for t in ts:
+    t.start()
+  for t in ts:
+    t.join(120)
+  num_parts, g0, nf0, _, node_pb, edge_pb = load_partition(str(tmp_path),
+                                                           0)
+  _, g1, nf1, _, _, _ = load_partition(str(tmp_path), 1)
+  assert num_parts == 2
+  # all edges present exactly once across parts
+  all_eids = np.concatenate([g0.eids, g1.eids])
+  assert sorted(all_eids.tolist()) == list(range(2 * N))
+  # edges owned by their src's partition
+  assert (node_pb[g0.edge_index[0]] == 0).all()
+  assert (edge_pb[g0.eids] == 0).all()
+  # features: every node's row present in its owner partition
+  for p, nf in ((0, nf0), (1, nf1)):
+    np.testing.assert_allclose(nf.feats[:, 0], nf.ids)
+    assert (node_pb[nf.ids] == p).all()
+  assert nf0.ids.shape[0] + nf1.ids.shape[0] == N
+
+
+def test_dist_random_partitioner_hetero_and_load(tmp_path):
+  """2-rank hetero partition -> DistDataset.load -> mesh sample step."""
+  et1, et2 = ('u', 'to', 'v'), ('v', 'back', 'u')
+  r1 = np.arange(N)
+  c1 = (np.arange(N) + 1) % N
+  r2 = np.arange(N)
+  c2 = (np.arange(N) + 2) % N
+  nfeat = {
+      'u': np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                             np.float32),
+      'v': 1000.0 + np.arange(N, dtype=np.float32)[:, None] * np.ones(
+          (1, 4), np.float32),
+  }
+  port = get_free_port()
+
+  def run(rank):
+    sl = slice(rank, None, 2)
+    DistRandomPartitioner(
+        str(tmp_path), {'u': N, 'v': N},
+        {et1: np.stack([r1[sl], c1[sl]]), et2: np.stack([r2[sl], c2[sl]])},
+        {et1: np.arange(N)[sl], et2: np.arange(N)[sl]},
+        {t: f[rank::2] for t, f in nfeat.items()},
+        {t: np.arange(N)[rank::2] for t in nfeat},
+        num_parts=2, rank=rank, world_size=2, master_port=port,
+        seed=0).partition()
+
+  ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+  for t in ts:
+    t.start()
+  for t in ts:
+    t.join(120)
+
+  mesh = make_mesh(2)
+  ds = DistDataset().load(str(tmp_path), mesh=mesh)
+  assert ds.graph.is_hetero
+  assert set(ds.graph.etypes) == {et1, et2}
+  loader = glt.distributed.DistNeighborLoader(
+      ds, {et1: [2], et2: [1]}, ('u', np.arange(N)), batch_size=4,
+      seed=0, mesh=mesh)
+  batch = next(iter(loader))
+  for t, base in (('u', 0.0), ('v', 1000.0)):
+    node = np.asarray(batch.node[t])
+    x = np.asarray(batch.x[t])
+    for p in range(2):
+      nn = int(np.asarray(batch.num_nodes[t])[p])
+      if nn:
+        np.testing.assert_allclose(x[p, :nn, 0], base + node[p, :nn])
+
+
+def test_dist_edge_features_end_to_end(tmp_path):
+  """Partition with edge features -> DistDataset.load -> loader batches
+  carry edge_attr gathered by global edge id."""
+  rows, cols = ring()
+  feat = np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  efeat = np.arange(2 * N, dtype=np.float32)[:, None] * np.ones(
+      (1, 3), np.float32)
+  glt.partition.RandomPartitioner(
+      str(tmp_path), 2, N, np.stack([rows, cols]), node_feat=feat,
+      edge_feat=efeat, seed=0).partition()
+  mesh = make_mesh(2)
+  ds = DistDataset().load(str(tmp_path), mesh=mesh)
+  assert ds.edge_features is not None
+  loader = glt.distributed.DistNeighborLoader(
+      ds, [2], np.arange(N), batch_size=4, seed=0, mesh=mesh,
+      with_edge=True)
+  batch = next(iter(loader))
+  eids = np.asarray(batch.edge_ids)
+  ea = np.asarray(batch.edge_attr)
+  em = np.asarray(batch.edge_mask)
+  assert em.any()
+  for p in range(2):
+    valid = em[p]
+    np.testing.assert_allclose(ea[p][valid][:, 0], eids[p][valid])
+
+
+def test_dist_table_dataset_end_to_end(tmp_path):
+  """Tabular files -> sliced read -> partition -> mesh load -> sample."""
+  rows, cols = ring()
+  np.save(tmp_path / 'edges.npy',
+          np.stack([rows, cols, np.arange(2 * N)]).T)
+  feat = np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  np.savez(tmp_path / 'nodes.npz', ids=np.arange(N), feats=feat,
+           labels=np.arange(N) % 3)
+  mesh = make_mesh(2)
+  ds = DistTableDataset().load_tables(
+      str(tmp_path / 'edges.npy'), str(tmp_path / 'nodes.npz'),
+      num_nodes=N, num_partitions=2, partition_idx=0, world_size=1,
+      output_dir=str(tmp_path / 'parts'), mesh=mesh)
+  assert ds.num_partitions == 2
+  np.testing.assert_array_equal(ds.node_labels, np.arange(N) % 3)
+  loader = glt.distributed.DistNeighborLoader(
+      ds, [2], np.arange(N), batch_size=4, seed=0, mesh=mesh)
+  batch = next(iter(loader))
+  node = np.asarray(batch.node)
+  x = np.asarray(batch.x)
+  y = np.asarray(batch.y)
+  for p in range(2):
+    nn = int(np.asarray(batch.num_nodes)[p])
+    np.testing.assert_allclose(x[p, :nn, 0], node[p, :nn])
+    np.testing.assert_array_equal(y[p, :nn], node[p, :nn] % 3)
