@@ -1,20 +1,22 @@
 """Profile the Pallas HBM row-gather kernel vs XLA's take on the TPU.
 
-Run from the repo root: `python benchmarks/prof_gather.py`. Measured on
-v5e-1 (1M x 128 f32 table, 131k random ids, pipelined dispatch — no
-device->host fetch before timing, PERF.md rules):
+Run from the repo root: `python benchmarks/prof_gather.py`. NOTE: the
+wall clocks this script prints are DISPATCH times on the axon tunnel
+(block_until_ready returns at dispatch — PERF.md "Timing on the axon
+tunnel"); ground truth comes from jax.profiler device traces. Trace-true
+numbers on v5e-1 (1M x 128 f32 table, 131k random ids):
 
-  xla_take:    6.3 ms/call   9.9 GB/s
-  pallas_64:   5.8 ms/call  10.8 GB/s   <- ops.gather_rows_hbm default
-  pallas_128:  8.1 ms/call   7.7 GB/s
-  pallas_256:  8.1 ms/call   7.8 GB/s
+  xla_take:    1.20 ms/call device time  (~52 GB/s useful)   <- WINNER
+  pallas_128:  1.41 ms/call
+  pallas_256:  1.41 ms/call
+  pallas_64:   1.62 ms/call
+  pallas_32:   2.40 ms/call
   pallas_512:  Mosaic compile failure (semaphore budget)
 
-A grid-free rotation variant (one grid step, G semaphores rotated over all
-B rows so the DMA queue never drains) measured 8.1 GB/s — the
-non-unrollable scalar issue loop costs more than the per-grid-step drain
-it avoids. Random 512-byte row reads are DMA-latency-bound, far from the
-chip's sequential HBM bandwidth; ~64 in-flight copies is the sweet spot.
+XLA's gather is already DMA-pipelined on TPU; the per-row-DMA kernel does
+not beat it, so UnifiedTensor does NOT auto-route through it
+(use_pallas opt-in). Kept for rigs where the balance differs and as the
+framework's Pallas reference kernel.
 """
 import sys
 import time

@@ -158,13 +158,18 @@ class UnifiedTensor:
     cold_rows = jax.device_put(fut.result(), self._small_block_target())
     return scatter_fn(out, jnp.asarray(pos), cold_rows)
 
+  use_pallas = False   # opt-in: device traces show XLA's take is faster
+  # for the all-hot row gather on v5e (1.20 vs 1.41 ms/call, PERF.md);
+  # the kernel remains available for rigs where the balance differs
+
   def _pallas_ok(self) -> bool:
-    """All-hot gathers use the Pallas row-DMA kernel when the table is
-    single-device TPU-resident with a 128-lane-aligned feature dim."""
+    """All-hot gathers use the Pallas row-DMA kernel only when opted in
+    AND the table is single-device TPU-resident with a 128-lane-aligned
+    feature dim."""
     import jax
     t = self._device_part
-    return (jax.default_backend() == 'tpu' and t is not None and
-            t.shape[1] % 128 == 0 and
+    return (self.use_pallas and jax.default_backend() == 'tpu' and
+            t is not None and t.shape[1] % 128 == 0 and
             len(t.sharding.device_set) == 1)
 
   def _small_block_target(self):

@@ -274,7 +274,7 @@ class DistLinkNeighborLoader(DistLoader):
                neg_sampling=None, with_edge: bool = False,
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
-               with_weight: bool = False):
+               with_weight: bool = False, dedup: str = 'sort'):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -294,7 +294,7 @@ class DistLinkNeighborLoader(DistLoader):
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
-        with_weight=with_weight)
+        with_weight=with_weight, dedup=dedup)
     super().__init__(data, sampler, np.zeros(0, np.int64), batch_size,
                      shuffle, drop_last, collect_features, seed)
     self.input_type = input_type  # EdgeType for hetero link sampling
@@ -354,7 +354,7 @@ class DistNeighborLoader(DistLoader):
                drop_last: bool = True, with_edge: bool = False,
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
-               with_weight: bool = False):
+               with_weight: bool = False, dedup: str = 'sort'):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -363,6 +363,6 @@ class DistNeighborLoader(DistLoader):
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
-        with_weight=with_weight)
+        with_weight=with_weight, dedup=dedup)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, collect_features, seed)

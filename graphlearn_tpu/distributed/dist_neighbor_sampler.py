@@ -88,23 +88,40 @@ def _exchange_hop(garr, pb, frontier, fmask, k, key, nparts: int,
 
 def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
                    node_cap: int, nparts: int, with_edge: bool,
-                   weighted: bool):
+                   weighted: bool, dedup: str = 'sort'):
   """Multi-hop homo engine body (traced inside shard_map): dedup seeds,
-  expand hop by hop via _exchange_hop + induce_next. Returns the per-shard
-  result dict (no leading axis)."""
+  expand hop by hop via _exchange_hop + the chosen inducer. Returns the
+  per-shard result dict (no leading axis).
+
+  ``dedup='tree'`` uses the positional computation-tree inducer
+  (ops/induce_tree.py) — zero random access, ~4x device speedup over the
+  exact-dedup inducers at products scale (PERF.md); 'sort' keeps exact
+  dedup (the shard-local analog of the reference's inducer).
+  """
   import jax
   import jax.numpy as jnp
   b = seeds.shape[0]
   hop_keys = jax.random.split(key, max(1, len(fanouts)))
-  state, uniq, umask, inv = ops.init_node(seeds, smask, capacity=node_cap)
+  if dedup == 'tree':
+    state, uniq, umask, inv = ops.init_node_tree(seeds, smask,
+                                                 capacity=node_cap)
+    induce = lambda st, fi, nb, m, off: ops.induce_next_tree(  # noqa: E731
+        st, fi, nb, m, offset=off)
+  else:
+    state, uniq, umask, inv = ops.init_node(seeds, smask,
+                                            capacity=node_cap)
+    induce = lambda st, fi, nb, m, off: ops.induce_next(  # noqa: E731
+        st, fi, nb, m)
   frontier, fidx, fmask = uniq, jnp.arange(b, dtype=jnp.int32), umask
   rows, cols, edges, emasks = [], [], [], []
   nodes_per_hop = [state.num_nodes]
   edges_per_hop = []
+  offset = caps[0]
   for i, k in enumerate(fanouts):
     nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
                                hop_keys[i], nparts, with_edge, weighted)
-    state, out = ops.induce_next(state, fidx, nbrs, m)
+    state, out = induce(state, fidx, nbrs, m, offset)
+    offset += caps[i] * k
     rows.append(out['cols'])   # message direction: neighbor -> seed
     cols.append(out['rows'])
     emasks.append(out['edge_mask'])
@@ -163,7 +180,7 @@ class DistNeighborSampler:
                with_edge: bool = False, seed: Optional[int] = None,
                node_budget: Optional[int] = None,
                collect_features: bool = False,
-               with_weight: bool = False):
+               with_weight: bool = False, dedup: str = 'sort'):
     import jax
     self.graph = dist_graph
     self.is_hetero = dist_graph.is_hetero
@@ -179,6 +196,13 @@ class DistNeighborSampler:
     self.with_weight = with_weight
     self.collect_features = collect_features and dist_feature is not None
     self.node_budget = node_budget
+    self.dedup = dedup   # 'sort' = exact dedup; 'tree' = positional
+    # computation-tree batches, ~4x faster inducer (PERF.md)
+    if dedup == 'tree' and dist_graph.is_hetero:
+      raise ValueError(
+          "dedup='tree' is not yet implemented for the heterogeneous "
+          'distributed engine (it uses exact dedup); drop the dedup '
+          "argument or pass 'sort'")
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     self._dev = dist_graph.device_arrays(mesh)
     if with_weight:
@@ -232,6 +256,12 @@ class DistNeighborSampler:
       caps.append(nxt)
     return caps
 
+  def _node_cap(self, caps) -> int:
+    if self.dedup == 'tree':
+      return caps[0] + sum(c * k for c, k in
+                           zip(caps[:-1], self.num_neighbors))
+    return sum(caps)
+
   # ----------------------------------------------------- hetero static plan
 
   def _etype_fanouts(self, et) -> List[int]:
@@ -284,7 +314,8 @@ class DistNeighborSampler:
     nparts = self.graph.num_partitions
     fanouts = tuple(self.num_neighbors)
     caps = self._capacities(b)
-    node_cap = sum(caps)
+    node_cap = self._node_cap(caps)
+    dedup = self.dedup
     with_edge = self.with_edge
     weighted = self._weighted_for()
 
@@ -294,7 +325,8 @@ class DistNeighborSampler:
       if weighted:
         gdev['wcum'] = wcum[0]
       res = _homo_hop_loop(gdev, pb, seeds[0], smask[0], keys[0], fanouts,
-                           caps, node_cap, nparts, with_edge, weighted)
+                           caps, node_cap, nparts, with_edge, weighted,
+                           dedup=dedup)
       return _lift(res)
 
     out_specs = dict(node=P('g'), num_nodes=P('g'), row=P('g'),
@@ -342,7 +374,8 @@ class DistNeighborSampler:
     else:  # triplet
       width = 2 * b + num_neg
     caps = self._capacities(width)
-    node_cap = sum(caps)
+    node_cap = self._node_cap(caps)
+    dedup = self.dedup
 
     def body(row_ids, indptr, indices, eids, wcum, sorted_loc, pb,
              rows, cols, smask, keys):
@@ -368,7 +401,8 @@ class DistNeighborSampler:
           seeds = jnp.concatenate([rows_, cols_, neg_dst])
           seed_mask = jnp.concatenate([sm, sm, nvalid])
       res = _homo_hop_loop(gdev, pb, seeds, seed_mask, kloop, fanouts,
-                           caps, node_cap, nparts, with_edge, weighted)
+                           caps, node_cap, nparts, with_edge, weighted,
+                           dedup=dedup)
       inv = res['seed_inverse']
       if mode == 'none':
         res['edge_label_index'] = jnp.stack([inv[:b], inv[b:2 * b]])

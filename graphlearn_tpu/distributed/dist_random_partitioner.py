@@ -119,7 +119,12 @@ class DistRandomPartitioner:
   def _ntypes(self):
     if not self.is_hetero:
       return [None]
-    return sorted({t for et in self.edge_index for t in (et[0], et[2])})
+    types = {t for et in self.edge_index for t in (et[0], et[2])}
+    if isinstance(self.num_nodes, dict):
+      types |= set(self.num_nodes)      # featured-but-edgeless node types
+    if isinstance(self.node_feat, dict):
+      types |= set(self.node_feat)
+    return sorted(types)
 
   def _etypes(self):
     return list(self.edge_index) if self.is_hetero else [None]
@@ -153,6 +158,11 @@ class DistRandomPartitioner:
                       else self.edge_index)
       rows, cols = ei[0].reshape(-1), ei[1].reshape(-1)
       eids = self._sel(self.edge_ids, et)
+      if eids is None and self.world_size > 1:
+        raise ValueError(
+            'multi-rank partitioning requires explicit global edge_ids '
+            'per slice — a per-rank arange default would produce '
+            'duplicate edge ids across ranks')
       eids = (np.asarray(eids) if eids is not None
               else np.arange(rows.shape[0], dtype=np.int64))
       if self.is_hetero:

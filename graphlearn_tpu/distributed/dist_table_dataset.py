@@ -48,10 +48,15 @@ def _read_edge_table(path: str, rank: int, world_size: int):
                        f'shape {arr.shape}')
     if arr.shape[0] in (2, 3) and arr.shape[1] > 3:
       arr = arr.T                    # [2/3, E] -> [E, 2/3]
+  total = arr.shape[0]
   arr = arr[rank::world_size]
   rows = arr[:, 0].astype(np.int64)
   cols = arr[:, 1].astype(np.int64)
-  eids = (arr[:, 2].astype(np.int64) if arr.shape[1] > 2 else None)
+  # without an explicit eid column, global table row positions serve as
+  # edge ids — they stay globally unique across rank slices (each rank
+  # defaulting to a local arange would collide)
+  eids = (arr[:, 2].astype(np.int64) if arr.shape[1] > 2
+          else np.arange(total, dtype=np.int64)[rank::world_size])
   return rows, cols, eids
 
 
@@ -100,6 +105,11 @@ class DistTableDataset(DistDataset):
     """
     ws = world_size or num_partitions
     hetero = isinstance(edge_tables, dict)
+    if output_dir is None and ws > 1:
+      raise ValueError(
+          'multi-rank load_tables needs a SHARED output_dir (the ranks '
+          'exchange partition chunks through it); the per-process temp '
+          'default would silo each rank')
     out = output_dir or os.path.join(tempfile.gettempdir(),
                                      f'glt_table_{os.getpid()}')
     os.makedirs(out, exist_ok=True)

@@ -50,7 +50,7 @@ def _gather_kernel(ids_ref, table_ref, out_ref, sems):
 
 @functools.partial(jax.jit,
                    static_argnames=('block_rows', 'interpret', 'force'))
-def gather_rows_hbm(table, ids, block_rows: int = 64,
+def gather_rows_hbm(table, ids, block_rows: int = 128,
                     interpret: bool = False, force: bool = False):
   """Gather ``table[ids]`` via per-row async DMAs.
 
@@ -58,11 +58,10 @@ def gather_rows_hbm(table, ids, block_rows: int = 64,
     table: [N, F] device array (HBM-resident; never copied wholesale).
     ids: [B] int32 row indices (clamped to [0, N)).
     block_rows: rows per grid step == concurrent DMAs in flight.
-      Measured on v5e-1 (1M x 128 f32 table, 131k random ids): 64 -> 10.8
-      GB/s vs 9.9 for XLA's take; 128/256 regress to ~7.8 (grid-step
-      drain beats DMA-queue pressure), and a grid-free rotation variant
-      that never drains measured 8.1 (scalar loop overhead) — see
-      benchmarks/prof_gather.py.
+      Device-trace truth on v5e-1 (1M x 128 f32 table, 131k random ids):
+      best config 1.41 ms/call at 128/256 vs XLA take's 1.20 ms — XLA's
+      gather wins on this chip, so callers opt in explicitly
+      (UnifiedTensor.use_pallas) — see benchmarks/prof_gather.py.
     interpret: run the Pallas interpreter (CPU tests).
     force: run the kernel even off-TPU (tests); default falls back to
       jnp.take when the backend isn't TPU.

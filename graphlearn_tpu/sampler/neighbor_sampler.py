@@ -31,8 +31,29 @@ def _round_up(n: int, multiple: int = 8) -> int:
   return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def _inducer_for(mode: str, num_graph_nodes: int):
+  """(init_fn, induce_fn(state, fidx, nbrs, m, offset)) per dedup mode.
+  ``offset`` (static positional slot base) is only consumed by 'tree'."""
+  if mode == 'map':
+    init = functools.partial(ops.init_node_map,
+                             num_graph_nodes=num_graph_nodes)
+    return init, lambda st, fi, nb, m, off: ops.induce_next_map(
+        st, fi, nb, m)
+  if mode == 'sort':
+    return ops.init_node, lambda st, fi, nb, m, off: ops.induce_next(
+        st, fi, nb, m)
+  assert mode == 'tree', mode
+  return ops.init_node_tree, lambda st, fi, nb, m, off: \
+      ops.induce_next_tree(st, fi, nb, m, offset=off)
+
+
+def _tree_node_cap(caps, fanouts) -> int:
+  """Positional layout size: seeds block + one full block per hop."""
+  return caps[0] + sum(c * k for c, k in zip(caps[:-1], fanouts))
+
+
 @functools.lru_cache(maxsize=None)
-def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, use_map,
+def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
                    num_graph_nodes):
   """Jitted whole-multi-hop sample program, cached at MODULE level on its
   static signature: every sampler instance with the same config (e.g. the
@@ -45,12 +66,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, use_map,
   """
   import jax
 
-  if use_map:
-    init_fn = functools.partial(ops.init_node_map,
-                                num_graph_nodes=num_graph_nodes)
-    induce_fn = ops.induce_next_map
-  else:
-    init_fn, induce_fn = ops.init_node, ops.induce_next
+  init_fn, induce_fn = _inducer_for(mode, num_graph_nodes)
 
   def fn(indptr, indices, eids, cum, seeds, seed_mask, key):
     import jax.numpy as jnp
@@ -62,6 +78,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, use_map,
     nodes_per_hop = [state.num_nodes]
     edges_per_hop = []
     keys = jax.random.split(key, len(fanouts))
+    offset = caps[0]
     for i, k in enumerate(fanouts):
       if weighted:
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
@@ -69,7 +86,8 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, use_map,
       else:
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
                                            fmask, k, keys[i])
-      state, out = induce_fn(state, fidx, nbrs, m)
+      state, out = induce_fn(state, fidx, nbrs, m, offset)
+      offset += caps[i] * k
       # message direction: neighbor -> seed
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -92,6 +110,10 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, use_map,
         num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
         seed_inverse=inv)
 
+  # distinguishable per-mode trace name (bench.py keys device-trace
+  # events by the jitted program name)
+  fn.__name__ = f'sample_{mode}'
+  fn.__qualname__ = fn.__name__
   return jax.jit(fn)
 
 
@@ -174,21 +196,34 @@ class NeighborSampler(BaseSampler):
 
   # ------------------------------------------------------------------ hops
 
+  def _dedup_mode(self) -> str:
+    """'map' | 'sort' | 'tree' ('none' aliases 'tree').
+
+    Profiler-measured on v5e-1 (products-scale, [15,10,5] @ 1024,
+    PERF.md): map = 53.7 ms/batch (random table scatters/gathers
+    dominate), sort = 213 ms, tree = positional relabeling with zero
+    random access in the inducer. 'auto' keeps reference-parity exact
+    dedup ('map'); pass dedup='tree' for the fast computation-tree
+    semantics.
+    """
+    if self.dedup in ('tree', 'none'):
+      if self.is_hetero:
+        raise ValueError(
+            "dedup='tree' is not yet implemented for heterogeneous "
+            'graphs (the typed engine uses exact dedup); drop the '
+            'dedup argument or pass "map"/"sort"')
+      return 'tree'
+    if self.dedup in ('map', 'sort'):
+      return self.dedup
+    return 'map' if self._get_graph().num_nodes <= 64_000_000 else 'sort'
+
   def _use_map_dedup(self) -> bool:
-    if self.dedup == 'map':
-      return True
-    if self.dedup == 'sort':
-      return False
-    return self._get_graph().num_nodes <= 64_000_000
+    return self._dedup_mode() == 'map'
 
   def _inducer_fns(self):
-    """(init_fn(seeds, mask, capacity), induce_fn) per dedup strategy."""
-    import functools
-    if self._use_map_dedup():
-      n = self._get_graph().num_nodes
-      init = functools.partial(ops.init_node_map, num_graph_nodes=n)
-      return init, ops.induce_next_map
-    return ops.init_node, ops.induce_next
+    """(init_fn(seeds, mask, capacity), induce_fn(..., offset)) for the
+    chained path."""
+    return _inducer_for(self._dedup_mode(), self._get_graph().num_nodes)
 
   def sample_one_hop(self, srcs, src_mask, k: int, key=None,
                      etype: Optional[EdgeType] = None) -> NeighborOutput:
@@ -224,15 +259,21 @@ class NeighborSampler(BaseSampler):
       caps.append(nxt)
     return caps
 
+  def _node_cap(self, caps, fanouts) -> int:
+    if self._dedup_mode() == 'tree':
+      return _tree_node_cap(caps, list(fanouts))
+    return sum(caps)
+
   def _build_homo_fn(self, batch_cap: int, fanouts):
     """Resolve the shared jitted multi-hop program for this config."""
     g = self._get_graph()
     caps = self._homo_capacities(batch_cap, fanouts)
+    mode = self._dedup_mode()
     return _fused_homo_fn(
-        tuple(fanouts), tuple(caps), sum(caps), self.with_edge,
+        tuple(fanouts), tuple(caps), self._node_cap(caps, fanouts),
+        self.with_edge,
         self.with_weight and g.edge_weights is not None,
-        self._use_map_dedup(),
-        g.num_nodes if self._use_map_dedup() else 0)
+        mode, g.num_nodes if mode == 'map' else 0)
 
   def _fused_args(self):
     """Graph device arrays passed (not captured) into the fused program."""
@@ -272,7 +313,7 @@ class NeighborSampler(BaseSampler):
         self._get_graph().edge_weights is not None
     cum = jnp.asarray(self._cumsum_for()) if weighted else None
     caps = self._homo_capacities(batch_cap, fanouts)
-    node_cap = sum(caps)
+    node_cap = self._node_cap(caps, fanouts)
     init_fn, induce_fn = self._inducer_fns()
     state, uniq, umask, inv = init_fn(seeds, seed_mask, capacity=node_cap)
     frontier = uniq
@@ -282,6 +323,7 @@ class NeighborSampler(BaseSampler):
     nodes_per_hop = [state.num_nodes]
     edges_per_hop = []
     keys = jax.random.split(key, len(fanouts))
+    offset = caps[0]
     for i, k in enumerate(fanouts):
       if weighted:
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
@@ -289,7 +331,8 @@ class NeighborSampler(BaseSampler):
       else:
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
                                            fmask, k, keys[i])
-      state, out = induce_fn(state, fidx, nbrs, m)
+      state, out = induce_fn(state, fidx, nbrs, m, offset)
+      offset += caps[i] * k
       rows.append(out['cols'])
       cols.append(out['rows'])
       emasks.append(out['edge_mask'])

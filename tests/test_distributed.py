@@ -218,6 +218,34 @@ def test_dist_sampler_skewed_partition_book_no_loss():
 
 # ------------------------------------------------------------ link + subgraph
 
+def test_dist_sampler_tree_mode():
+  """dedup='tree' in the sharded engine: positional slots, exchange hops
+  unchanged, edges still valid ring edges."""
+  num_parts = 2
+  parts, _, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2, 2], mesh, seed=0,
+                                                dedup='tree')
+  seeds = np.array([[0, 4], [1, 5]], np.int32)
+  out = sampler.sample_from_nodes(seeds)
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  em = np.asarray(out.edge_mask)
+  for p in range(num_parts):
+    np.testing.assert_array_equal(node[p][:2], seeds[p])
+    assert em[p].sum() > 0
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N)
+    # every sampled edge creates exactly one new slot
+    nn = int(np.asarray(out.num_nodes)[p])
+    assert nn == int(em[p].sum()) + 2
+
+
 def test_dist_link_sampler_binary():
   from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
   num_parts = 2
