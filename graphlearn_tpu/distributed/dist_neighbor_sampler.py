@@ -102,16 +102,9 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
   import jax.numpy as jnp
   b = seeds.shape[0]
   hop_keys = jax.random.split(key, max(1, len(fanouts)))
-  if dedup == 'tree':
-    state, uniq, umask, inv = ops.init_node_tree(seeds, smask,
-                                                 capacity=node_cap)
-    induce = lambda st, fi, nb, m, off: ops.induce_next_tree(  # noqa: E731
-        st, fi, nb, m, offset=off)
-  else:
-    state, uniq, umask, inv = ops.init_node(seeds, smask,
-                                            capacity=node_cap)
-    induce = lambda st, fi, nb, m, off: ops.induce_next(  # noqa: E731
-        st, fi, nb, m)
+  from ..sampler.neighbor_sampler import _inducer_for
+  init_seed, _, induce = _inducer_for(dedup)
+  state, uniq, umask, inv = init_seed(seeds, smask, capacity=node_cap)
   frontier, fidx, fmask = uniq, jnp.arange(b, dtype=jnp.int32), umask
   rows, cols, edges, emasks = [], [], [], []
   nodes_per_hop = [state.num_nodes]
@@ -196,13 +189,14 @@ class DistNeighborSampler:
     self.with_weight = with_weight
     self.collect_features = collect_features and dist_feature is not None
     self.node_budget = node_budget
-    self.dedup = dedup   # 'sort' = exact dedup; 'tree' = positional
-    # computation-tree batches, ~4x faster inducer (PERF.md)
-    if dedup == 'tree' and dist_graph.is_hetero:
-      raise ValueError(
-          "dedup='tree' is not yet implemented for the heterogeneous "
-          'distributed engine (it uses exact dedup); drop the dedup '
-          "argument or pass 'sort'")
+    # 'sort' = exact dedup; 'tree' ('none' aliases it) = positional
+    # computation-tree batches, ~4x faster inducer (PERF.md). The sharded
+    # engine has no 'map' mode (a [N] table per shard defeats sharding).
+    dedup = 'tree' if dedup == 'none' else dedup
+    if dedup not in ('sort', 'tree'):
+      raise ValueError(f'unknown dedup mode {dedup!r}; the distributed '
+                       "engine supports 'sort' (exact) and 'tree'")
+    self.dedup = dedup
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     self._dev = dist_graph.device_arrays(mesh)
     if with_weight:
@@ -555,18 +549,22 @@ class DistNeighborSampler:
     out_et_of = {et: (reverse_edge_type(et) if edge_dir == 'out' else et)
                  for et in etypes}
 
+    from ..sampler.neighbor_sampler import _inducer_for
+    init_seed, init_empty, induce = _inducer_for(self.dedup)
+    offsets = {t: (seed_arrays[t][0].shape[0] if t in seed_arrays else 0)
+               for t in ntypes}   # positional layout (tree mode)
     states, frontier, inv_dict = {}, {}, {}
     for t in ntypes:
       if node_caps[t] == 0:
         continue
       if t in seed_arrays:
         s, m = seed_arrays[t]
-        states[t], uniq, umask, inv_dict[t] = ops.init_node(
+        states[t], uniq, umask, inv_dict[t] = init_seed(
             s, m, capacity=node_caps[t])
         frontier[t] = (uniq, jnp.arange(s.shape[0], dtype=jnp.int32),
                        umask)
       else:
-        states[t] = ops.init_empty(node_caps[t])
+        states[t] = init_empty(node_caps[t])
 
     rows, cols, edges, emasks = {}, {}, {}, {}
     nodes_per_hop = {t: [states[t].num_nodes if t in states
@@ -586,8 +584,9 @@ class DistNeighborSampler:
                                    keys[ki], nparts, with_edge,
                                    self._weighted_for(et))
         ki += 1
-        states[res_t], iout = ops.induce_next(states[res_t], fidx, nbrs,
-                                              m)
+        states[res_t], iout = induce(states[res_t], fidx, nbrs, m,
+                                     offsets[res_t])
+        offsets[res_t] += fcap * k
         rows.setdefault(out_et, []).append(iout['cols'])
         cols.setdefault(out_et, []).append(iout['rows'])
         emasks.setdefault(out_et, []).append(iout['edge_mask'])

@@ -4,7 +4,7 @@ from .gather_pallas import gather_rows_hbm
 from .induce import InducerState, induce_next, init_empty, init_node
 from .induce_map import (MapInducerState, induce_next_map, init_node_map)
 from .induce_tree import (TreeInducerState, induce_next_tree,
-                          init_node_tree)
+                          init_empty_tree, init_node_tree)
 from .negative import (random_negative_sample, random_negative_sample_local,
                        sort_csr_segments)
 from .neighbor import (build_row_cumsum, edge_in_csr, uniform_sample,

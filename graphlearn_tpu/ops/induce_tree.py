@@ -48,6 +48,14 @@ def init_node_tree(seeds: jax.Array, seed_mask: jax.Array, capacity: int):
           seed_mask, inverse)
 
 
+@functools.partial(jax.jit, static_argnames=('capacity',))
+def init_empty_tree(capacity: int, dtype=jnp.int32):
+  """A tree state with no nodes yet (hetero: node types first reached
+  mid-hop)."""
+  return TreeInducerState(jnp.full((capacity,), FILL, dtype),
+                          jnp.asarray(0, jnp.int32))
+
+
 @functools.partial(jax.jit, static_argnames=('offset',))
 def induce_next_tree(state: TreeInducerState, src_idx: jax.Array,
                      nbrs: jax.Array, nbr_mask: jax.Array, offset: int):

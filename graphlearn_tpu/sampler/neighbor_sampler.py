@@ -31,20 +31,23 @@ def _round_up(n: int, multiple: int = 8) -> int:
   return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
-def _inducer_for(mode: str, num_graph_nodes: int):
-  """(init_fn, induce_fn(state, fidx, nbrs, m, offset)) per dedup mode.
-  ``offset`` (static positional slot base) is only consumed by 'tree'."""
+def _inducer_for(mode: str, num_graph_nodes: int = 0):
+  """(init_seed, init_empty, induce_fn(state, fidx, nbrs, m, offset)) per
+  dedup mode — the single source of truth for inducer dispatch across the
+  local homo/hetero and distributed engines. ``offset`` (static
+  positional slot base) is only consumed by 'tree'."""
   if mode == 'map':
     init = functools.partial(ops.init_node_map,
                              num_graph_nodes=num_graph_nodes)
-    return init, lambda st, fi, nb, m, off: ops.induce_next_map(
-        st, fi, nb, m)
+    return init, ops.init_empty, lambda st, fi, nb, m, off: \
+        ops.induce_next_map(st, fi, nb, m)
   if mode == 'sort':
-    return ops.init_node, lambda st, fi, nb, m, off: ops.induce_next(
-        st, fi, nb, m)
-  assert mode == 'tree', mode
-  return ops.init_node_tree, lambda st, fi, nb, m, off: \
-      ops.induce_next_tree(st, fi, nb, m, offset=off)
+    return ops.init_node, ops.init_empty, lambda st, fi, nb, m, off: \
+        ops.induce_next(st, fi, nb, m)
+  assert mode == 'tree', f'unknown dedup mode {mode!r}'
+  return ops.init_node_tree, ops.init_empty_tree, \
+      lambda st, fi, nb, m, off: ops.induce_next_tree(st, fi, nb, m,
+                                                      offset=off)
 
 
 def _tree_node_cap(caps, fanouts) -> int:
@@ -66,7 +69,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
   """
   import jax
 
-  init_fn, induce_fn = _inducer_for(mode, num_graph_nodes)
+  init_fn, _, induce_fn = _inducer_for(mode, num_graph_nodes)
 
   def fn(indptr, indices, eids, cum, seeds, seed_mask, key):
     import jax.numpy as jnp
@@ -207,11 +210,6 @@ class NeighborSampler(BaseSampler):
     semantics.
     """
     if self.dedup in ('tree', 'none'):
-      if self.is_hetero:
-        raise ValueError(
-            "dedup='tree' is not yet implemented for heterogeneous "
-            'graphs (the typed engine uses exact dedup); drop the '
-            'dedup argument or pass "map"/"sort"')
       return 'tree'
     if self.dedup in ('map', 'sort'):
       return self.dedup
@@ -314,7 +312,7 @@ class NeighborSampler(BaseSampler):
     cum = jnp.asarray(self._cumsum_for()) if weighted else None
     caps = self._homo_capacities(batch_cap, fanouts)
     node_cap = self._node_cap(caps, fanouts)
-    init_fn, induce_fn = self._inducer_fns()
+    init_fn, _, induce_fn = self._inducer_fns()
     state, uniq, umask, inv = init_fn(seeds, seed_mask, capacity=node_cap)
     frontier = uniq
     fidx = jnp.arange(batch_cap, dtype=jnp.int32)
@@ -466,9 +464,12 @@ class NeighborSampler(BaseSampler):
     nodes_per_hop: Dict[NodeType, list] = {t: [] for t in ntypes}
     edges_per_hop: Dict[EdgeType, list] = {}
 
+    mode = 'tree' if self.dedup in ('tree', 'none') else 'sort'
+    init_seed, init_empty, induce = _inducer_for(mode)
+    offsets = {t: caps_in.get(t, 0) for t in ntypes}  # positional layout
     inv_d = {}
     for t in seeds_dict:
-      st, uniq, umask, inv_t = ops.init_node(
+      st, uniq, umask, inv_t = init_seed(
           jnp.asarray(padded_d[t]), jnp.asarray(smask_d[t]),
           capacity=node_caps[t])
       states[t] = st
@@ -489,9 +490,10 @@ class NeighborSampler(BaseSampler):
         f, fidx, fmask = f[:fcap], fidx[:fcap], fmask[:fcap]
         hop_out = self.sample_one_hop(f, fmask, k, etype=et)
         if res_t not in states:
-          states[res_t] = ops.init_empty(node_caps[res_t])
-        states[res_t], iout = ops.induce_next(states[res_t], fidx,
-                                              hop_out.nbrs, hop_out.mask)
+          states[res_t] = init_empty(node_caps[res_t])
+        states[res_t], iout = induce(states[res_t], fidx, hop_out.nbrs,
+                                     hop_out.mask, offsets[res_t])
+        offsets[res_t] += fcap * k
         rows.setdefault(out_et, []).append(iout['cols'])
         cols.setdefault(out_et, []).append(iout['rows'])
         emasks.setdefault(out_et, []).append(iout['edge_mask'])

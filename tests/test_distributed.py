@@ -246,6 +246,38 @@ def test_dist_sampler_tree_mode():
     assert nn == int(em[p].sum()) + 2
 
 
+def test_dist_hetero_sampler_tree_mode():
+  """dedup='tree' in the typed sharded engine: per-type positional
+  slots; edges still satisfy the fixture invariants."""
+  num_parts = 2
+  parts, _, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, {et1: [2, 2], et2: [1, 1]}, mesh, seed=0, dedup='tree')
+  seeds = np.arange(4, dtype=np.int32).reshape(num_parts, 2)
+  out = sampler.sample_from_nodes(('u', seeds))
+  rev1 = glt.typing.reverse_edge_type(et1)
+  rev2 = glt.typing.reverse_edge_type(et2)
+  nu = np.asarray(out.node['u'])
+  nv = np.asarray(out.node['v'])
+  for p in range(num_parts):
+    np.testing.assert_array_equal(nu[p][:2], seeds[p])
+    r = np.asarray(out.row[rev1])[p]
+    c = np.asarray(out.col[rev1])[p]
+    m = np.asarray(out.edge_mask[rev1])[p]
+    assert m.sum() > 0
+    for ri, ci in zip(r[m], c[m]):
+      u, v = int(nu[p][ci]), int(nv[p][ri])
+      assert v in (u, (u + 1) % N)
+    r = np.asarray(out.row[rev2])[p]
+    c = np.asarray(out.col[rev2])[p]
+    m = np.asarray(out.edge_mask[rev2])[p]
+    for ri, ci in zip(r[m], c[m]):
+      v, u = int(nv[p][ci]), int(nu[p][ri])
+      assert u == (v + 2) % N
+
+
 def test_dist_link_sampler_binary():
   from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
   num_parts = 2

@@ -59,6 +59,28 @@ def test_sample_from_nodes_tree_mode(fused):
   assert int(out.num_nodes) == int(em.sum()) + 4
 
 
+def test_hetero_tree_mode():
+  """Typed tree mode: per-type positional slots, edges valid per etype."""
+  et = ('u', 'to', 'v')
+  rev = glt.typing.reverse_edge_type(et)
+  ei = np.stack([np.arange(8), (np.arange(8) + 1) % 8])
+  topo = glt.data.Topology(ei, num_nodes=8)
+  graphs = {et: glt.data.Graph(topo, 'CPU')}
+  sampler = glt.sampler.NeighborSampler(graphs, {et: [2]}, seed=0,
+                                        dedup='tree')
+  out = sampler.sample_from_nodes(NodeSamplerInput(np.array([0, 3]), 'u'))
+  nu = np.asarray(out.node['u'])
+  nv = np.asarray(out.node['v'])
+  np.testing.assert_array_equal(nu[:2], [0, 3])
+  r = np.asarray(out.row[rev])
+  c = np.asarray(out.col[rev])
+  m = np.asarray(out.edge_mask[rev])
+  assert m.sum() > 0
+  for ri, ci in zip(r[m], c[m]):
+    u, v = int(nu[ci]), int(nv[ri])
+    assert v == (u + 1) % 8
+
+
 def test_tree_mode_trains_equivalently():
   """A jitted SAGE step consumes tree-mode batches unchanged (padded
   shapes; seed slots lead)."""
