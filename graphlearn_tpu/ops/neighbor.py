@@ -22,6 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .unique import FILL
 
@@ -113,6 +114,79 @@ def weighted_sample(indptr, indices, row_cumsum, seeds, seed_mask, k: int,
   safe_epos = jnp.where(mask, epos, 0)
   nbrs = jnp.where(mask, indices[safe_epos], FILL)
   return nbrs, jnp.where(mask, epos, 0), mask
+
+
+def build_padded_adjacency(indptr, indices, window: int, seed: int = 0,
+                           edge_pos: bool = False):
+  """Host-side: dense [N, window] neighbor table with per-row shuffling.
+
+  The TPU answer to CSR pointer-chasing: XLA's ELEMENT gather over a
+  [25M] CSR indices array is DMA-latency-bound (~120M elem/s,
+  device-trace evidence in PERF.md), while ROW gathers move ~5x more
+  bytes/s. This table makes a sampling hop one row gather + cheap
+  in-row VPU selection. Rows with deg > window keep a uniformly random
+  ``window``-subset (the shuffle makes the truncation unbiased; rebuild
+  with a new seed to refresh the subset across epochs).
+
+  Returns (nbr_table [N, window] int32, FILL-padded; deg [N] int32 =
+  min(true degree, window); epos_table [N, window] or None — CSR edge
+  positions for with_edge/weighted lookups).
+  """
+  indptr = np.asarray(indptr)
+  indices = np.asarray(indices)
+  n = indptr.shape[0] - 1
+  e = indices.shape[0]
+  rng = np.random.default_rng(seed)
+  rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+  order = np.lexsort((rng.random(e), rows))     # shuffle within each row
+  # `order` keeps row blocks contiguous, so the within-row rank after the
+  # shuffle is the same arithmetic as before it
+  shuf_rows = rows[order]
+  shuf_within = np.arange(e, dtype=np.int64) - np.repeat(
+      indptr[:-1], np.diff(indptr))
+  sel = shuf_within < window
+  tab = np.full((n, window), FILL, np.int32)
+  tab[shuf_rows[sel], shuf_within[sel]] = indices[order][sel]
+  deg = np.minimum(np.diff(indptr), window).astype(np.int32)
+  epos = None
+  if edge_pos:
+    epos = np.zeros((n, window), np.int32)
+    epos[shuf_rows[sel], shuf_within[sel]] = order[sel]
+  return tab, deg, epos
+
+
+@functools.partial(jax.jit, static_argnames=('k',))
+def uniform_sample_padded(nbr_table, deg, seeds, seed_mask, k: int, key,
+                          epos_table=None):
+  """Uniform fanout sampling over a padded adjacency table
+  (:func:`build_padded_adjacency`). Same output contract as
+  :func:`uniform_sample`; ``epos`` is only meaningful when
+  ``epos_table`` is given (else zeros)."""
+  b = seeds.shape[0]
+  safe = jnp.where(seed_mask, seeds, 0)
+  rows = nbr_table[safe]                          # [B, W] row gather
+  d = jnp.where(seed_mask, deg[safe], 0)
+  u = jax.random.uniform(key, (b, k))
+  rand_off = jnp.floor(u * d[:, None].astype(u.dtype)).astype(jnp.int32)
+  rand_off = jnp.minimum(rand_off, jnp.maximum(d[:, None] - 1, 0))
+  seq_off = jnp.arange(k, dtype=jnp.int32)[None, :]
+  offsets = jnp.where(d[:, None] > k, rand_off, seq_off)
+  mask = seed_mask[:, None] & (offsets < d[:, None])
+  safe_off = jnp.where(mask, offsets, 0)
+  # in-row selection via one-hot contraction, NOT take_along_axis: a
+  # dynamic axis-1 gather lowers to the same latency-bound element
+  # gather this op exists to avoid; the one-hot multiply-sum is pure
+  # VPU work over the already-gathered [B, W] rows
+  onehot = (safe_off[:, :, None] ==
+            jnp.arange(rows.shape[1], dtype=jnp.int32)[None, None, :])
+  picked = jnp.sum(rows[:, None, :] * onehot, axis=-1)
+  nbrs = jnp.where(mask, picked, FILL)
+  if epos_table is not None:
+    ep = jnp.sum(epos_table[safe][:, None, :] * onehot, axis=-1)
+    epos = jnp.where(mask, ep, 0)
+  else:
+    epos = jnp.zeros_like(nbrs)
+  return nbrs, epos, mask
 
 
 @functools.partial(jax.jit, static_argnames=('k',))

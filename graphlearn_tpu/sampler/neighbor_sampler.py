@@ -39,7 +39,14 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
   if mode == 'map':
     init = functools.partial(ops.init_node_map,
                              num_graph_nodes=num_graph_nodes)
-    return init, ops.init_empty, lambda st, fi, nb, m, off: \
+
+    def _no_empty_map(capacity):
+      raise NotImplementedError(
+          'map-mode lazy (empty) inducer states are not implemented — '
+          'the hetero engines use sort/tree modes; add an '
+          'ops.init_empty_map before wiring map into a typed path')
+
+    return init, _no_empty_map, lambda st, fi, nb, m, off: \
         ops.induce_next_map(st, fi, nb, m)
   if mode == 'sort':
     return ops.init_node, ops.init_empty, lambda st, fi, nb, m, off: \
@@ -57,7 +64,7 @@ def _tree_node_cap(caps, fanouts) -> int:
 
 @functools.lru_cache(maxsize=None)
 def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
-                   num_graph_nodes):
+                   num_graph_nodes, padded=False):
   """Jitted whole-multi-hop sample program, cached at MODULE level on its
   static signature: every sampler instance with the same config (e.g. the
   train and eval loaders of one run) shares one traced/compiled
@@ -71,7 +78,8 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
 
   init_fn, _, induce_fn = _inducer_for(mode, num_graph_nodes)
 
-  def fn(indptr, indices, eids, cum, seeds, seed_mask, key):
+  def fn(indptr, indices, eids, cum, tab, deg, eptab, seeds, seed_mask,
+         key):
     import jax.numpy as jnp
     batch_cap = seeds.shape[0]
     state, uniq, umask, inv = init_fn(seeds, seed_mask, capacity=node_cap)
@@ -83,7 +91,10 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
     keys = jax.random.split(key, len(fanouts))
     offset = caps[0]
     for i, k in enumerate(fanouts):
-      if weighted:
+      if padded:
+        nbrs, epos, m = ops.uniform_sample_padded(
+            tab, deg, frontier, fmask, k, keys[i], epos_table=eptab)
+      elif weighted:
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
                                             fmask, k, keys[i])
       else:
@@ -115,7 +126,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
 
   # distinguishable per-mode trace name (bench.py keys device-trace
   # events by the jitted program name)
-  fn.__name__ = f'sample_{mode}'
+  fn.__name__ = f'sample_{mode}' + ('_padded' if padded else '')
   fn.__qualname__ = fn.__name__
   return jax.jit(fn)
 
@@ -143,7 +154,8 @@ class NeighborSampler(BaseSampler):
                with_weight: bool = False, strategy: str = 'random',
                edge_dir: str = 'out', seed: Optional[int] = None,
                node_budget: Optional[int] = None, fused: bool = True,
-               dedup: str = 'auto'):
+               dedup: str = 'auto',
+               padded_window: Optional[int] = None):
     import jax
     self.graph = graph
     self.num_neighbors = num_neighbors
@@ -166,6 +178,31 @@ class NeighborSampler(BaseSampler):
     # sort-based masked unique (memory scales with the batch, not the
     # graph). 'auto' picks map below 64M nodes (256MB table).
     self.dedup = dedup
+    # padded_window: sample hops from a dense pre-shuffled [N, W]
+    # adjacency table instead of the CSR — one ROW gather per hop rather
+    # than per-edge ELEMENT gathers (~5x faster on TPU, PERF.md). Rows
+    # with degree > W sample from a uniformly random W-subset (rebuild
+    # with a new seed to refresh). Homo + uniform only.
+    self.padded_window = padded_window
+    if padded_window is not None:
+      if with_weight:
+        raise ValueError('padded_window does not support weighted '
+                         'sampling')
+      if not fused:
+        raise ValueError('padded_window requires the fused path')
+      if isinstance(graph, dict):
+        raise ValueError('padded_window is homogeneous-only (the typed '
+                         'engine samples the CSR directly)')
+      fo = []
+      if num_neighbors is not None and not isinstance(num_neighbors,
+                                                      dict):
+        fo = list(num_neighbors)
+      if fo and padded_window < max(fo):
+        raise ValueError(
+            f'padded_window={padded_window} < max fanout {max(fo)}: '
+            'rows with degree > window would silently under-sample '
+            '(the table caps per-row candidates at the window)')
+    self._padded_seed = 0 if seed is None else seed
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     self._call_count = 0    # host-side PRNG stream position
     self._row_cumsum = {}   # per-graph CDF cache for weighted sampling
@@ -271,7 +308,31 @@ class NeighborSampler(BaseSampler):
         tuple(fanouts), tuple(caps), self._node_cap(caps, fanouts),
         self.with_edge,
         self.with_weight and g.edge_weights is not None,
-        mode, g.num_nodes if mode == 'map' else 0)
+        mode, g.num_nodes if mode == 'map' else 0,
+        padded=self.padded_window is not None)
+
+  def _padded_arrays(self):
+    """Lazily built device-resident padded adjacency (homo)."""
+    import jax.numpy as jnp
+    g = self._get_graph()
+    key = ('padded', id(g))
+    if key not in self._garrs:
+      tab, deg, epos = ops.build_padded_adjacency(
+          np.asarray(g.indptr), np.asarray(g.indices), self.padded_window,
+          seed=self._padded_seed, edge_pos=self.with_edge)
+      self._garrs[key] = dict(
+          tab=jnp.asarray(tab), deg=jnp.asarray(deg),
+          eptab=(jnp.asarray(epos) if epos is not None else None))
+    return self._garrs[key]
+
+  def refresh_padded_table(self, seed: Optional[int] = None):
+    """Rebuild the padded adjacency with a fresh shuffle so truncated
+    rows (deg > window) sample a NEW random window-subset — call between
+    epochs to de-bias the truncation (PERF.md)."""
+    if self.padded_window is None:
+      return
+    self._padded_seed = (self._padded_seed + 1 if seed is None else seed)
+    self._garrs.pop(('padded', id(self._get_graph())), None)
 
   def _fused_args(self):
     """Graph device arrays passed (not captured) into the fused program."""
@@ -280,11 +341,15 @@ class NeighborSampler(BaseSampler):
     weighted = self.with_weight and \
         self._get_graph().edge_weights is not None
     cum = jnp.asarray(self._cumsum_for()) if weighted else None
-    return ga['indptr'], ga['indices'], ga['eids'], cum
+    if self.padded_window is not None:
+      pa = self._padded_arrays()
+      return (ga['indptr'], ga['indices'], ga['eids'], cum, pa['tab'],
+              pa['deg'], pa['eptab'])
+    return ga['indptr'], ga['indices'], ga['eids'], cum, None, None, None
 
   def _homo_fn(self, batch_cap: int, fanouts):
     sig = ('homo', batch_cap, tuple(fanouts), self.with_edge,
-           self.with_weight)
+           self.with_weight, self.padded_window)
     if sig not in self._fns:
       self._fns[sig] = self._build_homo_fn(batch_cap, tuple(fanouts))
     return self._fns[sig]

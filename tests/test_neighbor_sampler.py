@@ -59,6 +59,50 @@ def test_sample_from_nodes_tree_mode(fused):
   assert int(out.num_nodes) == int(em.sum()) + 4
 
 
+def test_padded_adjacency_build():
+  """Dense [N, W] table: rows hold a shuffled subset of true neighbors,
+  deg clamps at W, epos entries point back at matching CSR positions."""
+  from graphlearn_tpu import ops
+  graph, topo, ei = make_graph()
+  indptr = np.asarray(graph.indptr)
+  indices = np.asarray(graph.indices)
+  tab, deg, epos = ops.build_padded_adjacency(indptr, indices, 4,
+                                              edge_pos=True)
+  for v in range(8):
+    true_nbrs = indices[indptr[v]:indptr[v + 1]].tolist()
+    d = min(len(true_nbrs), 4)
+    assert deg[v] == d
+    row = tab[v][:d]
+    assert set(row.tolist()) <= set(true_nbrs)
+    for j in range(d):
+      assert indices[epos[v, j]] == row[j]
+    assert (tab[v][d:] == ops.FILL).all()
+
+
+def test_padded_sampler_end_to_end():
+  """padded_window sampling: every emitted edge is a real graph edge and
+  edge ids resolve to the exact sampled (src, dst) pair."""
+  rng = np.random.default_rng(0)
+  n = 50
+  rows = rng.integers(0, n, 600)
+  cols = rng.integers(0, n, 600)
+  topo = glt.data.Topology(np.stack([rows, cols]), num_nodes=n)
+  g = glt.data.Graph(topo, 'CPU')
+  sampler = glt.sampler.NeighborSampler(g, [3, 2], seed=0, dedup='tree',
+                                        padded_window=8, with_edge=True)
+  out = sampler.sample_from_nodes(NodeSamplerInput(np.array([0, 7, 13])))
+  node = np.asarray(out.node)
+  em = np.asarray(out.edge_mask)
+  eids = np.asarray(out.edge)
+  assert em.sum() > 0
+  for r, c, e, m in zip(np.asarray(out.row), np.asarray(out.col), eids,
+                        em):
+    if not m:
+      continue
+    u, v = int(node[c]), int(node[r])
+    assert rows[e] == u and cols[e] == v
+
+
 def test_hetero_tree_mode():
   """Typed tree mode: per-type positional slots, edges valid per etype."""
   et = ('u', 'to', 'v')
