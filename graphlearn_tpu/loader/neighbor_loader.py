@@ -20,11 +20,13 @@ class NeighborLoader(NodeLoader):
                with_weight: bool = False, strategy: str = 'random',
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
-               node_budget: Optional[int] = None, dedup: str = 'auto'):
+               node_budget: Optional[int] = None, dedup: str = 'auto',
+               padded_window: Optional[int] = None):
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
-        seed=seed, node_budget=node_budget, dedup=dedup)
+        seed=seed, node_budget=node_budget, dedup=dedup,
+        padded_window=padded_window)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, with_edge, collect_features, to_device,
                      seed)

@@ -18,19 +18,51 @@ _CONVS = {'sage': SAGEConv, 'gcn': GCNConv, 'gat': GATConv}
 
 
 class GraphSAGE(nn.Module):
-  """Multi-layer GraphSAGE (reference example: 3 layers, hidden 256)."""
+  """Multi-layer GraphSAGE (reference example: 3 layers, hidden 256).
+
+  ``hop_node_offsets`` / ``hop_edge_offsets`` (static prefix sums of the
+  tree-mode sampler's positional hop blocks: node offsets
+  ``[b, b+c0*k0, ...]`` and edge offsets ``[c0*k0, c0*k0+c1*k1, ...]``)
+  enable the LAYERED forward: layer l only processes the node/edge
+  prefix its depth needs (a depth-d node's layer-l state matters only
+  when d <= L - l), so a [15,10,5] batch computes ~938k + 170k + 16k
+  node-rows instead of 3 x 938k — device-trace-measured 2.4x on the
+  products-scale train step (PERF.md). Requires dedup='tree' batches
+  (positional layout).
+  """
   hidden_dim: int
   out_dim: int
   num_layers: int = 3
   dropout: float = 0.0
   aggr: str = 'mean'
+  hop_node_offsets: Any = None
+  hop_edge_offsets: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask, train: bool = False):
+    layered = self.hop_node_offsets is not None
+    if layered:
+      assert len(self.hop_node_offsets) >= self.num_layers + 1 and \
+          len(self.hop_edge_offsets) >= self.num_layers
+      # trace-time layout check: a mismatched batch (different
+      # batch_size/fanouts, or a non-tree dedup mode) would slice wrong
+      # blocks SILENTLY — jnp never errors on oversized slices
+      assert self.hop_node_offsets[self.num_layers] == x.shape[0], (
+          f'layered forward: hop offsets {self.hop_node_offsets} do not '
+          f'match the batch node buffer ({x.shape[0]}); build them with '
+          'models.train.tree_hop_offsets from the SAME batch_size/'
+          'fanouts/node_budget as the tree-mode loader')
     for i in range(self.num_layers):
       dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
-      x = SAGEConv(dim, aggr=self.aggr, name=f'conv{i}')(
-          x, edge_index, edge_mask)
+      if layered:
+        hops_used = self.num_layers - i
+        n_in = self.hop_node_offsets[hops_used]
+        e_used = self.hop_edge_offsets[hops_used - 1]
+        x = SAGEConv(dim, aggr=self.aggr, name=f'conv{i}')(
+            x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
+      else:
+        x = SAGEConv(dim, aggr=self.aggr, name=f'conv{i}')(
+            x, edge_index, edge_mask)
       if i < self.num_layers - 1:
         x = nn.relu(x)
         if self.dropout > 0:

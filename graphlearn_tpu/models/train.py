@@ -37,13 +37,14 @@ def make_train_step(model, tx, num_classes: int):
   def loss_fn(params, batch):
     logits = model.apply(params, batch['x'], batch['edge_index'],
                          batch['edge_mask'])
-    n = logits.shape[0]
+    n = logits.shape[0]            # layered models emit a seed-side prefix
+    y = batch['y'][:n]
     seed_mask = jnp.arange(n) < batch['num_seed_nodes']
-    labels = jax.nn.one_hot(batch['y'], num_classes)
+    labels = jax.nn.one_hot(y, num_classes)
     ce = optax.softmax_cross_entropy(logits, labels)
     ce = jnp.where(seed_mask, ce, 0.0)
     loss = ce.sum() / jnp.maximum(seed_mask.sum(), 1)
-    correct = (logits.argmax(-1) == batch['y']) & seed_mask
+    correct = (logits.argmax(-1) == y) & seed_mask
     acc = correct.sum() / jnp.maximum(seed_mask.sum(), 1)
     return loss, acc
 
@@ -72,12 +73,20 @@ def make_eval_counts(model):
   def eval_counts(params, batch):
     logits = model.apply(params, batch['x'], batch['edge_index'],
                          batch['edge_mask'])
-    n = logits.shape[0]
+    n = logits.shape[0]            # layered models emit a seed-side prefix
     seed_mask = jnp.arange(n) < batch['num_seed_nodes']
-    correct = (logits.argmax(-1) == batch['y']) & seed_mask
+    correct = (logits.argmax(-1) == batch['y'][:n]) & seed_mask
     return correct.sum(), seed_mask.sum()
 
   return eval_counts
+
+
+def tree_hop_offsets(batch_cap: int, fanouts, node_budget=None):
+  """(hop_node_offsets, hop_edge_offsets) for the layered forward over
+  dedup='tree' batches — delegates to the sampler's layout plan so the
+  two can never diverge."""
+  from ..sampler.neighbor_sampler import tree_layout
+  return tree_layout(batch_cap, list(fanouts), node_budget)
 
 
 def make_link_train_step(model, tx):

@@ -62,6 +62,27 @@ def _tree_node_cap(caps, fanouts) -> int:
   return caps[0] + sum(c * k for c, k in zip(caps[:-1], fanouts))
 
 
+def tree_layout(batch_cap: int, fanouts, node_budget=None):
+  """(hop_node_offsets, hop_edge_offsets) of the tree-mode positional
+  layout — THE source of truth shared by the sampler's buffer plan and
+  the layered model forward (models.train.tree_hop_offsets)."""
+  caps = [batch_cap]
+  for k in fanouts:
+    nxt = caps[-1] * k
+    if node_budget is not None:
+      nxt = min(nxt, node_budget)
+    caps.append(nxt)
+  node_offs = [caps[0]]
+  edge_offs = []
+  total_e = 0
+  for i, k in enumerate(fanouts):
+    seg = caps[i] * k
+    total_e += seg
+    edge_offs.append(total_e)
+    node_offs.append(node_offs[-1] + seg)
+  return tuple(node_offs), tuple(edge_offs)
+
+
 @functools.lru_cache(maxsize=None)
 def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
                    num_graph_nodes, padded=False):
