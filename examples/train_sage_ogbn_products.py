@@ -136,14 +136,17 @@ def main():
       ds, args.fanout, train_idx, batch_size=args.batch_size, shuffle=True,
       drop_last=True, seed=0, dedup=args.dedup)
 
+  depth = len(args.fanout)
   if args.dedup == 'tree':
     # layered forward: each conv only processes the tree depths it
     # needs — 2.4x device speedup on the train step (PERF.md)
     no, eo = train_lib.tree_hop_offsets(args.batch_size, args.fanout)
-    model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls, num_layers=3,
-                      hop_node_offsets=no, hop_edge_offsets=eo)
+    model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
+                      num_layers=depth, hop_node_offsets=no,
+                      hop_edge_offsets=eo)
   else:
-    model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls, num_layers=3)
+    model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
+                      num_layers=depth)
   first = train_lib.batch_to_dict(next(iter(loader)))
   state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
                                            first, lr=args.lr)

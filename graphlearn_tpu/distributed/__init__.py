@@ -7,7 +7,8 @@ from .dist_feature import DistFeature
 from .dist_graph import DistGraph, DistHeteroGraph, build_local_csr
 from .dist_loader import (DistLinkNeighborLoader, DistLoader,
                           DistNeighborLoader, DistSubGraphLoader,
-                          MpDistNeighborLoader, RemoteDistNeighborLoader)
+                          MpDistLinkNeighborLoader, MpDistNeighborLoader,
+                          RemoteDistNeighborLoader)
 from .dist_neighbor_sampler import DistNeighborSampler
 from .dist_options import (CollocatedDistSamplingWorkerOptions,
                            MpDistSamplingWorkerOptions,

@@ -140,20 +140,26 @@ class MpDistNeighborLoader:
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, num_workers: int = 2,
                channel_size: int = 1 << 26, seed: Optional[int] = None):
-    from ..channel import QueueTimeoutError, ShmChannel
     from ..sampler import SamplingConfig, SamplingType
-    from .dist_sampling_producer import DistMpSamplingProducer
-    from .message import message_to_data
-    self._message_to_data = message_to_data
-    self._timeout_error = QueueTimeoutError
     config = SamplingConfig(
         SamplingType.NODE, list(num_neighbors), batch_size, shuffle,
         drop_last, with_edge, collect_features, False, False,
         data.edge_dir, seed)
+    self._setup(data, NodeSamplerInput(np.asarray(input_nodes).reshape(-1)),
+                config, channel_size, num_workers, seed)
+
+  def _setup(self, data, sampler_input, config, channel_size, num_workers,
+             seed):
+    """Shared producer/channel wiring for the mp loader family."""
+    from ..channel import QueueTimeoutError, ShmChannel
+    from .dist_sampling_producer import DistMpSamplingProducer
+    from .message import message_to_data
+    self._message_to_data = message_to_data
+    self._timeout_error = QueueTimeoutError
     self.channel = ShmChannel(shm_size=channel_size)
     self.producer = DistMpSamplingProducer(
-        data, NodeSamplerInput(np.asarray(input_nodes).reshape(-1)),
-        config, self.channel, num_workers=num_workers, seed=seed)
+        data, sampler_input, config, self.channel,
+        num_workers=num_workers, seed=seed)
     self.producer.init()
     self._expected = self.producer.num_expected()
 
@@ -179,6 +185,31 @@ class MpDistNeighborLoader:
   def shutdown(self):
     self.producer.shutdown()
     self.channel.close()
+
+
+class MpDistLinkNeighborLoader(MpDistNeighborLoader):
+  """Mp worker mode for LINK sampling: subprocesses run
+  sample_from_edges (positives + negatives) and stream batches with
+  edge_label_index/edge_label metadata over the shm channel (reference:
+  the link branch of the sampling producers,
+  dist_sampling_producer.py:106-140)."""
+
+  def __init__(self, data, num_neighbors: List[int], edge_label_index,
+               edge_label=None, neg_sampling=None, batch_size: int = 64,
+               shuffle: bool = False, drop_last: bool = False,
+               with_edge: bool = False, collect_features: bool = True,
+               num_workers: int = 2, channel_size: int = 1 << 26,
+               seed: Optional[int] = None):
+    from ..sampler import (EdgeSamplerInput, SamplingConfig, SamplingType)
+    ei = np.asarray(edge_label_index)
+    config = SamplingConfig(
+        SamplingType.LINK, list(num_neighbors), batch_size, shuffle,
+        drop_last, with_edge, collect_features,
+        neg_sampling is not None, False, data.edge_dir, seed)
+    self._setup(data,
+                EdgeSamplerInput(ei[0], ei[1], label=edge_label,
+                                 neg_sampling=neg_sampling),
+                config, channel_size, num_workers, seed)
 
 
 class RemoteDistNeighborLoader:

@@ -109,12 +109,12 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
   rows, cols, edges, emasks = [], [], [], []
   nodes_per_hop = [state.num_nodes]
   edges_per_hop = []
-  offset = caps[0]
+  from ..sampler.neighbor_sampler import tree_layout_from_caps
+  node_offs, _ = tree_layout_from_caps(caps, fanouts)
   for i, k in enumerate(fanouts):
     nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
                                hop_keys[i], nparts, with_edge, weighted)
-    state, out = induce(state, fidx, nbrs, m, offset)
-    offset += caps[i] * k
+    state, out = induce(state, fidx, nbrs, m, node_offs[i])
     rows.append(out['cols'])   # message direction: neighbor -> seed
     cols.append(out['rows'])
     emasks.append(out['edge_mask'])
@@ -252,8 +252,8 @@ class DistNeighborSampler:
 
   def _node_cap(self, caps) -> int:
     if self.dedup == 'tree':
-      return caps[0] + sum(c * k for c, k in
-                           zip(caps[:-1], self.num_neighbors))
+      from ..sampler.neighbor_sampler import tree_layout_from_caps
+      return tree_layout_from_caps(caps, self.num_neighbors)[0][-1]
     return sum(caps)
 
   # ----------------------------------------------------- hetero static plan

@@ -49,22 +49,53 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
                              dataset_handle['node_labels'],
                              dataset_handle['edge_dir'])
   cfg: SamplingConfig = sampling_config
+  # fold the worker rank into the seed: same-seeded workers would draw
+  # IDENTICAL negative edges per batch index (negatives depend only on
+  # the graph + key, not the positives), collapsing negative diversity
+  worker_seed = (0 if cfg.seed is None else cfg.seed) * 1000003 + rank
   sampler = glt.sampler.NeighborSampler(
       dataset.graph, cfg.num_neighbors, with_edge=cfg.with_edge,
-      with_weight=cfg.with_weight, edge_dir=cfg.edge_dir, seed=cfg.seed)
+      with_weight=cfg.with_weight, edge_dir=cfg.edge_dir,
+      seed=worker_seed)
+  from graphlearn_tpu.sampler import (EdgeSamplerInput, NegativeSampling,
+                                      SamplingType)
+  is_link = cfg.sampling_type == SamplingType.LINK
+  if is_link:
+    # seeds is a dict payload for link sampling (reference producers
+    # branch on the config's sampling type the same way,
+    # dist_sampling_producer.py:106-140)
+    rows_, cols_ = seeds['rows'], seeds['cols']
+    label_ = seeds.get('label')
+    neg = (NegativeSampling(seeds['neg_mode'], seeds['neg_amount'])
+           if seeds.get('neg_mode') else None)
+    n_seeds = rows_.shape[0]
+  else:
+    n_seeds = seeds.shape[0]
   while True:
     cmd, payload = task_queue.get()
     if cmd == MpCommand.STOP:
       break
     epoch_seed_order = payload
-    n = seeds.shape[0]
+    n = n_seeds
     bs = cfg.batch_size
     for i in range(0, n - (n % bs if cfg.drop_last else 0), bs):
       idx = epoch_seed_order[i:i + bs]
       if idx.shape[0] == 0:
         continue
-      out = sampler.sample_from_nodes(NodeSamplerInput(seeds[idx]),
-                                      batch_cap=bs)
+      if is_link:
+        if idx.shape[0] < bs:
+          # pad the final short batch cyclically so every batch keeps the
+          # compiled shape (a fresh length would retrace the whole chain
+          # per epoch); the few duplicated positives are slightly
+          # over-weighted in that one batch
+          idx = np.resize(idx, bs)
+        out = sampler.sample_from_edges(EdgeSamplerInput(
+            rows_[idx], cols_[idx],
+            label=(label_[idx] if label_ is not None else None),
+            neg_sampling=neg))
+      else:
+        out = sampler.sample_from_nodes(NodeSamplerInput(seeds[idx]),
+                                        batch_cap=bs)
       x = y = None
       if cfg.collect_features and dataset.node_features is not None:
         x = dataset.node_features.cpu_get(
@@ -81,20 +112,34 @@ class DistMpSamplingProducer:
   """Spawn N sampling subprocesses feeding `channel`
   (reference: dist_sampling_producer.py:154-280)."""
 
-  def __init__(self, dataset, sampler_input: NodeSamplerInput,
+  def __init__(self, dataset, sampler_input,
                sampling_config: SamplingConfig, channel: ChannelBase,
                num_workers: int = 1, seed: Optional[int] = None):
     self.dataset = dataset
-    self.seeds = np.asarray(sampler_input.node).reshape(-1)
     self.config = sampling_config
+    if hasattr(sampler_input, 'row'):     # EdgeSamplerInput (link mode)
+      neg = sampler_input.neg_sampling
+      self._link_input = dict(
+          rows=np.asarray(sampler_input.row).reshape(-1),
+          cols=np.asarray(sampler_input.col).reshape(-1),
+          label=(np.asarray(sampler_input.label).reshape(-1)
+                 if sampler_input.label is not None else None),
+          neg_mode=(neg.mode if neg is not None else None),
+          neg_amount=(neg.amount if neg is not None else 1))
+      n = self._link_input['rows'].shape[0]
+      self.seeds = None
+    else:
+      self._link_input = None
+      self.seeds = np.asarray(sampler_input.node).reshape(-1)
+      n = self.seeds.shape[0]
+    self._num_seeds = n
     self.channel = channel
     self.num_workers = num_workers
     self._rng = np.random.default_rng(seed)
     self._procs = []
     self._queues = []
     self._done = None
-    self._splits = np.array_split(np.arange(self.seeds.shape[0]),
-                                  num_workers)
+    self._splits = np.array_split(np.arange(n), num_workers)
 
   def init(self):
     ctx = mp.get_context('spawn')
@@ -108,9 +153,19 @@ class DistMpSamplingProducer:
     # ship host containers; subprocesses rebuild on the CPU backend
     for w in range(self.num_workers):
       q = ctx.Queue()
+      if self._link_input is not None:
+        sl = self._splits[w]
+        li = self._link_input
+        wseeds = dict(rows=li['rows'][sl], cols=li['cols'][sl],
+                      label=(li['label'][sl] if li['label'] is not None
+                             else None),
+                      neg_mode=li['neg_mode'],
+                      neg_amount=li['neg_amount'])
+      else:
+        wseeds = self.seeds[self._splits[w]]
       p = ctx.Process(
           target=_sampling_worker_loop,
-          args=(w, handle, self.config, self.seeds[self._splits[w]], q,
+          args=(w, handle, self.config, wseeds, q,
                 self.channel, self._done),
           daemon=True)
       p.start()

@@ -12,6 +12,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+import numpy as np
+
 from ..channel import QueueTimeoutError, ShmChannel
 from ..sampler import NodeSamplerInput, SamplingConfig
 from .dist_context import _set_server_context, get_context
@@ -45,8 +47,23 @@ class DistServer:
       pid = self._next_id
       self._next_id += 1
       buf = ShmChannel(shm_size=buffer_size)
+      from ..sampler import EdgeSamplerInput, SamplingType
+      if sampling_config.sampling_type == SamplingType.LINK:
+        # seeds arrive as [2, E] (or an EdgeSamplerInput); negatives are
+        # requested through config.with_neg (binary, amount 1 — pass an
+        # EdgeSamplerInput for other modes)
+        if not isinstance(seeds, EdgeSamplerInput):
+          from ..sampler import NegativeSampling
+          ei = np.asarray(seeds)
+          seeds = EdgeSamplerInput(
+              ei[0], ei[1],
+              neg_sampling=(NegativeSampling('binary', 1)
+                            if sampling_config.with_neg else None))
+        sampler_input = seeds
+      else:
+        sampler_input = NodeSamplerInput.cast(seeds)
       producer = DistMpSamplingProducer(
-          self.dataset, NodeSamplerInput.cast(seeds), sampling_config, buf,
+          self.dataset, sampler_input, sampling_config, buf,
           num_workers=num_workers)
       producer.init()
       self._producers[pid] = producer

@@ -86,7 +86,7 @@ def tree_hop_offsets(batch_cap: int, fanouts, node_budget=None):
   dedup='tree' batches — delegates to the sampler's layout plan so the
   two can never diverge."""
   from ..sampler.neighbor_sampler import tree_layout
-  return tree_layout(batch_cap, list(fanouts), node_budget)
+  return tree_layout(batch_cap, list(fanouts), node_budget)  # shared plan
 
 
 def make_link_train_step(model, tx):

@@ -57,21 +57,21 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
                                                       offset=off)
 
 
-def _tree_node_cap(caps, fanouts) -> int:
-  """Positional layout size: seeds block + one full block per hop."""
-  return caps[0] + sum(c * k for c, k in zip(caps[:-1], fanouts))
-
-
-def tree_layout(batch_cap: int, fanouts, node_budget=None):
-  """(hop_node_offsets, hop_edge_offsets) of the tree-mode positional
-  layout — THE source of truth shared by the sampler's buffer plan and
-  the layered model forward (models.train.tree_hop_offsets)."""
+def capacity_plan(batch_cap: int, fanouts, node_budget=None):
+  """Per-hop frontier capacities [b, c1, ...] with the node_budget
+  clamp — the shared base of every buffer/offset computation below."""
   caps = [batch_cap]
   for k in fanouts:
     nxt = caps[-1] * k
     if node_budget is not None:
       nxt = min(nxt, node_budget)
     caps.append(nxt)
+  return caps
+
+
+def tree_layout_from_caps(caps, fanouts):
+  """(hop_node_offsets, hop_edge_offsets) of the tree-mode positional
+  layout for an existing capacity plan."""
   node_offs = [caps[0]]
   edge_offs = []
   total_e = 0
@@ -81,6 +81,20 @@ def tree_layout(batch_cap: int, fanouts, node_budget=None):
     edge_offs.append(total_e)
     node_offs.append(node_offs[-1] + seg)
   return tuple(node_offs), tuple(edge_offs)
+
+
+def tree_layout(batch_cap: int, fanouts, node_budget=None):
+  """(hop_node_offsets, hop_edge_offsets) of the tree-mode positional
+  layout — THE source of truth shared by the sampler's buffer plan
+  (_homo_capacities/_node_cap/_fused_homo_fn all derive from it) and the
+  layered model forward (models.train.tree_hop_offsets)."""
+  return tree_layout_from_caps(capacity_plan(batch_cap, fanouts,
+                                             node_budget), fanouts)
+
+
+def _tree_node_cap(caps, fanouts) -> int:
+  """Positional layout size: seeds block + one full block per hop."""
+  return tree_layout_from_caps(caps, fanouts)[0][-1]
 
 
 @functools.lru_cache(maxsize=None)
@@ -110,7 +124,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
     nodes_per_hop = [state.num_nodes]
     edges_per_hop = []
     keys = jax.random.split(key, len(fanouts))
-    offset = caps[0]
+    node_offs, _ = tree_layout_from_caps(caps, fanouts)
     for i, k in enumerate(fanouts):
       if padded:
         nbrs, epos, m = ops.uniform_sample_padded(
@@ -121,8 +135,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
       else:
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
                                            fmask, k, keys[i])
-      state, out = induce_fn(state, fidx, nbrs, m, offset)
-      offset += caps[i] * k
+      state, out = induce_fn(state, fidx, nbrs, m, node_offs[i])
       # message direction: neighbor -> seed
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -307,13 +320,7 @@ class NeighborSampler(BaseSampler):
 
   def _homo_capacities(self, batch_cap: int, fanouts) -> List[int]:
     """Frontier capacity per hop (hop 0 = seeds)."""
-    caps = [batch_cap]
-    for k in fanouts:
-      nxt = caps[-1] * k
-      if self.node_budget is not None:
-        nxt = min(nxt, self.node_budget)
-      caps.append(nxt)
-    return caps
+    return capacity_plan(batch_cap, fanouts, self.node_budget)
 
   def _node_cap(self, caps, fanouts) -> int:
     if self._dedup_mode() == 'tree':

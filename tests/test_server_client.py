@@ -66,6 +66,36 @@ def test_mp_dist_neighbor_loader():
     loader.shutdown()
 
 
+def test_mp_dist_link_loader():
+  """LINK sampling through the mp producer path: batches stream with
+  edge_label_index/edge_label metadata and positives relocate to the
+  seed edge pairs."""
+  from graphlearn_tpu.sampler import NegativeSampling
+  ds = make_dataset()
+  rows = np.arange(N)
+  cols = (np.arange(N) + 1) % N
+  loader = glt.distributed.MpDistLinkNeighborLoader(
+      ds, [2], np.stack([rows, cols]),
+      neg_sampling=NegativeSampling('binary', 1), batch_size=4,
+      num_workers=2, seed=0)
+  try:
+    batches = 0
+    for batch in loader:
+      batches += 1
+      node = np.asarray(batch.node)
+      eli = np.asarray(batch.metadata['edge_label_index'])
+      label = np.asarray(batch.metadata['edge_label'])
+      npos = int((label == 1).sum())
+      assert npos > 0 and (label == 0).sum() > 0
+      for i in range(npos):
+        u = int(node[eli[0, i]])
+        v = int(node[eli[1, i]])
+        assert v == (u + 1) % N
+    assert batches == len(loader)
+  finally:
+    loader.shutdown()
+
+
 def _server_main(port_queue):
   import jax
   try:
