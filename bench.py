@@ -121,18 +121,24 @@ def main():
   # W=16 covers the max fanout 15 and is the fastest window, PERF.md)
   s_pad = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True,
                                       dedup='tree', padded_window=16)
+  # block mode: cluster sampling over aligned 16-wide CSR blocks — raw
+  # CSR, exact uniform marginals, row-gather speed (PERF.md)
+  s_blk = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True,
+                                      dedup='tree', strategy='block')
   rng = np.random.default_rng(1)
 
   # compile all programs outside the trace
   _run_mode(s_tree, rng, jax)
   _run_mode(s_map, rng, jax)
   _run_mode(s_pad, rng, jax)
+  _run_mode(s_blk, rng, jax)
 
   shutil.rmtree(TRACE_DIR, ignore_errors=True)
   jax.profiler.start_trace(TRACE_DIR)
   tree_edges, tree_dispatch = _run_mode(s_tree, rng, jax)
   map_edges, _ = _run_mode(s_map, rng, jax)
   pad_edges, _ = _run_mode(s_pad, rng, jax)
+  blk_edges, _ = _run_mode(s_blk, rng, jax)
   jax.profiler.stop_trace()
 
   progs = _device_program_ms(TRACE_DIR)
@@ -149,9 +155,10 @@ def main():
   result = {}
   tree_ms, map_ms = mode_ms('tree'), mode_ms('map')
   pad_ms = mode_ms('tree_padded')
+  blk_ms = mode_ms('tree_block')
   if tree_ms is None or map_ms is None:
     # trace unavailable (non-TPU backend): fall back to dispatch wall
-    tree_ms = map_ms = pad_ms = tree_dispatch / ITERS * 1000
+    tree_ms = map_ms = pad_ms = blk_ms = tree_dispatch / ITERS * 1000
     result['timing'] = 'dispatch-wall-fallback'
   tree_rate = np.mean(tree_edges) / tree_ms / 1e3   # edges/ms -> M/s
   map_rate = np.mean(map_edges) / map_ms / 1e3
@@ -173,6 +180,12 @@ def main():
   else:
     # measurement failure must not read as a 0-regression
     result['padded16_edges_per_sec_m'] = None
+  if blk_ms:
+    blk_rate = np.mean(blk_edges) / blk_ms / 1e3
+    result['block_edges_per_sec_m'] = round(float(blk_rate), 3)
+    result['block_device_ms_per_batch'] = round(float(blk_ms), 3)
+  else:
+    result['block_edges_per_sec_m'] = None
   print(json.dumps(result))
 
 

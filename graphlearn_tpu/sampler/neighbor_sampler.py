@@ -99,7 +99,7 @@ def _tree_node_cap(caps, fanouts) -> int:
 
 @functools.lru_cache(maxsize=None)
 def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
-                   num_graph_nodes, padded=False):
+                   num_graph_nodes, padded=False, block_num_edges=0):
   """Jitted whole-multi-hop sample program, cached at MODULE level on its
   static signature: every sampler instance with the same config (e.g. the
   train and eval loaders of one run) shares one traced/compiled
@@ -129,6 +129,9 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
       if padded:
         nbrs, epos, m = ops.uniform_sample_padded(
             tab, deg, frontier, fmask, k, keys[i], epos_table=eptab)
+      elif block_num_edges:
+        nbrs, epos, m = ops.uniform_sample_block(
+            indptr, tab, block_num_edges, frontier, fmask, k, keys[i])
       elif weighted:
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
                                             fmask, k, keys[i])
@@ -160,7 +163,8 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
 
   # distinguishable per-mode trace name (bench.py keys device-trace
   # events by the jitted program name)
-  fn.__name__ = f'sample_{mode}' + ('_padded' if padded else '')
+  fn.__name__ = f'sample_{mode}' + ('_padded' if padded else '') + \
+      ('_block' if block_num_edges else '')
   fn.__qualname__ = fn.__name__
   return jax.jit(fn)
 
@@ -218,6 +222,24 @@ class NeighborSampler(BaseSampler):
     # with degree > W sample from a uniformly random W-subset (rebuild
     # with a new seed to refresh). Homo + uniform only.
     self.padded_window = padded_window
+    fo = (list(num_neighbors)
+          if num_neighbors is not None and
+          not isinstance(num_neighbors, dict) else [])
+    # strategy='block': cluster sampling over aligned 16-wide CSR blocks
+    # (row-gather speed on the raw CSR, exact uniform marginals,
+    # correlated within a row per hop — ops.uniform_sample_block)
+    if strategy == 'block':
+      if isinstance(graph, dict):
+        raise ValueError('block sampling is homogeneous-only')
+      if with_weight:
+        raise ValueError('block sampling does not support weights')
+      if not fused:
+        raise ValueError('block sampling requires the fused path')
+      if padded_window is not None:
+        raise ValueError("strategy='block' and padded_window are "
+                         'mutually exclusive sampling backends')
+      if fo and max(fo) > ops.BLOCK:
+        raise ValueError(f'block sampling caps fanouts at {ops.BLOCK}')
     if padded_window is not None:
       if with_weight:
         raise ValueError('padded_window does not support weighted '
@@ -227,10 +249,6 @@ class NeighborSampler(BaseSampler):
       if isinstance(graph, dict):
         raise ValueError('padded_window is homogeneous-only (the typed '
                          'engine samples the CSR directly)')
-      fo = []
-      if num_neighbors is not None and not isinstance(num_neighbors,
-                                                      dict):
-        fo = list(num_neighbors)
       if fo and padded_window < max(fo):
         raise ValueError(
             f'padded_window={padded_window} < max fanout {max(fo)}: '
@@ -332,12 +350,16 @@ class NeighborSampler(BaseSampler):
     g = self._get_graph()
     caps = self._homo_capacities(batch_cap, fanouts)
     mode = self._dedup_mode()
+    nblk_edges = 0
+    if self.strategy == 'block':
+      nblk_edges = int(g.indices.shape[0])   # no D2H: shape is metadata
     return _fused_homo_fn(
         tuple(fanouts), tuple(caps), self._node_cap(caps, fanouts),
         self.with_edge,
         self.with_weight and g.edge_weights is not None,
         mode, g.num_nodes if mode == 'map' else 0,
-        padded=self.padded_window is not None)
+        padded=self.padded_window is not None,
+        block_num_edges=nblk_edges)
 
   def _padded_arrays(self):
     """Lazily built device-resident padded adjacency (homo)."""
@@ -351,6 +373,22 @@ class NeighborSampler(BaseSampler):
       self._garrs[key] = dict(
           tab=jnp.asarray(tab), deg=jnp.asarray(deg),
           eptab=(jnp.asarray(epos) if epos is not None else None))
+    return self._garrs[key]
+
+  def _block_arrays(self):
+    """Aligned [E/16, 16] view of the CSR indices (FILL tail pad).
+    Built device-side — a host round-trip here would both copy ~E bytes
+    and flip the remote-dispatch runtime into its degraded mode
+    (PERF.md)."""
+    import jax.numpy as jnp
+    g = self._get_graph()
+    key = ('blocks', id(g))
+    if key not in self._garrs:
+      ind = jnp.asarray(g.indices)
+      pad = (-int(ind.shape[0])) % ops.BLOCK
+      if pad:
+        ind = jnp.concatenate([ind, jnp.full((pad,), -1, ind.dtype)])
+      self._garrs[key] = ind.reshape(-1, ops.BLOCK)
     return self._garrs[key]
 
   def refresh_padded_table(self, seed: Optional[int] = None):
@@ -373,11 +411,14 @@ class NeighborSampler(BaseSampler):
       pa = self._padded_arrays()
       return (ga['indptr'], ga['indices'], ga['eids'], cum, pa['tab'],
               pa['deg'], pa['eptab'])
+    if self.strategy == 'block':
+      return (ga['indptr'], ga['indices'], ga['eids'], cum,
+              self._block_arrays(), None, None)
     return ga['indptr'], ga['indices'], ga['eids'], cum, None, None, None
 
   def _homo_fn(self, batch_cap: int, fanouts):
     sig = ('homo', batch_cap, tuple(fanouts), self.with_edge,
-           self.with_weight, self.padded_window)
+           self.with_weight, self.padded_window, self.strategy)
     if sig not in self._fns:
       self._fns[sig] = self._build_homo_fn(batch_cap, tuple(fanouts))
     return self._fns[sig]
