@@ -7,10 +7,16 @@ several ``split_ratio`` values to see the hot-cache effect; with the
 miss-proportional mixed gather (data/unified_tensor.py) the host->device
 traffic scales with (1 - hit_rate), not batch size.
 
+TIMING: the all-hot path reports DEVICE-TRACE GB/s (wall clocks are
+unreliable on the axon tunnel — PERF.md); mixed ratios inherently involve
+host work + transfers, so their figure is wall-clock and tunnel-bound on
+this rig (noted in the output as timing='wall').
+
 Usage: python benchmarks/bench_feature.py [--split-ratios 0.2,1.0]
 """
 import argparse
 import json
+import shutil
 import sys
 import time
 
@@ -18,7 +24,10 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
 
-from bench import AVG_DEG, BATCH, FANOUT, NUM_NODES, build_graph  # noqa: E402
+from bench import (AVG_DEG, BATCH, FANOUT, NUM_NODES,  # noqa: E402
+                   _device_program_ms, build_graph)
+
+TRACE_DIR = '/tmp/glt_feat_trace'
 
 FEAT_DIM = 100  # ogbn-products feature width
 ITERS = 20
@@ -79,6 +88,10 @@ def main():
       outs.append(store[ids])
     jax.block_until_ready(outs)
     log(f'split_ratio={ratio}: timing...')
+    all_hot = ratio >= 1.0
+    if all_hot:
+      shutil.rmtree(TRACE_DIR, ignore_errors=True)
+      jax.profiler.start_trace(TRACE_DIR)
     t0 = time.perf_counter()
     outs, rows = [], 0
     for ids, nvalid in lookup_sets[WARMUP:]:
@@ -86,6 +99,14 @@ def main():
       rows += nvalid
     jax.block_until_ready(outs)
     dt = time.perf_counter() - t0
+    timing = 'wall'
+    if all_hot:
+      jax.profiler.stop_trace()
+      progs = _device_program_ms(TRACE_DIR)
+      dev_ms = sum(ms * cnt for ms, cnt in progs.values())
+      if dev_ms:
+        dt = dev_ms / 1000.0
+        timing = 'device-trace'
     gbs = rows * FEAT_DIM * 4 / dt / (1024 ** 3)
     hot = int(args.num_nodes * ratio)
     hits = sum(int((store.id2index[ids] < hot).sum())
@@ -94,7 +115,8 @@ def main():
     results.append(dict(split_ratio=ratio,
                         gb_per_sec=round(gbs, 3),
                         hit_rate=round(hits / total, 3),
-                        lookup_rows=rows, secs=round(dt, 4)))
+                        lookup_rows=rows, secs=round(dt, 4),
+                        timing=timing))
     print(json.dumps({'metric': 'feature_lookup_gbps', **results[-1]}))
   return results
 
