@@ -193,7 +193,7 @@ BLOCK = 16  # aligned CSR block width for block sampling
 
 
 @functools.partial(jax.jit, static_argnames=('k',))
-def uniform_sample_block(indptr, indices_blocks, num_edges: int, seeds,
+def uniform_sample_block(csr_meta, indices_blocks, num_edges: int, seeds,
                          seed_mask, k: int, key):
   """Block (cluster) fanout sampling over the raw CSR — row-gather speed
   without a prebuilt table.
@@ -210,6 +210,7 @@ def uniform_sample_block(indptr, indices_blocks, num_edges: int, seeds,
   cluster sampling, fresh per batch via the PRNG (unlike the padded
   table's fixed W-subset).
 
+  ``csr_meta`` is the [N, 2] packed (row start, degree) table;
   ``indices_blocks`` is ``padded_indices.reshape(-1, 16)`` where the
   indices array is FILL-padded to a multiple of 16 (`num_edges` = true
   edge count). Same output contract as :func:`uniform_sample`.
@@ -218,8 +219,12 @@ def uniform_sample_block(indptr, indices_blocks, num_edges: int, seeds,
   b = seeds.shape[0]
   nblocks = indices_blocks.shape[0]
   safe = jnp.where(seed_mask, seeds, 0)
-  start = indptr[safe]
-  deg = jnp.where(seed_mask, indptr[safe + 1] - start, 0)
+  # (start, deg) packed per node: ONE 2-wide row gather instead of two
+  # element gathers over indptr (element gathers are the latency-bound
+  # op this mode exists to avoid)
+  meta = csr_meta[safe]
+  start = meta[:, 0]
+  deg = jnp.where(seed_mask, meta[:, 1], 0)
   small = deg <= k                                 # keep-all branch
   ku, kk = jax.random.split(key)
   u = jax.random.uniform(ku, (b,))

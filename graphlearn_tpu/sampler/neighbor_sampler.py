@@ -130,8 +130,9 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
         nbrs, epos, m = ops.uniform_sample_padded(
             tab, deg, frontier, fmask, k, keys[i], epos_table=eptab)
       elif block_num_edges:
+        # deg is the metadata row gather; tab = (csr_meta, indices_blocks)
         nbrs, epos, m = ops.uniform_sample_block(
-            indptr, tab, block_num_edges, frontier, fmask, k, keys[i])
+            deg, tab, block_num_edges, frontier, fmask, k, keys[i])
       elif weighted:
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
                                             fmask, k, keys[i])
@@ -376,10 +377,10 @@ class NeighborSampler(BaseSampler):
     return self._garrs[key]
 
   def _block_arrays(self):
-    """Aligned [E/16, 16] view of the CSR indices (FILL tail pad).
-    Built device-side — a host round-trip here would both copy ~E bytes
-    and flip the remote-dispatch runtime into its degraded mode
-    (PERF.md)."""
+    """(aligned [E/16, 16] view of the CSR indices, packed [N, 2]
+    (start, deg) metadata). Built device-side — a host round-trip here
+    would both copy ~E bytes and flip the remote-dispatch runtime into
+    its degraded mode (PERF.md)."""
     import jax.numpy as jnp
     g = self._get_graph()
     key = ('blocks', id(g))
@@ -388,7 +389,10 @@ class NeighborSampler(BaseSampler):
       pad = (-int(ind.shape[0])) % ops.BLOCK
       if pad:
         ind = jnp.concatenate([ind, jnp.full((pad,), -1, ind.dtype)])
-      self._garrs[key] = ind.reshape(-1, ops.BLOCK)
+      ptr = jnp.asarray(g.indptr)
+      meta = jnp.stack([ptr[:-1], ptr[1:] - ptr[:-1]],
+                       axis=1).astype(jnp.int32)
+      self._garrs[key] = (ind.reshape(-1, ops.BLOCK), meta)
     return self._garrs[key]
 
   def refresh_padded_table(self, seed: Optional[int] = None):
@@ -412,8 +416,9 @@ class NeighborSampler(BaseSampler):
       return (ga['indptr'], ga['indices'], ga['eids'], cum, pa['tab'],
               pa['deg'], pa['eptab'])
     if self.strategy == 'block':
-      return (ga['indptr'], ga['indices'], ga['eids'], cum,
-              self._block_arrays(), None, None)
+      blocks, meta = self._block_arrays()
+      return (ga['indptr'], ga['indices'], ga['eids'], cum, blocks,
+              meta, None)
     return ga['indptr'], ga['indices'], ga['eids'], cum, None, None, None
 
   def _homo_fn(self, batch_cap: int, fanouts):
