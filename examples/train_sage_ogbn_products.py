@@ -105,6 +105,11 @@ def main():
                   help="batch construction: 'map' = reference-parity "
                        "exact dedup; 'tree' (default) = computation-tree "
                        "batches, 4x faster sampling on TPU (PERF.md)")
+  ap.add_argument('--node-budget', type=int, default=None,
+                  help='clamp any hop frontier to this many nodes: '
+                       'shrinks the padded batch buffers (and so the '
+                       'feature gather + model compute) at the cost of '
+                       'truncating expansion beyond the budget')
   ap.add_argument('--strategy', default='random',
                   choices=['random', 'block'],
                   help="'block' = cluster sampling over aligned CSR "
@@ -139,13 +144,15 @@ def main():
 
   loader = glt.loader.NeighborLoader(
       ds, args.fanout, train_idx, batch_size=args.batch_size, shuffle=True,
-      drop_last=True, seed=0, dedup=args.dedup, strategy=args.strategy)
+      drop_last=True, seed=0, dedup=args.dedup, strategy=args.strategy,
+      node_budget=args.node_budget)
 
   depth = len(args.fanout)
   if args.dedup == 'tree':
     # layered forward: each conv only processes the tree depths it
     # needs — 2.4x device speedup on the train step (PERF.md)
-    no, eo = train_lib.tree_hop_offsets(args.batch_size, args.fanout)
+    no, eo = train_lib.tree_hop_offsets(args.batch_size, args.fanout,
+                                        args.node_budget)
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
                       num_layers=depth, hop_node_offsets=no,
                       hop_edge_offsets=eo)
@@ -172,7 +179,8 @@ def main():
   # ---- eval on the held-out test split (device-accumulated) ----
   test_loader = glt.loader.NeighborLoader(
       ds, args.fanout, test_idx, batch_size=args.batch_size, shuffle=False,
-      drop_last=False, seed=1, dedup=args.dedup, strategy=args.strategy)
+      drop_last=False, seed=1, dedup=args.dedup, strategy=args.strategy,
+      node_budget=args.node_budget)
   correct = total = None
   t0 = time.perf_counter()
   for i, batch in enumerate(test_loader):
