@@ -230,11 +230,9 @@ class NeighborSampler(BaseSampler):
     # (row-gather speed on the raw CSR, exact uniform marginals,
     # correlated within a row per hop — ops.uniform_sample_block)
     if strategy == 'block':
-      if isinstance(graph, dict):
-        raise ValueError('block sampling is homogeneous-only')
       if with_weight:
         raise ValueError('block sampling does not support weights')
-      if not fused:
+      if not fused and not isinstance(graph, dict):
         raise ValueError('block sampling requires the fused path')
       if padded_window is not None:
         raise ValueError("strategy='block' and padded_window are "
@@ -324,6 +322,10 @@ class NeighborSampler(BaseSampler):
       nbrs, epos, mask = ops.weighted_sample(
           g.indptr, g.indices, self._cumsum_for(etype), srcs, src_mask, k,
           key)
+    elif self.strategy == 'block':
+      blocks, meta = self._block_arrays(etype)
+      nbrs, epos, mask = ops.uniform_sample_block(
+          meta, blocks, int(g.indices.shape[0]), srcs, src_mask, k, key)
     else:
       nbrs, epos, mask = ops.uniform_sample(g.indptr, g.indices, srcs,
                                             src_mask, k, key)
@@ -376,13 +378,13 @@ class NeighborSampler(BaseSampler):
           eptab=(jnp.asarray(epos) if epos is not None else None))
     return self._garrs[key]
 
-  def _block_arrays(self):
+  def _block_arrays(self, etype=None):
     """(aligned [E/16, 16] view of the CSR indices, packed [N, 2]
     (start, deg) metadata). Built device-side — a host round-trip here
     would both copy ~E bytes and flip the remote-dispatch runtime into
     its degraded mode (PERF.md)."""
     import jax.numpy as jnp
-    g = self._get_graph()
+    g = self._get_graph(etype)
     key = ('blocks', id(g))
     if key not in self._garrs:
       ind = jnp.asarray(g.indices)

@@ -157,6 +157,26 @@ def test_block_sampling_end_to_end():
   assert freqs.min() > 0.2 / deg0 and freqs.max() < 3.0 / deg0
 
 
+def test_hetero_block_sampling():
+  """strategy='block' in the typed engine: per-etype block tables, edges
+  valid per etype."""
+  et = ('u', 'to', 'v')
+  rev = glt.typing.reverse_edge_type(et)
+  n = 40
+  ei = np.stack([np.arange(n), (np.arange(n) + 1) % n])
+  graphs = {et: glt.data.Graph(glt.data.Topology(ei, num_nodes=n), 'CPU')}
+  sampler = glt.sampler.NeighborSampler(graphs, {et: [2]}, seed=0,
+                                        dedup='tree', strategy='block')
+  out = sampler.sample_from_nodes(NodeSamplerInput(np.array([0, 7]), 'u'))
+  nu = np.asarray(out.node['u'])
+  nv = np.asarray(out.node['v'])
+  m = np.asarray(out.edge_mask[rev])
+  assert m.sum() > 0
+  for ri, ci in zip(np.asarray(out.row[rev])[m],
+                    np.asarray(out.col[rev])[m]):
+    assert int(nv[ri]) == (int(nu[ci]) + 1) % n
+
+
 def test_hetero_tree_mode():
   """Typed tree mode: per-type positional slots, edges valid per etype."""
   et = ('u', 'to', 'v')
