@@ -1,0 +1,116 @@
+"""GraphSAGE at a features-exceed-HBM scale (papers100M-shaped).
+
+Counterpart of /root/reference/examples/multi_gpu/train_sage_ogbn_papers100m.py:
+the defining property of papers100M is that node features do NOT fit one
+accelerator's memory, so the feature store must split hot rows in HBM from
+cold rows in host RAM and ship only the misses. This example builds a
+synthetic at a scale where the feature table exceeds the HBM budget you
+give it (default: 10M nodes x 128 f32 = 5 GB against a 2 GB hot split),
+trains with the degree-ordered hot split (sort_by_in_degree, so the hot
+prefix catches most lookups), and reports the measured hit rate alongside
+convergence.
+
+NOTE on this rig: every mixed (hot+cold) lookup reads ids on host, which
+the axon tunnel punishes heavily (PERF.md) — epoch wall times here are
+tunnel-bound, not design-bound. The design point being demonstrated is
+capability + hit-rate-proportional transfer, verified by
+tests/test_feature.py::test_unified_tensor_ships_only_cold_rows.
+
+Run: python examples/train_sage_papers_scale.py --steps 8
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.models import GraphSAGE, train as train_lib
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-nodes', type=int, default=10_000_000)
+  ap.add_argument('--avg-deg', type=int, default=8)
+  ap.add_argument('--feat-dim', type=int, default=128)
+  ap.add_argument('--hot-gb', type=float, default=2.0,
+                  help='HBM budget for the hot feature prefix')
+  ap.add_argument('--steps', type=int, default=8)
+  ap.add_argument('--batch-size', type=int, default=256)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[5, 5])
+  args = ap.parse_args()
+
+  import jax
+  glt.utils.enable_compilation_cache()
+  rng = np.random.default_rng(0)
+  n, f = args.num_nodes, args.feat_dim
+  ncls = 16
+
+  t0 = time.time()
+  e = n * args.avg_deg
+  rows = rng.integers(0, n, e).astype(np.int32)
+  # zipf head so the degree reorder concentrates lookups in the hot prefix
+  cols = (rng.zipf(1.3, e) % n).astype(np.int32)
+  label = (cols[:n] % ncls).astype(np.int64)    # graph-correlated labels
+  feat = rng.standard_normal((n, f)).astype(np.float32)
+  feat_gb = feat.nbytes / (1 << 30)
+  split = min(1.0, args.hot_gb / feat_gb)
+  print(f'# features {feat_gb:.1f} GB vs hot budget {args.hot_gb} GB '
+        f'-> split_ratio {split:.3f}; built in {time.time()-t0:.1f}s',
+        flush=True)
+  assert split < 1.0, 'pick --num-nodes/--hot-gb so features exceed HBM'
+
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), num_nodes=n, graph_mode='HBM')
+  ds.init_node_features(feat, sort_func=glt.data.sort_by_in_degree,
+                        split_ratio=split)
+  ds.init_node_labels(label)
+
+  # uniform-random seeds reach cold-tail nodes, so batches genuinely mix
+  # hot HBM rows with host-spilled rows
+  loader = glt.loader.NeighborLoader(
+      ds, args.fanout, rng.integers(0, n, n // 100),
+      batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0,
+      dedup='tree', strategy='block')
+  model = GraphSAGE(hidden_dim=64, out_dim=ncls,
+                    num_layers=len(args.fanout))
+  it = iter(loader)
+  first = train_lib.batch_to_dict(next(it))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  train_step, _ = train_lib.make_train_step(model, tx, ncls)
+
+  hot = int(n * split)
+  id2idx = ds.node_features.id2index
+  losses, hits, total = [], 0, 0
+  t0 = time.perf_counter()
+  for i, batch in enumerate(it):
+    if i >= args.steps:
+      break
+    state, loss, acc = train_step(state, train_lib.batch_to_dict(batch))
+    losses.append(loss)
+    ids = np.asarray(batch.node)
+    valid = ids >= 0
+    hits += int((id2idx[ids[valid]] < hot).sum())
+    total += int(valid.sum())
+  jax.block_until_ready(state)
+  dt = time.perf_counter() - t0
+
+  print(json.dumps({
+      'num_nodes': n, 'feat_gb': round(feat_gb, 2),
+      'split_ratio': round(split, 3),
+      'hot_hit_rate': round(hits / max(total, 1), 3),
+      'steps': len(losses),
+      'first_loss': round(float(losses[0]), 4),
+      'final_loss': round(float(losses[-1]), 4),
+      'secs_per_step_wall': round(dt / max(len(losses), 1), 3),
+      'timing': 'wall (tunnel-bound on this rig; see PERF.md)',
+  }), flush=True)
+
+
+if __name__ == '__main__':
+  main()
