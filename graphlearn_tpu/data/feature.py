@@ -131,7 +131,11 @@ class Feature:
     """
     import jax.numpy as jnp
     self.lazy_init()
-    ids = jnp.asarray(ids)
+    # clamp FILL(-1) padding to id 0: jnp.take would WRAP -1 to the last
+    # row, which after a degree reorder is a cold-tail row — every padded
+    # slot would ship a host row for nothing (rows for pad slots are
+    # masked downstream, any value serves)
+    ids = jnp.maximum(jnp.asarray(ids), 0)
     if self._id2index_dev is not None:
       ids = jnp.take(self._id2index_dev, ids, axis=0)
     return self._unified[ids]
