@@ -44,6 +44,12 @@ WARMUP = 3
 ITERS = 20
 TRACE_DIR = '/tmp/glt_bench_trace'
 
+# end-to-end train-step section (products-like: SAGE h=256, 47 classes)
+E2E_ITERS = 10
+E2E_HIDDEN = 256
+E2E_CLASSES = 47
+E2E_FEAT_DIM = 100
+
 
 def build_graph():
   import graphlearn_tpu as glt
@@ -103,6 +109,50 @@ def _run_mode(sampler, rng, jax):
   dispatch_dt = time.perf_counter() - t0
   edges = [sum(int(c) for c in o.num_sampled_edges) for o in outs]
   return edges, dispatch_dt
+
+
+def _run_e2e(ds, train_idx, dtype, jax, trace_dir):
+  """One full train-step pipeline (block sampling + collate + layered
+  SAGE fwd/bwd/adam) traced for E2E_ITERS batches; returns total device
+  ms per batch summed across the pipeline's programs (the same breakdown
+  methodology as PERF.md 'End-to-end training step')."""
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import GraphSAGE
+  from graphlearn_tpu.models import train as train_lib
+
+  loader = glt.loader.NeighborLoader(
+      ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
+      drop_last=True, seed=0, dedup='tree', strategy='block')
+  no, eo = train_lib.tree_hop_offsets(BATCH, FANOUT)
+  model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
+                    num_layers=len(FANOUT), hop_node_offsets=no,
+                    hop_edge_offsets=eo, dtype=dtype)
+  it = iter(loader)
+  first = train_lib.batch_to_dict(next(it))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  step, _ = train_lib.make_train_step(model, tx, E2E_CLASSES)
+  state, loss, _ = step(state, first)            # compile
+  for _ in range(2):                             # warmup
+    state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
+  jax.block_until_ready(loss)
+  shutil.rmtree(trace_dir, ignore_errors=True)
+  jax.profiler.start_trace(trace_dir)
+  losses = []
+  for _ in range(E2E_ITERS):
+    state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
+    losses.append(loss)
+  jax.block_until_ready(losses)
+  jax.profiler.stop_trace()
+  progs = _device_program_ms(trace_dir)
+  if not progs:
+    return None
+  # every pipeline program (sample / collate / train_step / bookkeeping)
+  # runs exactly once per batch, so ms/step = sum of PER-CALL averages —
+  # robust to steps leaking across the trace window on this rig, where
+  # block_until_ready returns at dispatch (module docstring); a
+  # count-weighted total / E2E_ITERS would not be
+  return sum(ms for ms, _ in progs.values())
 
 
 def main():
@@ -186,6 +236,28 @@ def main():
     result['block_device_ms_per_batch'] = round(float(blk_ms), 3)
   else:
     result['block_edges_per_sec_m'] = None
+
+  # ---- end-to-end train step (sample + collate + layered SAGE) ----
+  try:
+    import jax.numpy as jnp
+    frng = np.random.default_rng(2)
+    feat = frng.standard_normal((NUM_NODES, E2E_FEAT_DIM),
+                                dtype=np.float32)
+    labels = frng.integers(0, E2E_CLASSES, NUM_NODES)
+    ds = glt.data.Dataset(graph=graph)
+    ds.init_node_features(feat)
+    ds.init_node_labels(labels)
+    n_seeds = BATCH * (E2E_ITERS + 4)
+    train_idx = frng.integers(0, NUM_NODES, n_seeds)
+    e2e_f32 = _run_e2e(ds, train_idx, None, jax, '/tmp/glt_bench_e2e_f32')
+    e2e_bf16 = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
+                        '/tmp/glt_bench_e2e_bf16')
+    result['train_step_ms_f32'] = (round(float(e2e_f32), 3)
+                                   if e2e_f32 else None)
+    result['train_step_ms_bf16'] = (round(float(e2e_bf16), 3)
+                                    if e2e_bf16 else None)
+  except Exception as e:                        # never break the headline
+    result['train_step_error'] = f'{type(e).__name__}: {e}'[:200]
   print(json.dumps(result))
 
 

@@ -100,6 +100,9 @@ def main():
                        'the graph; the reference evaluates it all, cap '
                        'keeps driver runs bounded; 0 = all)')
   ap.add_argument('--bf16-features', action='store_true')
+  ap.add_argument('--bf16-model', action='store_true',
+                  help='bf16 compute in the convs (MXU at 2x f32 rate); '
+                       'params/optimizer/loss stay f32')
   ap.add_argument('--dedup', default='tree',
                   choices=['auto', 'map', 'sort', 'tree'],
                   help="batch construction: 'map' = reference-parity "
@@ -148,6 +151,7 @@ def main():
       node_budget=args.node_budget)
 
   depth = len(args.fanout)
+  mdtype = jnp.bfloat16 if args.bf16_model else None
   if args.dedup == 'tree':
     # layered forward: each conv only processes the tree depths it
     # needs — 2.4x device speedup on the train step (PERF.md)
@@ -155,10 +159,10 @@ def main():
                                         args.node_budget)
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
                       num_layers=depth, hop_node_offsets=no,
-                      hop_edge_offsets=eo)
+                      hop_edge_offsets=eo, dtype=mdtype)
   else:
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
-                      num_layers=depth)
+                      num_layers=depth, dtype=mdtype)
   first = train_lib.batch_to_dict(next(iter(loader)))
   state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
                                            first, lr=args.lr)

@@ -66,14 +66,14 @@ def main():
   ds = glt.data.Dataset()
   ds.init_graph(np.stack([rows, cols]), num_nodes=n, graph_mode='HBM')
   # graph-correlated labels (learnable from 1-hop aggregation): each
-  # node's label is its first CSR neighbor's id class
-  topo = ds.get_graph().topo
-  indptr_np = np.asarray(topo.indptr)
-  indices_np = np.asarray(topo.indices)
-  first_nbr = np.where(np.diff(indptr_np) > 0,
-                       indices_np[np.minimum(indptr_np[:-1],
-                                             len(indices_np) - 1)],
-                       np.arange(n))
+  # node's label is one of its out-neighbors' id class. Computed on the
+  # HOST from the COO arrays already in hand — fetching the device CSR
+  # here would be a huge D2H transfer that also degrades every later
+  # dispatch on this rig (PERF.md "Timing on the axon tunnel").
+  order = np.argsort(rows, kind='stable')
+  uniq, first_pos = np.unique(rows[order], return_index=True)
+  first_nbr = np.arange(n)                      # deg-0 nodes: self class
+  first_nbr[uniq] = cols[order[first_pos]]
   label = (first_nbr % ncls).astype(np.int64)
   ds.init_node_features(feat, sort_func=glt.data.sort_by_in_degree,
                         split_ratio=split)
@@ -111,11 +111,13 @@ def main():
   jax.block_until_ready(state)
   dt = time.perf_counter() - t0
   # hit accounting after the clock stops (PERF.md: no host fetch in the
-  # hot region); padded -1 slots count as hot — the store clamps them to
-  # id 0, which the degree reorder keeps resident
+  # hot region). Only REAL lookups count: padded -1 slots are excluded —
+  # the store clamps them to storage row 0 (always hot), so including
+  # them would inflate the rate with traffic that costs nothing.
   hits = total = 0
   for nd in node_sets:
-    ids = np.maximum(np.asarray(nd), 0)
+    ids = np.asarray(nd)
+    ids = ids[ids >= 0]
     hits += int((id2idx[ids] < hot).sum())
     total += ids.size
 

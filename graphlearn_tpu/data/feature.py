@@ -131,14 +131,18 @@ class Feature:
     """
     import jax.numpy as jnp
     self.lazy_init()
-    # clamp FILL(-1) padding to id 0: jnp.take would WRAP -1 to the last
-    # row, which after a degree reorder is a cold-tail row — every padded
-    # slot would ship a host row for nothing (rows for pad slots are
-    # masked downstream, any value serves)
-    ids = jnp.maximum(jnp.asarray(ids), 0)
+    # FILL(-1) pad slots must not cost a host-row fetch: jnp.take would
+    # WRAP -1 to the last row (cold tail after a degree reorder). Clamp
+    # pads to STORAGE row 0 — after the remap, so it is the hottest row
+    # by construction — not to node id 0, whose remapped row can be cold.
+    # Rows for pad slots are masked downstream; any value serves.
+    ids = jnp.asarray(ids)
+    pad = ids < 0
+    idx = jnp.maximum(ids, 0)
     if self._id2index_dev is not None:
-      ids = jnp.take(self._id2index_dev, ids, axis=0)
-    return self._unified[ids]
+      idx = jnp.take(self._id2index_dev, idx, axis=0)
+    idx = jnp.where(pad, 0, idx)
+    return self._unified[idx]
 
   def device_table(self):
     """(feats_dev, id2index_dev) when ALL rows are HBM-resident, else None.

@@ -9,7 +9,7 @@ segment ops to efficient scatter-adds, and the masked-padding design means
 one compile for the whole epoch. Feature matmuls are [N, F] x [F, H] dense —
 MXU-shaped; keep hidden dims multiples of 128 for best tiling.
 """
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -25,9 +25,11 @@ def segment_mean_agg(msgs, col, edge_mask, num_nodes: int):
   """Mean-aggregate edge messages at their target nodes."""
   tgt = _masked_targets(col, edge_mask, num_nodes)
   summed = jax.ops.segment_sum(msgs, tgt, num_segments=num_nodes + 1)
-  count = jax.ops.segment_sum(jnp.ones_like(tgt, msgs.dtype), tgt,
+  # counts in f32 (exact for any degree), divide in the message dtype
+  count = jax.ops.segment_sum(jnp.ones_like(tgt, jnp.float32), tgt,
                               num_segments=num_nodes + 1)
-  return summed[:num_nodes] / jnp.maximum(count[:num_nodes, None], 1.0)
+  inv = (1.0 / jnp.maximum(count[:num_nodes, None], 1.0)).astype(msgs.dtype)
+  return summed[:num_nodes] * inv
 
 
 def segment_sum_agg(msgs, col, edge_mask, num_nodes: int):
@@ -48,19 +50,29 @@ _AGGS = {'mean': segment_mean_agg, 'sum': segment_sum_agg,
 
 
 class SAGEConv(nn.Module):
-  """GraphSAGE conv: W_self x_v + W_nbr agg_{u->v} x_u."""
+  """GraphSAGE conv: W_self x_v + W_nbr agg_{u->v} x_u.
+
+  ``dtype`` selects the compute dtype (``jnp.bfloat16`` runs the matmuls
+  and aggregation on the MXU at twice the f32 rate; params stay f32).
+  """
   out_dim: int
   aggr: str = 'mean'
   use_bias: bool = True
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     n = x.shape[0]
     row, col = edge_index[0], edge_index[1]
-    src = jnp.where((row >= 0)[:, None], x[jnp.maximum(row, 0)], 0.0)
+    src = jnp.where((row >= 0)[:, None], x[jnp.maximum(row, 0)],
+                    jnp.zeros((), x.dtype))
     agg = _AGGS[self.aggr](src, col, edge_mask, n)
-    h = nn.Dense(self.out_dim, use_bias=self.use_bias, name='lin_self')(x)
-    h = h + nn.Dense(self.out_dim, use_bias=False, name='lin_nbr')(agg)
+    h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
+                 name='lin_self')(x)
+    h = h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
+                     name='lin_nbr')(agg)
     return h
 
 
@@ -68,25 +80,31 @@ class GCNConv(nn.Module):
   """GCN conv with symmetric degree normalization + implicit self loops."""
   out_dim: int
   use_bias: bool = True
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     n = x.shape[0]
     row, col = edge_index[0], edge_index[1]
     tgt = _masked_targets(col, edge_mask, n)
     srcseg = _masked_targets(row, edge_mask, n)
-    ones = jnp.ones_like(tgt, x.dtype)
+    # degree norms in f32 regardless of compute dtype (rsqrt of counts)
+    ones = jnp.ones_like(tgt, jnp.float32)
     # degrees including the self loop
     deg_in = jax.ops.segment_sum(ones, tgt, num_segments=n + 1)[:n] + 1.0
     deg_out = jax.ops.segment_sum(ones, srcseg, num_segments=n + 1)[:n] + 1.0
-    h = nn.Dense(self.out_dim, use_bias=self.use_bias)(x)
+    h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype)(x)
     inv_src = (1.0 / jnp.sqrt(deg_out))[jnp.maximum(row, 0)]
     inv_dst_e = (1.0 / jnp.sqrt(deg_in))[jnp.maximum(col, 0)]
-    msgs = h[jnp.maximum(row, 0)] * (inv_src * inv_dst_e)[:, None]
+    norm = (inv_src * inv_dst_e).astype(h.dtype)
+    msgs = h[jnp.maximum(row, 0)] * norm[:, None]
     agg = jax.ops.segment_sum(
-        jnp.where(edge_mask[:, None], msgs, 0.0), tgt,
+        jnp.where(edge_mask[:, None], msgs, jnp.zeros((), h.dtype)), tgt,
         num_segments=n + 1)[:n]
-    return agg + h / deg_in[:, None]  # self loop term (1/sqrt(d)^2)
+    # self loop term (1/sqrt(d)^2)
+    return agg + h * (1.0 / deg_in[:, None]).astype(h.dtype)
 
 
 class GATConv(nn.Module):
@@ -95,21 +113,27 @@ class GATConv(nn.Module):
   heads: int = 1
   negative_slope: float = 0.2
   concat: bool = True
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     n = x.shape[0]
     h_dim = self.out_dim
     row, col = edge_index[0], edge_index[1]
     safe_row, safe_col = jnp.maximum(row, 0), jnp.maximum(col, 0)
-    w = nn.Dense(self.heads * h_dim, use_bias=False, name='lin')(x)
+    w = nn.Dense(self.heads * h_dim, use_bias=False, dtype=self.dtype,
+                 name='lin')(x)
     w = w.reshape(n, self.heads, h_dim)
     a_src = self.param('att_src', nn.initializers.glorot_uniform(),
                        (self.heads, h_dim))
     a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
                        (self.heads, h_dim))
-    alpha_src = (w * a_src[None]).sum(-1)  # [N, H]
-    alpha_dst = (w * a_dst[None]).sum(-1)
+    # attention logits/softmax in f32 for stability; messages in dtype
+    wf = w.astype(jnp.float32)
+    alpha_src = (wf * a_src[None]).sum(-1)  # [N, H]
+    alpha_dst = (wf * a_dst[None]).sum(-1)
     e = alpha_src[safe_row] + alpha_dst[safe_col]  # [E, H]
     e = nn.leaky_relu(e, self.negative_slope)
     tgt = _masked_targets(col, edge_mask, n)
@@ -119,11 +143,11 @@ class GATConv(nn.Module):
     e = jnp.exp(e - seg_max[tgt])
     e = jnp.where(edge_mask[:, None], e, 0.0)
     denom = jax.ops.segment_sum(e, tgt, num_segments=n + 1)
-    attn = e / jnp.maximum(denom[tgt], 1e-9)
+    attn = (e / jnp.maximum(denom[tgt], 1e-9)).astype(w.dtype)
     msgs = w[safe_row] * attn[:, :, None]           # [E, H, D]
     out = jax.ops.segment_sum(
-        jnp.where(edge_mask[:, None, None], msgs, 0.0), tgt,
-        num_segments=n + 1)[:n]
+        jnp.where(edge_mask[:, None, None], msgs, jnp.zeros((), w.dtype)),
+        tgt, num_segments=n + 1)[:n]
     if self.concat:
       return out.reshape(n, self.heads * h_dim)
     return out.mean(axis=1)

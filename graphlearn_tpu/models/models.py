@@ -37,6 +37,7 @@ class GraphSAGE(nn.Module):
   aggr: str = 'mean'
   hop_node_offsets: Any = None
   hop_edge_offsets: Any = None
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask, train: bool = False):
@@ -58,11 +59,12 @@ class GraphSAGE(nn.Module):
         hops_used = self.num_layers - i
         n_in = self.hop_node_offsets[hops_used]
         e_used = self.hop_edge_offsets[hops_used - 1]
-        x = SAGEConv(dim, aggr=self.aggr, name=f'conv{i}')(
+        x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,
+                     name=f'conv{i}')(
             x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
       else:
-        x = SAGEConv(dim, aggr=self.aggr, name=f'conv{i}')(
-            x, edge_index, edge_mask)
+        x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,
+                     name=f'conv{i}')(x, edge_index, edge_mask)
       if i < self.num_layers - 1:
         x = nn.relu(x)
         if self.dropout > 0:
@@ -75,12 +77,14 @@ class GCN(nn.Module):
   out_dim: int
   num_layers: int = 2
   dropout: float = 0.0
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask, train: bool = False):
     for i in range(self.num_layers):
       dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
-      x = GCNConv(dim, name=f'conv{i}')(x, edge_index, edge_mask)
+      x = GCNConv(dim, dtype=self.dtype, name=f'conv{i}')(
+          x, edge_index, edge_mask)
       if i < self.num_layers - 1:
         x = nn.relu(x)
         if self.dropout > 0:
@@ -94,6 +98,7 @@ class GAT(nn.Module):
   num_layers: int = 2
   heads: int = 4
   dropout: float = 0.0
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask, train: bool = False):
@@ -101,7 +106,8 @@ class GAT(nn.Module):
       last = i == self.num_layers - 1
       x = GATConv(self.out_dim if last else self.hidden_dim,
                   heads=1 if last else self.heads, concat=not last,
-                  name=f'conv{i}')(x, edge_index, edge_mask)
+                  dtype=self.dtype, name=f'conv{i}')(
+          x, edge_index, edge_mask)
       if not last:
         x = nn.elu(x)
         if self.dropout > 0:
@@ -146,17 +152,19 @@ class RGNN(nn.Module):
   num_layers: int = 2
   conv: str = 'sage'
   out_ntype: NodeType = None
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
-    x_dict = {t: nn.Dense(self.hidden_dim, name=f'embed_{t}')(x)
+    x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
+                          name=f'embed_{t}')(x)
               for t, x in x_dict.items()}
     for i in range(self.num_layers):
       last = i == self.num_layers - 1
       dim = self.out_dim if last else self.hidden_dim
-      convs = {tuple(et): SAGEConv(dim) if self.conv == 'sage'
-               else GATConv(dim)
+      convs = {tuple(et): SAGEConv(dim, dtype=self.dtype)
+               if self.conv == 'sage' else GATConv(dim, dtype=self.dtype)
                for et in self.etypes}
       x_dict = HeteroConv(convs, name=f'hetero{i}')(
           x_dict, edge_index_dict, edge_mask_dict)

@@ -37,6 +37,7 @@ def make_train_step(model, tx, num_classes: int):
   def loss_fn(params, batch):
     logits = model.apply(params, batch['x'], batch['edge_index'],
                          batch['edge_mask'])
+    logits = logits.astype(jnp.float32)  # loss in f32 under bf16 compute
     n = logits.shape[0]            # layered models emit a seed-side prefix
     y = batch['y'][:n]
     seed_mask = jnp.arange(n) < batch['num_seed_nodes']
@@ -98,7 +99,7 @@ def make_link_train_step(model, tx):
 
   def loss_fn(params, batch):
     h = model.apply(params, batch['x'], batch['edge_index'],
-                    batch['edge_mask'])
+                    batch['edge_mask']).astype(jnp.float32)
     eli = batch['edge_label_index']
     lab = batch['edge_label'].astype(jnp.float32)
     valid = (eli[0] >= 0) & (eli[1] >= 0)
