@@ -145,7 +145,17 @@ class HeteroConv(nn.Module):
 
 class RGNN(nn.Module):
   """Hetero GNN: embeds each node type, stacks HeteroConv layers
-  (reference examples/igbh/rgnn.py RGNN with sage/gat convs)."""
+  (reference examples/igbh/rgnn.py RGNN with sage/gat convs).
+
+  ``hop_node_offsets`` ({ntype: (o_0..o_H)}) / ``hop_edge_offsets``
+  ({etype: (e_1..e_H)}) — from ``sampler.hetero_tree_layout`` with the
+  SAME seed caps/fanouts as the loader — enable the HIERARCHICAL forward
+  over hetero tree-mode batches: layer l only processes the typed
+  node/edge prefixes its depth needs, the typed counterpart of the
+  reference's trim_to_layer hierarchical model
+  (examples/hetero/hierarchical_sage.py:35-66) and of this framework's
+  layered GraphSAGE. Requires dedup='tree' batches.
+  """
   etypes: Sequence[EdgeType]
   hidden_dim: int
   out_dim: int
@@ -153,10 +163,28 @@ class RGNN(nn.Module):
   conv: str = 'sage'
   out_ntype: NodeType = None
   dtype: Any = None
+  hop_node_offsets: Any = None
+  hop_edge_offsets: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
+    hier = self.hop_node_offsets is not None
+    if hier:
+      for t, x in x_dict.items():
+        assert t in self.hop_node_offsets, (
+            f'hierarchical forward: batch has node type {t!r} but '
+            f'hop_node_offsets only covers {list(self.hop_node_offsets)}')
+        assert len(self.hop_node_offsets[t]) >= self.num_layers + 1, (
+            f'hierarchical forward: hop_node_offsets for {t!r} has '
+            f'{len(self.hop_node_offsets[t])} entries, need '
+            f'num_layers+1={self.num_layers + 1} — layout fanouts must '
+            'cover every layer')
+        assert self.hop_node_offsets[t][-1] == x.shape[0], (
+            f'hierarchical forward: node offsets for {t!r} '
+            f'({self.hop_node_offsets[t]}) do not match the batch buffer '
+            f'({x.shape[0]}); build them with sampler.hetero_tree_layout '
+            'from the SAME seed caps/fanouts as the tree-mode loader')
     x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
                           name=f'embed_{t}')(x)
               for t, x in x_dict.items()}
@@ -166,8 +194,19 @@ class RGNN(nn.Module):
       convs = {tuple(et): SAGEConv(dim, dtype=self.dtype)
                if self.conv == 'sage' else GATConv(dim, dtype=self.dtype)
                for et in self.etypes}
-      x_dict = HeteroConv(convs, name=f'hetero{i}')(
-          x_dict, edge_index_dict, edge_mask_dict)
+      if hier:
+        hops_used = self.num_layers - i
+        x_in = {t: x[:self.hop_node_offsets[t][hops_used]]
+                for t, x in x_dict.items()}
+        ei = {et: v[:, :self.hop_edge_offsets[tuple(et)][hops_used - 1]]
+              for et, v in edge_index_dict.items()
+              if tuple(et) in self.hop_edge_offsets}
+        em = {et: v[:self.hop_edge_offsets[tuple(et)][hops_used - 1]]
+              for et, v in edge_mask_dict.items()
+              if tuple(et) in self.hop_edge_offsets}
+      else:
+        x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
+      x_dict = HeteroConv(convs, name=f'hetero{i}')(x_in, ei, em)
       if not last:
         x_dict = {t: nn.relu(v) for t, v in x_dict.items()}
     return x_dict if self.out_ntype is None else x_dict[self.out_ntype]

@@ -3,4 +3,5 @@ from .base import (BaseSampler, EdgeSamplerInput, HeteroSamplerOutput,
                    RemoteNodePathSamplerInput, RemoteSamplerInput,
                    SamplerOutput, SamplingConfig, SamplingType)
 from .negative_sampler import RandomNegativeSampler
-from .neighbor_sampler import NeighborSampler
+from .neighbor_sampler import (NeighborSampler, hetero_tree_layout,
+                               tree_layout)

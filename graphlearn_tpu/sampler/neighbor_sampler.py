@@ -97,6 +97,87 @@ def _tree_node_cap(caps, fanouts) -> int:
   return tree_layout_from_caps(caps, fanouts)[0][-1]
 
 
+def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir):
+  """Static hetero buffer plan shared by the typed engine and the
+  hierarchical model layout.
+
+  Returns ``(ntypes, hop_caps, node_caps)``: ``hop_caps[h]`` maps each
+  edge type active at hop ``h`` to ``(source-frontier capacity, fanout)``;
+  ``node_caps[t]`` is node type ``t``'s total buffer size.
+  """
+  num_hops = max(len(fanouts_of(et)) for et in etypes)
+  ntypes = set()
+  for (u, _, v) in etypes:
+    ntypes.update((u, v))
+  frontier_cap = {t: seed_caps.get(t, 0) for t in ntypes}
+  node_caps = dict(frontier_cap)
+  hop_caps = []
+  for hop in range(num_hops):
+    adds = {t: 0 for t in ntypes}
+    per_et = {}
+    for et in etypes:
+      fo = fanouts_of(et)
+      if hop >= len(fo):
+        continue
+      k = fo[hop]
+      key_t = et[0] if edge_dir == 'out' else et[2]
+      res_t = et[2] if edge_dir == 'out' else et[0]
+      fcap = frontier_cap.get(key_t, 0)
+      if fcap == 0 or k == 0:
+        continue
+      per_et[et] = (fcap, k)
+      adds[res_t] += fcap * k
+    hop_caps.append(per_et)
+    for t in ntypes:
+      frontier_cap[t] = adds[t]
+      node_caps[t] += adds[t]
+  return ntypes, hop_caps, node_caps
+
+
+def hetero_tree_layout(seed_caps: Dict[NodeType, int], etypes,
+                       num_neighbors, edge_dir: str = 'out'):
+  """(hop_node_offsets, hop_edge_offsets) of the hetero tree-mode
+  positional layout — the typed counterpart of ``tree_layout`` consumed
+  by the hierarchical (trim-per-layer) hetero model forward.
+
+  ``seed_caps`` must match the engine's seed buffer sizes: for
+  single-type seeds that is the loader's ``batch_size`` (its
+  ``batch_cap``); multi-type (link) seeds round up to 8.
+
+  Returns ``({ntype: (o_0, ..., o_H)}, {out_etype: (e_1, ..., e_H)})``
+  where ``o_h`` is the node-buffer prefix holding every node of depth
+  <= h and ``e_h`` the edge-buffer prefix holding hops 1..h; output edge
+  types are reversed from the stored etypes when ``edge_dir='out'``
+  (the engine emits message-flow orientation).
+  """
+  etypes = [tuple(et) for et in etypes]
+  fanouts_of = ((lambda et: list(num_neighbors[et]))
+                if isinstance(num_neighbors, dict)
+                else (lambda et: list(num_neighbors)))
+  ntypes, hop_caps, _ = hetero_capacity_plan(etypes, fanouts_of,
+                                             seed_caps, edge_dir)
+  node_offs = {t: [seed_caps.get(t, 0)] for t in ntypes}
+  out_ets = [reverse_edge_type(et) if edge_dir == 'out' else et
+             for et in etypes]
+  edge_tot = {et: 0 for et in out_ets}
+  edge_offs = {et: [] for et in out_ets}
+  for per_et in hop_caps:
+    adds = {t: 0 for t in ntypes}
+    seg = {et: 0 for et in out_ets}
+    for et, (fcap, k) in per_et.items():
+      res_t = et[2] if edge_dir == 'out' else et[0]
+      out_et = reverse_edge_type(et) if edge_dir == 'out' else et
+      adds[res_t] += fcap * k
+      seg[out_et] += fcap * k
+    for t in ntypes:
+      node_offs[t].append(node_offs[t][-1] + adds[t])
+    for et in out_ets:
+      edge_tot[et] += seg[et]
+      edge_offs[et].append(edge_tot[et])
+  return ({t: tuple(v) for t, v in node_offs.items()},
+          {et: tuple(v) for et, v in edge_offs.items()})
+
+
 @functools.lru_cache(maxsize=None)
 def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
                    num_graph_nodes, padded=False, block_num_edges=0):
@@ -566,34 +647,13 @@ class NeighborSampler(BaseSampler):
     padded, smask = padded_d[ntype], smask_d[ntype]
 
     etypes = list(self.graph.keys())
-    num_hops = max(len(self._etype_fanouts(et)) for et in etypes)
 
-    # Per-ntype inducer capacity: worst-case additions per hop (static).
-    ntypes = set()
-    for (u, _, v) in etypes:
-      ntypes.update((u, v))
-    frontier_cap = {t: caps_in.get(t, 0) for t in ntypes}
-    node_caps = dict(frontier_cap)
-    hop_caps = []  # per hop: dict et -> (src frontier cap, k)
-    for hop in range(num_hops):
-      adds: Dict[NodeType, int] = {t: 0 for t in ntypes}
-      per_et = {}
-      for et in etypes:
-        fo = self._etype_fanouts(et)
-        if hop >= len(fo):
-          continue
-        k = fo[hop]
-        key_t = et[0] if self.edge_dir == 'out' else et[2]
-        res_t = et[2] if self.edge_dir == 'out' else et[0]
-        fcap = frontier_cap.get(key_t, 0)
-        if fcap == 0 or k == 0:
-          continue
-        per_et[et] = (fcap, k)
-        adds[res_t] += fcap * k
-      hop_caps.append(per_et)
-      for t in ntypes:
-        frontier_cap[t] = adds[t]
-        node_caps[t] += adds[t]
+    # Static per-hop/per-ntype buffer plan — shared with
+    # hetero_tree_layout so the hierarchical model forward can never
+    # disagree with the engine's positional layout.
+    ntypes, hop_caps, node_caps = hetero_capacity_plan(
+        etypes, self._etype_fanouts, caps_in, self.edge_dir)
+    num_hops = len(hop_caps)
 
     states = {}
     frontier = {}
