@@ -1,0 +1,199 @@
+"""Distributed unsupervised GraphSAGE (link prediction over the mesh).
+
+Counterpart of
+/root/reference/examples/distributed/dist_sage_unsup/dist_sage_unsup.py:
+there, ranks own partitions, a DistLinkNeighborLoader streams link
+batches with binary negatives over RPC, and DDP trains SAGE with BCE on
+edge scores. Here the same pipeline is SPMD: the sharded
+DistLinkNeighborLoader emits per-shard link batches in one program, and
+a shard_map data-parallel step computes per-shard BCE on edge scores
+with jax.lax.pmean gradient sync (the DDP allreduce).
+
+Runs on any mesh: real TPU slice, or the virtual CPU mesh
+(--cpu-devices 4) for a laptop smoke test.
+
+Run: python examples/distributed/dist_sage_unsup.py --cpu-devices 4
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--epochs', type=int, default=2)
+  ap.add_argument('--num-nodes', type=int, default=20_000)
+  ap.add_argument('--avg-deg', type=int, default=12)
+  ap.add_argument('--batch-size', type=int, default=128)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
+  ap.add_argument('--hidden', type=int, default=128)
+  ap.add_argument('--lr', type=float, default=3e-3)
+  ap.add_argument('--num-partitions', type=int, default=None)
+  ap.add_argument('--cpu-devices', type=int, default=0,
+                  help='force a virtual CPU mesh of this size')
+  args = ap.parse_args()
+
+  import jax
+  if args.cpu_devices:
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', args.cpu_devices)
+  import jax.numpy as jnp
+  import optax
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import GraphSAGE
+  from graphlearn_tpu.sampler import NegativeSampling
+  from graphlearn_tpu.typing import GraphPartitionData
+
+  ctx = glt.distributed.init_worker_group(
+      num_partitions=args.num_partitions)
+  P = ctx.num_partitions
+  mesh = ctx.mesh
+  rng = np.random.default_rng(0)
+  n = args.num_nodes
+
+  # community graph: link structure is learnable (85% intra-community)
+  ncomm = 16
+  comm = rng.integers(0, ncomm, n).astype(np.int32)
+  e = n * args.avg_deg
+  rows = rng.integers(0, n, e).astype(np.int32)
+  intra = rng.random(e) < 0.85
+  cols = np.where(intra,
+                  (rows + ncomm * rng.integers(0, n // ncomm, e)) % n,
+                  rng.integers(0, n, e)).astype(np.int32)
+  feat = (comm[:, None] == np.arange(64) % ncomm).astype(np.float32) + \
+      0.3 * rng.standard_normal((n, 64)).astype(np.float32)
+
+  # 90/10 link split FIRST: test edges must not be in the
+  # message-passing graph, or eval scores leak the label (the sampler
+  # would aggregate dst into src's embedding through the very edge
+  # being predicted)
+  perm = rng.permutation(e)
+  tr_idx, te_idx = perm[: int(e * 0.9)], perm[int(e * 0.9):]
+  train_eli = np.stack([rows, cols])[:, tr_idx]
+  test_eli = np.stack([rows, cols])[:, te_idx]
+
+  node_pb = (np.arange(n) % P).astype(np.int32)
+  g_rows, g_cols = rows[tr_idx], cols[tr_idx]   # train edges only
+  epb = node_pb[g_rows]
+  parts, feats = [], []
+  for p in range(P):
+    m = epb == p
+    parts.append(GraphPartitionData(
+        edge_index=np.stack([g_rows[m], g_cols[m]]),
+        eids=np.nonzero(m)[0]))
+    ids = np.nonzero(node_pb == p)[0]
+    feats.append((ids.astype(np.int64), feat[ids]))
+  dg = glt.distributed.DistGraph(P, 0, parts, node_pb)
+  df = glt.distributed.DistFeature(P, feats, node_pb, mesh)
+  ds = glt.distributed.DistDataset(P, 0, dg, df)
+  loader = glt.distributed.DistLinkNeighborLoader(
+      ds, list(args.fanout), train_eli, batch_size=args.batch_size,
+      shuffle=True, neg_sampling=NegativeSampling('binary', 1), mesh=mesh,
+      seed=0)
+  test_loader = glt.distributed.DistLinkNeighborLoader(
+      ds, list(args.fanout), test_eli, batch_size=args.batch_size,
+      shuffle=False, neg_sampling=NegativeSampling('binary', 1),
+      mesh=mesh, seed=1)
+
+  model = GraphSAGE(hidden_dim=args.hidden, out_dim=args.hidden,
+                    num_layers=len(args.fanout))
+  first = next(iter(loader))
+  params = model.init(jax.random.PRNGKey(0), np.asarray(first.x)[0],
+                      np.asarray(first.edge_index)[0],
+                      np.asarray(first.edge_mask)[0])
+  tx = optax.adam(args.lr)
+  opt_state = tx.init(params)
+
+  from jax import shard_map
+  from jax.sharding import PartitionSpec as PS
+
+  def shard_scores(params, x, ei, em, eli, label):
+    h = model.apply(params, x, ei, em).astype(jnp.float32)
+    valid = (eli[0] >= 0) & (eli[1] >= 0)
+    s = (h[jnp.maximum(eli[0], 0)] * h[jnp.maximum(eli[1], 0)]).sum(-1)
+    return s, label.astype(jnp.float32), valid
+
+  def loss_fn(params, x, ei, em, eli, label):
+    s, lab, valid = shard_scores(params, x, ei, em, eli, label)
+    bce = optax.sigmoid_binary_cross_entropy(s, lab)
+    loss = jnp.where(valid, bce, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+    hit = ((s > 0) == (lab > 0.5)) & valid
+    return loss, hit.sum() / jnp.maximum(valid.sum(), 1)
+
+  def dp_step(params, opt_state, x, ei, em, eli, label):
+    (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, x[0], ei[0], em[0], eli[0], label[0])
+    grads = jax.lax.pmean(grads, 'g')      # the DDP allreduce
+    loss = jax.lax.pmean(loss, 'g')
+    acc = jax.lax.pmean(acc, 'g')
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss, acc
+
+  step = jax.jit(shard_map(
+      dp_step, mesh=mesh,
+      in_specs=(PS(), PS(), PS('g'), PS('g'), PS('g'), PS('g'), PS('g')),
+      out_specs=(PS(), PS(), PS(), PS()),
+      check_vma=False))
+
+  def eval_acc(params, x, ei, em, eli, label):
+    s, lab, valid = shard_scores(params, x[0], ei[0], em[0], eli[0],
+                                 label[0])
+    hit = ((s > 0) == (lab > 0.5)) & valid
+    return jax.lax.psum(hit.sum(), 'g'), jax.lax.psum(valid.sum(), 'g')
+
+  eval_step = jax.jit(shard_map(
+      eval_acc, mesh=mesh,
+      in_specs=(PS(), PS('g'), PS('g'), PS('g'), PS('g'), PS('g')),
+      out_specs=(PS(), PS()), check_vma=False))
+
+  def fields(batch):
+    return (batch.x, batch.edge_index, batch.edge_mask,
+            batch.metadata['edge_label_index'],
+            batch.metadata['edge_label'])
+
+  # On the virtual CPU mesh, keeping many multi-device programs in
+  # flight can deadlock XLA's in-process collective rendezvous (the
+  # sampler's all_to_all and the step's pmean contend for the same
+  # thread pool), so serialize steps there; real TPU collectives ride
+  # ICI and need no such barrier.
+  serialize = jax.devices()[0].platform == 'cpu'
+  losses, accs, epoch_times = [], [], []
+  for epoch in range(args.epochs):
+    t0 = time.perf_counter()
+    for batch in loader:
+      params, opt_state, loss, acc = step(params, opt_state,
+                                          *fields(batch))
+      losses.append(loss)
+      accs.append(acc)
+      if serialize:
+        jax.block_until_ready(loss)
+    jax.block_until_ready(params)
+    epoch_times.append(time.perf_counter() - t0)
+
+  hits = total = None
+  for batch in test_loader:
+    h, t = eval_step(params, *fields(batch))
+    hits = h if hits is None else hits + h
+    total = t if total is None else total + t
+    if serialize:                    # same rendezvous hazard as training
+      jax.block_until_ready(total)
+  jax.block_until_ready((hits, total))
+
+  print(json.dumps({
+      'model': 'dist-SAGE-unsup', 'mesh_size': P,
+      'first_loss': round(float(losses[0]), 4),
+      'final_loss': round(float(losses[-1]), 4),
+      'final_train_acc': round(float(accs[-1]), 4),
+      'test_link_acc': round(float(hits) / max(float(total), 1.0), 4),
+      'epoch_time_s': round(float(np.mean(epoch_times)), 3),
+  }), flush=True)
+
+
+if __name__ == '__main__':
+  main()

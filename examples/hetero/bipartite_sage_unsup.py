@@ -32,22 +32,23 @@ I2I = ('item', 'to', 'item')
 
 def make_taobao_like(n_user, n_item, n_groups, clicks_per_user, rng):
   ug = rng.integers(0, n_groups, n_user).astype(np.int32)
-  ig = rng.integers(0, n_groups, n_item).astype(np.int32)
-  items_by_g = [np.where(ig == g)[0].astype(np.int32)
-                for g in range(n_groups)]
+  # item groups round-robin: every group non-empty, pick is vectorized
+  ig = (np.arange(n_item) % n_groups).astype(np.int32)
+  order = np.argsort(ig, kind='stable').astype(np.int32)
+  counts = np.bincount(ig, minlength=n_groups)
+  offsets = np.zeros(n_groups + 1, np.int64)
+  np.cumsum(counts, out=offsets[1:])
   u = np.repeat(np.arange(n_user, dtype=np.int32), clicks_per_user)
   e = u.shape[0]
   intra = rng.random(e) < 0.85
   it = rng.integers(0, n_item, e).astype(np.int32)
   gsel = ug[u[intra]]
-  pick = (rng.random(intra.sum()) *
-          np.array([len(items_by_g[g]) for g in gsel])).astype(np.int64)
-  it[intra] = np.array([items_by_g[g][p]
-                        for g, p in zip(gsel, pick)], np.int32)
+  pick = (rng.random(intra.sum()) * counts[gsel]).astype(np.int64)
+  it[intra] = order[offsets[gsel] + pick]
   return np.stack([u, it])
 
 
-def item_cooccurrence(u2i, n_item, min_count, cap=200_000):
+def item_cooccurrence(u2i, min_count, cap=200_000):
   """item<->item pairs co-clicked by >= min_count users (reference builds
   comat = mat.T @ mat >= 3 via scipy; done sparsely here)."""
   from collections import Counter
@@ -90,7 +91,7 @@ def main():
   perm = rng.permutation(e)
   n_tr = int(e * 0.8)
   train_e, test_e = u2i[:, perm[:n_tr]], u2i[:, perm[n_tr:]]
-  i2i = item_cooccurrence(train_e, args.n_item, min_count=3)
+  i2i = item_cooccurrence(train_e, min_count=3)
 
   ds = glt.data.Dataset(edge_dir='out')
   edges = {U2I: train_e, I2U: train_e[::-1].copy(), I2I: i2i}

@@ -60,9 +60,14 @@ def main():
 
   fan = {et: [8, 4] for et in edges}
   n_tr = int(args.n_paper * 0.2)
-  loader = glt.loader.NeighborLoader(
-      ds, fan, ('paper', np.arange(n_tr)), batch_size=args.batch_size,
-      shuffle=True, drop_last=True, seed=0, dedup='tree')
+
+  def make_loader():
+    # fresh loader per variant: the shuffle RNG is stateful, so sharing
+    # one loader would feed the two variants different batch sequences
+    # and invalidate the convergence comparison
+    return glt.loader.NeighborLoader(
+        ds, fan, ('paper', np.arange(n_tr)), batch_size=args.batch_size,
+        shuffle=True, drop_last=True, seed=0, dedup='tree')
 
   model_etypes = tuple(rev(et) for et in edges)
   no, eo = glt.sampler.hetero_tree_layout(
@@ -82,6 +87,7 @@ def main():
 
   report = {'model': 'hierarchical-hetero-SAGE', 'n_paper': args.n_paper}
   for name, model in variants.items():
+    loader = make_loader()
     first = bdict(next(iter(loader)))
     params = model.init(jax.random.PRNGKey(0), first['x'], first['ei'],
                         first['em'])

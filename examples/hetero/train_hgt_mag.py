@@ -61,22 +61,27 @@ def make_mag_like(n_paper, n_author, n_inst, n_field, ncls, rng):
   wp = community_pick(order, offsets, counts, acomm[wa], rng)
   writes = np.stack([wa, wp])
 
-  # authors -> institutions (institutions lean to one community)
-  icomm = rng.integers(0, ncls, n_inst).astype(np.int32)
+  def comm_table(n_items):
+    """(order, offsets, counts) community lookup for n_items entities,
+    guaranteeing every community is non-empty (round-robin base)."""
+    c = (np.arange(n_items) % ncls).astype(np.int32)
+    order_ = np.argsort(c, kind='stable').astype(np.int32)
+    counts_ = np.bincount(c, minlength=ncls)
+    offsets_ = np.zeros(ncls + 1, np.int64)
+    np.cumsum(counts_, out=offsets_[1:])
+    return order_, offsets_, counts_
+
+  # authors -> institutions (institutions lean to one community);
+  # vectorized with the same community_pick pattern as cites
+  iorder, ioff, icnt = comm_table(n_inst)
   ia = np.arange(n_author, dtype=np.int32)
-  inst_by_comm = [np.where(icomm == c)[0] for c in range(ncls)]
-  ai = np.array([rng.choice(inst_by_comm[c]) if len(inst_by_comm[c])
-                 else rng.integers(0, n_inst) for c in acomm],
-                np.int32)
+  ai = community_pick(iorder, ioff, icnt, acomm, rng).astype(np.int32)
   affil = np.stack([ia, ai])
 
   # papers -> fields (fields lean to one community)
-  fcomm = rng.integers(0, ncls, n_field).astype(np.int32)
-  field_by_comm = [np.where(fcomm == c)[0] for c in range(ncls)]
+  forder, foff, fcnt = comm_table(n_field)
   tp = np.repeat(np.arange(n_paper, dtype=np.int32), 2)
-  tf = np.array([rng.choice(field_by_comm[c]) if len(field_by_comm[c])
-                 else rng.integers(0, n_field)
-                 for c in comm[tp]], np.int32)
+  tf = community_pick(forder, foff, fcnt, comm[tp], rng).astype(np.int32)
   topic = np.stack([tp, tf])
 
   f = 32
