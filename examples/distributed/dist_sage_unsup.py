@@ -57,9 +57,12 @@ def main():
   rng = np.random.default_rng(0)
   n = args.num_nodes
 
-  # community graph: link structure is learnable (85% intra-community)
+  # community graph: link structure is learnable (85% intra-community).
+  # Communities ARE the residue classes mod ncomm — the same classes the
+  # intra-edge construction below connects — so the one-hot-ish features
+  # genuinely correlate with linkage.
   ncomm = 16
-  comm = rng.integers(0, ncomm, n).astype(np.int32)
+  comm = (np.arange(n) % ncomm).astype(np.int32)
   e = n * args.avg_deg
   rows = rng.integers(0, n, e).astype(np.int32)
   intra = rng.random(e) < 0.85

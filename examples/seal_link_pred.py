@@ -88,9 +88,9 @@ def main():
   from graphlearn_tpu.models import GCNConv
 
   rng = np.random.default_rng(0)
-  # Cora-scale SBM: 8 communities, intra-heavy => links are predictable
+  # Cora-scale community graph (communities = residue classes mod 8,
+  # intra-heavy) => links are predictable from structure
   n = args.num_nodes
-  comm = rng.integers(0, 8, n)
   e = n * 6
   rows = rng.integers(0, n, e)
   intra = rng.random(e) < 0.85
@@ -228,9 +228,11 @@ def main():
     return model.apply(params, batch['z'], batch['ei'], batch['em'],
                        batch['nm'])
 
+  shuffle_rng = np.random.default_rng(1)   # advances across epochs
+
   def batches(data, shuffle):
     z, ei, em, nm, y = data
-    order = (np.random.default_rng(1).permutation(len(y)) if shuffle
+    order = (shuffle_rng.permutation(len(y)) if shuffle
              else np.arange(len(y)))
     for i in range(0, len(y) - args.batch_size + 1, args.batch_size):
       sel = order[i:i + args.batch_size]

@@ -50,6 +50,17 @@ class DistLoader:
   def _num_seeds(self):
     return self.input_seeds.shape[0]
 
+  def state_dict(self):
+    """Resumable iteration state (epoch-boundary granularity): the seed
+    shuffle stream + the SPMD sampler's PRNG state (delegated)."""
+    return {'rng_state': self._rng.bit_generator.state,
+            'sampler': self.sampler.state_dict()}
+
+  def load_state_dict(self, state):
+    self._rng.bit_generator.state = state['rng_state']
+    if 'sampler' in state:
+      self.sampler.load_state_dict(state['sampler'])
+
   def _index_blocks(self):
     """Yield ([P, B] seed-index blocks, validity mask or None) per step.
 

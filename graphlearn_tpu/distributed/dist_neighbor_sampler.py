@@ -241,6 +241,14 @@ class DistNeighborSampler:
     self._key, sub = jax.random.split(self._key)
     return jax.random.split(sub, self.graph.num_partitions)
 
+  def state_dict(self):
+    """Split-and-carry PRNG: the carried key is the whole state."""
+    return {'key': np.asarray(self._key).tolist()}
+
+  def load_state_dict(self, state):
+    import jax.numpy as jnp
+    self._key = jnp.asarray(np.asarray(state['key'], np.uint32))
+
   def _capacities(self, b: int):
     caps = [b]
     for k in self.num_neighbors:

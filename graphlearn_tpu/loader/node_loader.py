@@ -43,6 +43,17 @@ class SeedBatcher:
     rem = self.num_seeds - n_full * self.batch_size
     return n_full + (1 if rem and not self.drop_last else 0)
 
+  # -- checkpoint/resume (utils.checkpoint) --------------------------------
+  # The shuffle stream is the only mutable state: capturing the PRNG
+  # state and restoring it in a fresh batcher (same seed/sizes) replays
+  # the exact remaining permutation sequence — epoch-boundary resume.
+
+  def state_dict(self):
+    return {'rng_state': self._rng.bit_generator.state}
+
+  def load_state_dict(self, state):
+    self._rng.bit_generator.state = state['rng_state']
+
 
 class NodeLoader:
   """Sample-and-collate loader over seed nodes
@@ -69,6 +80,20 @@ class NodeLoader:
 
   def __len__(self):
     return len(self._batcher)
+
+  def state_dict(self):
+    """Resumable iteration state (epoch-boundary granularity): the seed
+    shuffle stream plus the sampler's PRNG state, so a restored run
+    replays the exact batches the uninterrupted run would have
+    produced."""
+    state = self._batcher.state_dict()
+    state['sampler'] = self.sampler.state_dict()
+    return state
+
+  def load_state_dict(self, state):
+    self._batcher.load_state_dict(state)
+    if 'sampler' in state:
+      self.sampler.load_state_dict(state['sampler'])
 
   def __iter__(self):
     from ..utils import step_annotation

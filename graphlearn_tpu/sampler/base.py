@@ -258,3 +258,16 @@ class BaseSampler:
 
   def subgraph(self, inputs: NodeSamplerInput, **kwargs):
     raise NotImplementedError
+
+  # -- checkpoint/resume (utils.checkpoint; loaders delegate here) ---------
+
+  def state_dict(self):
+    """PRNG/iteration state for checkpoint-resume. Default: stateless."""
+    return {}
+
+  def load_state_dict(self, state):
+    if state:
+      raise ValueError(
+          f'{type(self).__name__} has no state to restore, but the '
+          f'checkpoint carries sampler state {sorted(state)} — it was '
+          'written by a different sampler type; resuming would diverge')

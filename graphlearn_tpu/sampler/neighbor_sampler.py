@@ -354,6 +354,14 @@ class NeighborSampler(BaseSampler):
     self._call_count += 1
     return jax.random.fold_in(self._key, self._call_count)
 
+  def state_dict(self):
+    """The fold_in counter is the whole PRNG state (base key is derived
+    from the constructor seed, which the restoring loader re-supplies)."""
+    return {'call_count': int(self._call_count)}
+
+  def load_state_dict(self, state):
+    self._call_count = int(state['call_count'])
+
   def _get_graph(self, etype: Optional[EdgeType] = None) -> Graph:
     return self.graph[etype] if self.is_hetero else self.graph
 

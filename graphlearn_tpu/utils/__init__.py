@@ -1,3 +1,4 @@
+from .checkpoint import CheckpointManager
 from .common import count_dict, get_free_port, merge_dict
 from .device import (enable_compilation_cache, ensure_device,
                      get_available_device, global_device_put)

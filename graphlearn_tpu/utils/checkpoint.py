@@ -1,0 +1,118 @@
+"""Checkpoint / resume for training state + loader position (orbax).
+
+The reference has NO checkpointing (SURVEY.md §5: "Checkpoint / resume:
+none in the library ... TPU build: add orbax-style checkpoint for parity
+with modern expectations"). This module goes beyond the reference:
+
+- ``CheckpointManager.save(step, state, loader=..., extra=...)`` writes
+  the train-state pytree (params/opt_state/...) via orbax, plus a JSON
+  sidecar holding the loader's resumable iteration state
+  (``loader.state_dict()`` — the shuffle PRNG stream, epoch-boundary
+  granularity) and any user metadata.
+- ``restore(state_template, loader=...)`` loads the newest (or a given)
+  step back into arrays shaped like the template and replays the loader
+  position, so training continues with the exact permutation sequence it
+  would have seen.
+
+Works with any pytree state (models.train.TrainState, raw param dicts)
+and any loader exposing state_dict/load_state_dict (NodeLoader family,
+LinkLoader family, DistLoader family).
+"""
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _jsonify(obj):
+  """numpy scalars/arrays inside rng state dicts -> JSON-able."""
+  if isinstance(obj, dict):
+    return {k: _jsonify(v) for k, v in obj.items()}
+  if isinstance(obj, (list, tuple)):
+    return [_jsonify(v) for v in obj]
+  if isinstance(obj, np.ndarray):
+    return {'__ndarray__': obj.tolist(), 'dtype': str(obj.dtype)}
+  if isinstance(obj, np.generic):
+    return obj.item()
+  return obj
+
+
+def _dejsonify(obj):
+  if isinstance(obj, dict):
+    if '__ndarray__' in obj:
+      return np.asarray(obj['__ndarray__'], dtype=obj['dtype'])
+    return {k: _dejsonify(v) for k, v in obj.items()}
+  if isinstance(obj, list):
+    return [_dejsonify(v) for v in obj]
+  return obj
+
+
+class CheckpointManager:
+  """Step-indexed checkpoints under one directory.
+
+  Layout: ``{directory}/{step}/state`` (orbax pytree) +
+  ``{directory}/{step}/meta.json`` (loader state + extra metadata).
+  """
+
+  def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
+    self.directory = os.path.abspath(directory)
+    os.makedirs(self.directory, exist_ok=True)
+    self.max_to_keep = max_to_keep
+    import orbax.checkpoint as ocp
+    self._ckptr = ocp.StandardCheckpointer()
+
+  # -- save ----------------------------------------------------------------
+
+  def save(self, step: int, state: Any, loader=None, extra: Any = None):
+    """Write state (+ loader position + extra JSON metadata) at `step`."""
+    path = os.path.join(self.directory, str(int(step)))
+    self._ckptr.save(os.path.join(path, 'state'), state)
+    self._ckptr.wait_until_finished()
+    meta = {'step': int(step), 'extra': extra}
+    if loader is not None:
+      meta['loader'] = _jsonify(loader.state_dict())
+    with open(os.path.join(path, 'meta.json'), 'w') as f:
+      json.dump(meta, f)
+    self._gc()
+    return path
+
+  def _gc(self):
+    if self.max_to_keep is None:
+      return
+    steps = self.all_steps()
+    for s in steps[: max(0, len(steps) - self.max_to_keep)]:
+      import shutil
+      shutil.rmtree(os.path.join(self.directory, str(s)),
+                    ignore_errors=True)
+
+  # -- restore -------------------------------------------------------------
+
+  def all_steps(self):
+    steps = []
+    for name in os.listdir(self.directory):
+      full = os.path.join(self.directory, name, 'meta.json')
+      if name.isdigit() and os.path.exists(full):
+        steps.append(int(name))
+    return sorted(steps)
+
+  def latest_step(self) -> Optional[int]:
+    steps = self.all_steps()
+    return steps[-1] if steps else None
+
+  def restore(self, state_template: Any, step: Optional[int] = None,
+              loader=None):
+    """Load `step` (default: newest). Returns (state, extra); if
+    `loader` is given its iteration position is restored in place."""
+    if step is None:
+      step = self.latest_step()
+    if step is None:
+      raise FileNotFoundError(f'no checkpoints in {self.directory}')
+    path = os.path.join(self.directory, str(int(step)))
+    state = self._ckptr.restore(os.path.join(path, 'state'),
+                                state_template)
+    with open(os.path.join(path, 'meta.json')) as f:
+      meta = json.load(f)
+    if loader is not None and 'loader' in meta:
+      loader.load_state_dict(_dejsonify(meta['loader']))
+    return state, meta.get('extra')
