@@ -247,6 +247,10 @@ class DistNeighborSampler:
 
   def load_state_dict(self, state):
     import jax.numpy as jnp
+    if 'key' not in state:
+      raise ValueError(
+          f'checkpoint sampler state {sorted(state)} was written by a '
+          'different sampler type; resuming would diverge')
     self._key = jnp.asarray(np.asarray(state['key'], np.uint32))
 
   def _capacities(self, b: int):

@@ -69,10 +69,19 @@ def init_node_map(seeds: jax.Array, seed_mask: jax.Array, capacity: int,
   return MapInducerState(table, nodes, count), uniq, uniq_mask, inverse
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=('compact_frontier',))
 def induce_next_map(state: MapInducerState, src_idx: jax.Array,
-                    nbrs: jax.Array, nbr_mask: jax.Array):
-  """Absorb one hop (same contract as ops.induce.induce_next)."""
+                    nbrs: jax.Array, nbr_mask: jax.Array,
+                    compact_frontier: bool = True):
+  """Absorb one hop (same contract as ops.induce.induce_next).
+
+  ``compact_frontier=False`` emits the next-hop frontier POSITIONALLY
+  (mask = winner) instead of scatter-compacting it — saves two
+  S-element scatters per hop (~7 ms/batch at products scale, measured).
+  Only valid when the consumer keeps the frontier's full width (no
+  node_budget truncation): a truncating consumer must take the compact
+  form so the first `budget` entries are real winners.
+  """
   f, k = nbrs.shape
   size = f * k
   n_table = state.table.shape[0]
@@ -102,12 +111,17 @@ def induce_next_map(state: MapInducerState, src_idx: jax.Array,
   local = jnp.where(flat_mask, table[safe] - 1, -1)
   rows = jnp.where(flat_mask, jnp.repeat(src_idx.astype(jnp.int32), k), -1)
 
-  slot = jnp.where(winner, rank, size)
-  frontier = jnp.full((size,), FILL, flat.dtype).at[slot].set(flat,
-                                                              mode='drop')
-  frontier_idx = jnp.full((size,), -1, jnp.int32).at[slot].set(new_idx,
-                                                               mode='drop')
-  frontier_mask = jnp.arange(size) < num_new
+  if compact_frontier:
+    slot = jnp.where(winner, rank, size)
+    frontier = jnp.full((size,), FILL, flat.dtype).at[slot].set(
+        flat, mode='drop')
+    frontier_idx = jnp.full((size,), -1, jnp.int32).at[slot].set(
+        new_idx, mode='drop')
+    frontier_mask = jnp.arange(size) < num_new
+  else:
+    frontier = jnp.where(winner, flat, FILL)
+    frontier_idx = jnp.where(winner, new_idx, -1)
+    frontier_mask = winner
 
   out = dict(rows=rows, cols=local, edge_mask=flat_mask,
              frontier=frontier, frontier_idx=frontier_idx,

@@ -46,15 +46,17 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
           'the hetero engines use sort/tree modes; add an '
           'ops.init_empty_map before wiring map into a typed path')
 
-    return init, _no_empty_map, lambda st, fi, nb, m, off: \
-        ops.induce_next_map(st, fi, nb, m)
+    return init, _no_empty_map, \
+        lambda st, fi, nb, m, off, compact=True: \
+        ops.induce_next_map(st, fi, nb, m, compact_frontier=compact)
   if mode == 'sort':
-    return ops.init_node, ops.init_empty, lambda st, fi, nb, m, off: \
+    return ops.init_node, ops.init_empty, \
+        lambda st, fi, nb, m, off, compact=True: \
         ops.induce_next(st, fi, nb, m)
   assert mode == 'tree', f'unknown dedup mode {mode!r}'
   return ops.init_node_tree, ops.init_empty_tree, \
-      lambda st, fi, nb, m, off: ops.induce_next_tree(st, fi, nb, m,
-                                                      offset=off)
+      lambda st, fi, nb, m, off, compact=True: \
+      ops.induce_next_tree(st, fi, nb, m, offset=off)
 
 
 def capacity_plan(batch_cap: int, fanouts, node_budget=None):
@@ -220,7 +222,12 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
       else:
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
                                            fmask, k, keys[i])
-      state, out = induce_fn(state, fidx, nbrs, m, node_offs[i])
+      # the frontier feeds the next hop at caps[i+1] width; when nothing
+      # truncates it (no node_budget clamp) the map inducer can emit it
+      # positionally and skip two S-element compaction scatters
+      compact = (i + 1 < len(caps)) and caps[i + 1] < caps[i] * k
+      state, out = induce_fn(state, fidx, nbrs, m, node_offs[i],
+                             compact)
       # message direction: neighbor -> seed
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -360,6 +367,10 @@ class NeighborSampler(BaseSampler):
     return {'call_count': int(self._call_count)}
 
   def load_state_dict(self, state):
+    if 'call_count' not in state:
+      raise ValueError(
+          f'checkpoint sampler state {sorted(state)} was written by a '
+          'different sampler type; resuming would diverge')
     self._call_count = int(state['call_count'])
 
   def _get_graph(self, etype: Optional[EdgeType] = None) -> Graph:
@@ -559,7 +570,8 @@ class NeighborSampler(BaseSampler):
       else:
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
                                            fmask, k, keys[i])
-      state, out = induce_fn(state, fidx, nbrs, m, offset)
+      compact = caps[i + 1] < caps[i] * k   # see _fused_homo_fn note
+      state, out = induce_fn(state, fidx, nbrs, m, offset, compact)
       offset += caps[i] * k
       rows.append(out['cols'])
       cols.append(out['rows'])

@@ -5,21 +5,25 @@ none in the library ... TPU build: add orbax-style checkpoint for parity
 with modern expectations"). This module goes beyond the reference:
 
 - ``CheckpointManager.save(step, state, loader=..., extra=...)`` writes
-  the train-state pytree (params/opt_state/...) via orbax, plus a JSON
-  sidecar holding the loader's resumable iteration state
-  (``loader.state_dict()`` — the shuffle PRNG stream, epoch-boundary
-  granularity) and any user metadata.
+  the train-state pytree (params/opt_state/...) plus a JSON item holding
+  the loader's resumable iteration state (``loader.state_dict()`` — the
+  shuffle PRNG stream + sampler PRNG, epoch-boundary granularity) and
+  any user metadata.
 - ``restore(state_template, loader=...)`` loads the newest (or a given)
   step back into arrays shaped like the template and replays the loader
   position, so training continues with the exact permutation sequence it
   would have seen.
 
+Thin wrapper over ``orbax.checkpoint.CheckpointManager`` — step
+indexing, retention (``max_to_keep``), and ATOMIC per-step commits
+(tmp-dir + rename, so a crash mid-save can never leave a latest-looking
+but unrestorable step) are orbax's; this adds only the loader-state JSON
+item and numpy-safe serialization.
+
 Works with any pytree state (models.train.TrainState, raw param dicts)
 and any loader exposing state_dict/load_state_dict (NodeLoader family,
 LinkLoader family, DistLoader family).
 """
-import json
-import os
 from typing import Any, Optional
 
 import numpy as np
@@ -49,56 +53,32 @@ def _dejsonify(obj):
 
 
 class CheckpointManager:
-  """Step-indexed checkpoints under one directory.
-
-  Layout: ``{directory}/{step}/state`` (orbax pytree) +
-  ``{directory}/{step}/meta.json`` (loader state + extra metadata).
-  """
+  """Step-indexed checkpoints under one directory (orbax-backed)."""
 
   def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
-    self.directory = os.path.abspath(directory)
-    os.makedirs(self.directory, exist_ok=True)
-    self.max_to_keep = max_to_keep
+    import os
     import orbax.checkpoint as ocp
-    self._ckptr = ocp.StandardCheckpointer()
-
-  # -- save ----------------------------------------------------------------
+    self.directory = os.path.abspath(directory)
+    self._mgr = ocp.CheckpointManager(
+        self.directory,
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+    self._args = ocp.args
 
   def save(self, step: int, state: Any, loader=None, extra: Any = None):
     """Write state (+ loader position + extra JSON metadata) at `step`."""
-    path = os.path.join(self.directory, str(int(step)))
-    self._ckptr.save(os.path.join(path, 'state'), state)
-    self._ckptr.wait_until_finished()
-    meta = {'step': int(step), 'extra': extra}
+    meta = {'step': int(step), 'extra': _jsonify(extra)}
     if loader is not None:
       meta['loader'] = _jsonify(loader.state_dict())
-    with open(os.path.join(path, 'meta.json'), 'w') as f:
-      json.dump(meta, f)
-    self._gc()
-    return path
-
-  def _gc(self):
-    if self.max_to_keep is None:
-      return
-    steps = self.all_steps()
-    for s in steps[: max(0, len(steps) - self.max_to_keep)]:
-      import shutil
-      shutil.rmtree(os.path.join(self.directory, str(s)),
-                    ignore_errors=True)
-
-  # -- restore -------------------------------------------------------------
+    self._mgr.save(int(step), args=self._args.Composite(
+        state=self._args.StandardSave(state),
+        meta=self._args.JsonSave(meta)))
+    self._mgr.wait_until_finished()
 
   def all_steps(self):
-    steps = []
-    for name in os.listdir(self.directory):
-      full = os.path.join(self.directory, name, 'meta.json')
-      if name.isdigit() and os.path.exists(full):
-        steps.append(int(name))
-    return sorted(steps)
+    return sorted(self._mgr.all_steps())
 
   def latest_step(self) -> Optional[int]:
-    steps = self.all_steps()
-    return steps[-1] if steps else None
+    return self._mgr.latest_step()
 
   def restore(self, state_template: Any, step: Optional[int] = None,
               loader=None):
@@ -108,11 +88,13 @@ class CheckpointManager:
       step = self.latest_step()
     if step is None:
       raise FileNotFoundError(f'no checkpoints in {self.directory}')
-    path = os.path.join(self.directory, str(int(step)))
-    state = self._ckptr.restore(os.path.join(path, 'state'),
-                                state_template)
-    with open(os.path.join(path, 'meta.json')) as f:
-      meta = json.load(f)
+    out = self._mgr.restore(int(step), args=self._args.Composite(
+        state=self._args.StandardRestore(state_template),
+        meta=self._args.JsonRestore()))
+    meta = out['meta']
     if loader is not None and 'loader' in meta:
       loader.load_state_dict(_dejsonify(meta['loader']))
-    return state, meta.get('extra')
+    return out['state'], _dejsonify(meta.get('extra'))
+
+  def close(self):
+    self._mgr.close()
