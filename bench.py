@@ -122,11 +122,16 @@ def _run_e2e(ds, train_idx, dtype, jax, trace_dir):
 
   loader = glt.loader.NeighborLoader(
       ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
-      drop_last=True, seed=0, dedup='tree', strategy='block')
+      drop_last=True, seed=0, dedup='tree', strategy='block',
+      seed_labels_only=True)
   no, eo = train_lib.tree_hop_offsets(BATCH, FANOUT)
+  # tree_dense: contiguous child blocks -> reshape aggregation (no
+  # gathers/segment scatters); exact for un-budgeted tree batches and
+  # 2.8x on the fwd/bwd (PERF.md)
   model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
                     num_layers=len(FANOUT), hop_node_offsets=no,
-                    hop_edge_offsets=eo, dtype=dtype)
+                    hop_edge_offsets=eo, dtype=dtype, tree_dense=True,
+                    fanouts=tuple(FANOUT))
   it = iter(loader)
   first = train_lib.batch_to_dict(next(it))
   state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),

@@ -154,12 +154,17 @@ def main():
   mdtype = jnp.bfloat16 if args.bf16_model else None
   if args.dedup == 'tree':
     # layered forward: each conv only processes the tree depths it
-    # needs — 2.4x device speedup on the train step (PERF.md)
+    # needs — 2.4x device speedup on the train step; without a
+    # node_budget the dense-tree aggregation (reshape over contiguous
+    # child blocks, no gathers/scatters) adds another 2.8x on fwd/bwd
+    # (PERF.md). Both are numerically exact.
     no, eo = train_lib.tree_hop_offsets(args.batch_size, args.fanout,
                                         args.node_budget)
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
                       num_layers=depth, hop_node_offsets=no,
-                      hop_edge_offsets=eo, dtype=mdtype)
+                      hop_edge_offsets=eo, dtype=mdtype,
+                      tree_dense=args.node_budget is None,
+                      fanouts=tuple(args.fanout))
   else:
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
                       num_layers=depth, dtype=mdtype)

@@ -21,7 +21,8 @@ class NeighborLoader(NodeLoader):
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
                node_budget: Optional[int] = None, dedup: str = 'auto',
-               padded_window: Optional[int] = None):
+               padded_window: Optional[int] = None,
+               seed_labels_only: bool = False):
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
@@ -29,4 +30,4 @@ class NeighborLoader(NodeLoader):
         padded_window=padded_window)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, with_edge, collect_features, to_device,
-                     seed)
+                     seed, seed_labels_only=seed_labels_only)

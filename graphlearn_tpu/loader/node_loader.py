@@ -59,13 +59,19 @@ class NodeLoader:
   """Sample-and-collate loader over seed nodes
   (reference: loader/node_loader.py:27-113)."""
 
+  seed_labels_only = False   # subclasses that skip __init__ inherit this
+
   def __init__(self, data: Dataset, node_sampler: BaseSampler,
                input_nodes, batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, to_device=None,
-               seed: Optional[int] = None):
+               seed: Optional[int] = None,
+               seed_labels_only: bool = False):
     self.data = data
     self.sampler = node_sampler
+    # seed_labels_only: gather y for the seed block only (supervision
+    # uses seed slots; skips a full-capacity random gather — PERF.md)
+    self.seed_labels_only = seed_labels_only
     if isinstance(input_nodes, tuple):
       self.input_type, self.input_seeds = input_nodes
     else:
@@ -144,8 +150,15 @@ class NodeLoader:
         y = {}
         for t, buf in out.node.items():
           labels = self._label_table(t)
-          if labels is not None:
-            y[t] = ops.gather_rows(labels, None, buf)
+          if labels is None:
+            continue
+          if self.seed_labels_only:
+            # supervision reads seed slots only, and seeds lead the
+            # INPUT type's buffer; other types carry no seed block
+            if t != out.input_type:
+              continue
+            buf = buf[:self.batch_size]
+          y[t] = ops.gather_rows(labels, None, buf)
       return to_hetero_data(out, x, y)
 
     feats = id2i = None
@@ -160,7 +173,9 @@ class NodeLoader:
         efeats = edt[0]
     res = ops.collate_batch(out.node, out.num_nodes, out.row, out.col,
                             feats, id2i, self._label_table(), efeats,
-                            out.edge)
+                            out.edge,
+                            label_cap=(self.batch_size
+                                       if self.seed_labels_only else None))
     x = res['x']
     if x is None and self.collect_features and \
         self.data.node_features is not None:

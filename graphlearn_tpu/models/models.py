@@ -9,12 +9,73 @@ type embeddings (sum across relations), mirroring rgnn.py's HeteroConv use.
 from typing import Any, Dict, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..typing import EdgeType, NodeType
 from .conv import GATConv, GCNConv, SAGEConv
 
 _CONVS = {'sage': SAGEConv, 'gcn': GCNConv, 'gat': GATConv}
+
+
+class TreeSAGEConv(nn.Module):
+  """SAGEConv over tree-positional batches, aggregation as DENSE reshape.
+
+  In ``dedup='tree'`` layout the children of the node at slot ``s`` of
+  depth block ``d`` occupy the CONTIGUOUS slots ``[o_d + s*k_d,
+  o_d + (s+1)*k_d)`` of block ``d+1`` — so mean aggregation needs no
+  edge gather and no segment scatter at all: reshape each child block to
+  ``[parents, k, F]`` and take a masked mean over axis 1. Both ops (and
+  their gradients) are dense — the TPU-shaped replacement for the
+  scatter-add path, valid ONLY for un-truncated tree batches (no
+  node_budget).
+
+  Parameter names match ``SAGEConv`` (``lin_self``/``lin_nbr``) so the
+  two are checkpoint-interchangeable.
+  """
+  out_dim: int
+  node_offsets: Any    # (o_0..o_H) tree block offsets covering the input
+  fanouts: Any = None  # true per-depth fanouts; guards against truncation
+  use_bias: bool = True
+  dtype: Any = None
+
+  @nn.compact
+  def __call__(self, x, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
+    no = tuple(self.node_offsets)
+    assert no[-1] == x.shape[0], (no, x.shape)
+    blocks = (no[0],) + tuple(no[i + 1] - no[i] for i in range(len(no) - 1))
+    # a truncated (node_budget) layout can accidentally satisfy any
+    # divisibility check (e.g. equal consecutive blocks), so the guard
+    # must compare against the REAL fanouts: un-truncated means
+    # block[d+1] == block[d] * fanouts[d] exactly
+    assert self.fanouts is not None and         len(self.fanouts) >= len(blocks) - 1, (
+        'TreeSAGEConv requires the true fanouts to validate the layout')
+    eo = [0]
+    for d in range(len(blocks) - 1):
+      assert blocks[d + 1] == blocks[d] * self.fanouts[d], (
+          'dense-tree aggregation requires un-truncated tree blocks '
+          f'(block {d + 1} = {blocks[d + 1]} != parent block '
+          f'{blocks[d]} * fanout {self.fanouts[d]}); node_budget '
+          'batches must use the segment-op path')
+      eo.append(eo[-1] + blocks[d + 1])
+    aggs = []
+    for d in range(len(blocks) - 1):   # target block d <- child block d+1
+      b, k = blocks[d], self.fanouts[d]
+      ch = jax.lax.dynamic_slice_in_dim(x, no[d], blocks[d + 1]
+                                        ).reshape(b, k, x.shape[-1])
+      m = edge_mask[eo[d]:eo[d + 1]].reshape(b, k)
+      s = jnp.where(m[..., None], ch, jnp.zeros((), ch.dtype)).sum(1)
+      inv = (1.0 / jnp.maximum(m.sum(1), 1)).astype(ch.dtype)
+      aggs.append(s * inv[:, None])
+    # deepest block has no children in this slice: aggregate = 0
+    aggs.append(jnp.zeros((blocks[-1], x.shape[-1]), x.dtype))
+    agg = jnp.concatenate(aggs)
+    h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
+                 name='lin_self')(x)
+    return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
+                        name='lin_nbr')(agg)
 
 
 class GraphSAGE(nn.Module):
@@ -38,10 +99,22 @@ class GraphSAGE(nn.Module):
   hop_node_offsets: Any = None
   hop_edge_offsets: Any = None
   dtype: Any = None
+  # tree_dense: aggregate via TreeSAGEConv's reshape path (no gathers or
+  # segment scatters; requires un-truncated tree batches + aggr='mean'
+  # + the true `fanouts`, which guard against node_budget truncation)
+  tree_dense: bool = False
+  fanouts: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask, train: bool = False):
     layered = self.hop_node_offsets is not None
+    if self.tree_dense:
+      assert layered, 'tree_dense requires hop_node/edge_offsets'
+      assert self.aggr == 'mean', 'tree_dense implements mean aggregation'
+      assert self.fanouts is not None, (
+          'tree_dense requires fanouts=... (the loader fanouts) so a '
+          'node_budget-truncated layout cannot slip through the layout '
+          'check')
     if layered:
       assert len(self.hop_node_offsets) >= self.num_layers + 1 and \
           len(self.hop_edge_offsets) >= self.num_layers
@@ -59,9 +132,16 @@ class GraphSAGE(nn.Module):
         hops_used = self.num_layers - i
         n_in = self.hop_node_offsets[hops_used]
         e_used = self.hop_edge_offsets[hops_used - 1]
-        x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,
-                     name=f'conv{i}')(
-            x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
+        if self.tree_dense:
+          x = TreeSAGEConv(
+              dim, node_offsets=tuple(self.hop_node_offsets[:hops_used + 1]),
+              fanouts=tuple(self.fanouts[:hops_used]),
+              dtype=self.dtype, name=f'conv{i}')(
+              x[:n_in], edge_mask[:e_used])
+        else:
+          x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,
+                       name=f'conv{i}')(
+              x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
       else:
         x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,
                      name=f'conv{i}')(x, edge_index, edge_mask)

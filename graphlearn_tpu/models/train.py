@@ -38,7 +38,11 @@ def make_train_step(model, tx, num_classes: int):
     logits = model.apply(params, batch['x'], batch['edge_index'],
                          batch['edge_mask'])
     logits = logits.astype(jnp.float32)  # loss in f32 under bf16 compute
-    n = logits.shape[0]            # layered models emit a seed-side prefix
+    # seed slots lead both buffers; y may be seed-block-sized
+    # (seed_labels_only loaders) or full-buffer-sized — either way only
+    # the common prefix carries supervision
+    n = min(logits.shape[0], batch['y'].shape[0])
+    logits = logits[:n]
     y = batch['y'][:n]
     seed_mask = jnp.arange(n) < batch['num_seed_nodes']
     labels = jax.nn.one_hot(y, num_classes)
@@ -74,9 +78,10 @@ def make_eval_counts(model):
   def eval_counts(params, batch):
     logits = model.apply(params, batch['x'], batch['edge_index'],
                          batch['edge_mask'])
-    n = logits.shape[0]            # layered models emit a seed-side prefix
+    # common prefix (see make_train_step loss_fn)
+    n = min(logits.shape[0], batch['y'].shape[0])
     seed_mask = jnp.arange(n) < batch['num_seed_nodes']
-    correct = (logits.argmax(-1) == batch['y'][:n]) & seed_mask
+    correct = (logits[:n].argmax(-1) == batch['y'][:n]) & seed_mask
     return correct.sum(), seed_mask.sum()
 
   return eval_counts

@@ -9,13 +9,15 @@ on remote-dispatch runtimes (PERF.md), so the loader may not touch the
 sampler's outputs eagerly. All arrays enter as arguments (never closures),
 and optional stores are trace-time ``None`` branches.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=('label_cap',))
 def collate_batch(node, num_nodes, row, col, feats, id2index, labels,
-                  edge_feats, edge):
+                  edge_feats, edge, label_cap=None):
   """Build the derived batch payloads on device.
 
   Args:
@@ -27,6 +29,11 @@ def collate_batch(node, num_nodes, row, col, feats, id2index, labels,
     labels: [N] device label table (or None).
     edge_feats: [E, F_e] device edge-feature table (or None).
     edge: [cap_e] global edge ids (needed when edge_feats given).
+    label_cap: static; gather labels only for the first ``label_cap``
+      node slots (the seed block leads the buffer, and supervision uses
+      seed slots only — a full-buffer label gather is a per-element
+      random access over the whole node capacity, ~5 ms/batch at
+      products scale). None = full buffer (reference-parity y shape).
 
   Returns dict with node_mask, edge_index (or None), x, y, edge_attr —
   padded slots gather row/label 0 (masked downstream by node_mask).
@@ -40,7 +47,8 @@ def collate_batch(node, num_nodes, row, col, feats, id2index, labels,
     out['x'] = feats[fidx]
   else:
     out['x'] = None
-  out['y'] = labels[safe] if labels is not None else None
+  lsafe = safe if label_cap is None else safe[:label_cap]
+  out['y'] = labels[lsafe] if labels is not None else None
   if edge_feats is not None and edge is not None:
     out['edge_attr'] = edge_feats[jnp.maximum(edge, 0)]
   else:

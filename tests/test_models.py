@@ -298,3 +298,57 @@ def test_hierarchical_rgnn_matches_full():
   out_hier = np.asarray(hier.apply(params, b.x, b.edge_index, b.edge_mask))
   nseed = int(b.num_sampled_nodes['paper'][0])
   np.testing.assert_allclose(out_full[:nseed], out_hier[:nseed], rtol=1e-5)
+
+
+def test_tree_dense_matches_segment():
+  """GraphSAGE(tree_dense=True) — dense reshape aggregation over tree
+  blocks — is numerically identical to the segment-op layered forward
+  (same params, same batches), and trains."""
+  import jax
+  from graphlearn_tpu.models import train as train_lib
+  rng = np.random.default_rng(0)
+  n = 300
+  rows = rng.integers(0, n, 3000)
+  cols = rng.integers(0, n, 3000)
+  keep = rows != n - 1                 # isolated node: zero-child parents
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows[keep], cols[keep]]), num_nodes=n,
+                graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 12)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, 4, n))
+  loader = glt.loader.NeighborLoader(
+      ds, [4, 3], np.array([n - 1] + list(range(15))), batch_size=16,
+      seed=0, dedup='tree')
+  b = train_lib.batch_to_dict(next(iter(loader)))
+  no, eo = train_lib.tree_hop_offsets(16, [4, 3])
+  seg = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2,
+                             hop_node_offsets=no, hop_edge_offsets=eo)
+  dense = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2,
+                               hop_node_offsets=no, hop_edge_offsets=eo,
+                               tree_dense=True, fanouts=(4, 3))
+  params = seg.init(jax.random.PRNGKey(0), b['x'], b['edge_index'],
+                    b['edge_mask'])
+  o_seg = np.asarray(seg.apply(params, b['x'], b['edge_index'],
+                               b['edge_mask']))
+  # params are interchangeable by construction (same names)
+  o_dense = np.asarray(dense.apply(params, b['x'], b['edge_index'],
+                                   b['edge_mask']))
+  np.testing.assert_allclose(o_seg, o_dense, rtol=2e-5, atol=2e-5)
+  # trains end to end
+  state, tx = train_lib.create_train_state(dense, jax.random.PRNGKey(0), b)
+  step, _ = train_lib.make_train_step(dense, tx, 4)
+  state, loss, acc = step(state, b)
+  assert np.isfinite(float(loss))
+  # node_budget (truncated blocks) must be rejected loudly
+  no_b, eo_b = train_lib.tree_hop_offsets(16, [4, 3], node_budget=32)
+  bad = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2,
+                             hop_node_offsets=no_b, hop_edge_offsets=eo_b,
+                             tree_dense=True, fanouts=(4, 3))
+  loader_b = glt.loader.NeighborLoader(
+      ds, [4, 3], np.arange(16), batch_size=16, seed=0, dedup='tree',
+      node_budget=32)
+  bb = train_lib.batch_to_dict(next(iter(loader_b)))
+  import pytest
+  with pytest.raises(AssertionError, match='un-truncated'):
+    bad.init(jax.random.PRNGKey(0), bb['x'], bb['edge_index'],
+             bb['edge_mask'])
