@@ -90,9 +90,17 @@ def main():
 
   loader = glt.distributed.DistNeighborLoader(
       ds, list(args.fanout), np.arange(n), batch_size=args.batch_size,
-      shuffle=True, drop_last=True, seed=0, mesh=mesh)
+      shuffle=True, drop_last=True, seed=0, mesh=mesh, dedup='tree')
 
-  model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls, num_layers=2)
+  # the sharded engine emits the SAME positional tree layout as the
+  # local sampler, so each shard's forward can use the layered +
+  # dense-tree aggregation (no gathers/segment scatters — PERF.md)
+  from graphlearn_tpu.models import train as train_lib
+  no, eo = train_lib.tree_hop_offsets(args.batch_size, args.fanout)
+  model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
+                    num_layers=len(args.fanout), hop_node_offsets=no,
+                    hop_edge_offsets=eo, tree_dense=True,
+                    fanouts=tuple(args.fanout))
   first = next(iter(loader))
   params = model.init(jax.random.PRNGKey(0),
                       np.asarray(first.x)[0], np.asarray(first.edge_index)[0],
@@ -105,7 +113,9 @@ def main():
 
   def loss_fn(params, x, ei, em, y, nseed):
     logits = model.apply(params, x, ei, em)
-    seed_mask = jnp.arange(logits.shape[0]) < nseed
+    n = min(logits.shape[0], y.shape[0])   # layered seed-side prefix
+    logits, y = logits[:n], y[:n]
+    seed_mask = jnp.arange(n) < nseed
     ce = optax.softmax_cross_entropy(logits, jax.nn.one_hot(y, ncls))
     loss = jnp.where(seed_mask, ce, 0.0).sum() / jnp.maximum(
         seed_mask.sum(), 1)

@@ -664,3 +664,80 @@ def test_dist_hetero_loader_end_to_end():
         glt.typing.reverse_edge_type(et1),
         glt.typing.reverse_edge_type(et2)}
   assert steps == len(loader) == N // (num_parts * 4)
+
+
+def test_dist_tree_batches_support_dense_model():
+  """The sharded engine's tree layout equals the local tree layout
+  (same capacity plan, positional inducer, order-preserving exchange),
+  so the dense-tree GraphSAGE forward is numerically identical to the
+  segment-op forward on every shard of a dist tree batch."""
+  import jax
+  from graphlearn_tpu.models import train as train_lib
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  df = glt.distributed.DistFeature(num_parts, feats, node_pb, mesh)
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df)
+  loader = glt.distributed.DistNeighborLoader(
+      ds, [2, 2], np.arange(N), batch_size=4, shuffle=False, seed=0,
+      mesh=mesh, dedup='tree')
+  batch = next(iter(loader))
+  no, eo = train_lib.tree_hop_offsets(4, [2, 2])
+  seg = glt.models.GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2,
+                             hop_node_offsets=no, hop_edge_offsets=eo)
+  dense = glt.models.GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2,
+                               hop_node_offsets=no, hop_edge_offsets=eo,
+                               tree_dense=True, fanouts=(2, 2))
+  x = np.asarray(batch.x)
+  ei = np.asarray(batch.edge_index)
+  em = np.asarray(batch.edge_mask)
+  params = seg.init(jax.random.PRNGKey(0), x[0], ei[0], em[0])
+  for p in range(num_parts):
+    o_seg = np.asarray(seg.apply(params, x[p], ei[p], em[p]))
+    o_dense = np.asarray(dense.apply(params, x[p], ei[p], em[p]))
+    nseed = int(np.asarray(batch.num_sampled_nodes)[p, 0])
+    np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dist_hetero_tree_batches_support_hierarchical_model():
+  """The typed sharded engine's tree layout equals hetero_tree_layout
+  (same capacity plan), so the hierarchical RGNN forward matches the
+  full forward on every shard of a dist hetero tree batch."""
+  import jax
+  num_parts = 2
+  parts, feats, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  df = {t: glt.distributed.DistFeature(num_parts, feats[t], node_pb[t],
+                                       mesh) for t in ('u', 'v')}
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df)
+  fan = {et1: [2, 2], et2: [1, 1]}
+  loader = glt.distributed.DistNeighborLoader(
+      ds, fan, ('u', np.arange(N)), batch_size=4, shuffle=False, seed=0,
+      mesh=mesh, dedup='tree')
+  batch = next(iter(loader))
+  no, eo = glt.sampler.hetero_tree_layout({'u': 4}, (et1, et2), fan)
+  for t, v in batch.x.items():
+    assert no[t][-1] == np.asarray(v).shape[1], (t, no[t])
+  etypes = (glt.typing.reverse_edge_type(et1),
+            glt.typing.reverse_edge_type(et2))
+  full = glt.models.RGNN(etypes=etypes, hidden_dim=8, out_dim=3,
+                         num_layers=2, out_ntype='u')
+  hier = glt.models.RGNN(etypes=etypes, hidden_dim=8, out_dim=3,
+                         num_layers=2, out_ntype='u',
+                         hop_node_offsets=no, hop_edge_offsets=eo)
+  def shard(d, p):
+    return {k: np.asarray(v)[p] for k, v in d.items()}
+  params = None
+  for p in range(num_parts):
+    x, ei, em = shard(batch.x, p), shard(batch.edge_index, p), \
+        shard(batch.edge_mask, p)
+    if params is None:
+      params = full.init(jax.random.PRNGKey(0), x, ei, em)
+    nseed = int(np.asarray(batch.num_sampled_nodes['u'])[p, 0])
+    o_full = np.asarray(full.apply(params, x, ei, em))
+    o_hier = np.asarray(hier.apply(params, x, ei, em))
+    np.testing.assert_allclose(o_full[:nseed], o_hier[:nseed],
+                               rtol=2e-5, atol=2e-5)
