@@ -1,0 +1,95 @@
+"""Train from tabular sources (the reference's PAI/ODPS workflow).
+
+Counterpart of /root/reference/examples/pai/ (training GLT models from
+MaxCompute tables via TableDataset): the reference reads edge/node
+tables with threaded `common_io` readers; here `data.TableDataset` reads
+local .npy/.npz/.csv tables with the same threaded multi-table scheme
+(odps:// URLs are accepted when the common_io package exists). This
+example writes a small tabular dataset to disk, ingests it through
+TableDataset, and trains GraphSAGE — the full table -> graph -> batches
+-> model path.
+
+Run: python examples/train_from_tables.py
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.models import GraphSAGE, train as train_lib
+
+
+def write_tables(root, rng, n=20_000, shards=3):
+  """Edge tables (one .npy [2, E] per shard — e.g. one per upstream
+  partition) + node tables (.npz with ids/feats/labels)."""
+  ncls = 8
+  comm = (np.arange(n) % ncls).astype(np.int64)
+  e = n * 10
+  rows = rng.integers(0, n, e)
+  intra = rng.random(e) < 0.85
+  cols = np.where(intra, (rows + ncls * rng.integers(0, n // ncls, e)) % n,
+                  rng.integers(0, n, e))
+  edge_tables = []
+  for s in range(shards):
+    path = os.path.join(root, f'edges_{s}.npy')
+    np.save(path, np.stack([rows[s::shards], cols[s::shards]]))
+    edge_tables.append(path)
+  feats = (comm[:, None] == np.arange(32) % ncls) * 1.0 + \
+      0.5 * rng.standard_normal((n, 32))
+  node_tables = []
+  for s in range(shards):
+    ids = np.arange(s, n, shards)
+    path = os.path.join(root, f'nodes_{s}.npz')
+    np.savez(path, ids=ids, feats=feats[ids].astype(np.float32),
+             labels=comm[ids])
+    node_tables.append(path)
+  return edge_tables, node_tables, n, ncls
+
+
+def main():
+  import jax
+  glt.utils.enable_compilation_cache()
+  rng = np.random.default_rng(0)
+  with tempfile.TemporaryDirectory() as root:
+    t0 = time.time()
+    edge_tables, node_tables, n, ncls = write_tables(root, rng)
+    ds = glt.data.TableDataset(edge_tables=edge_tables,
+                               node_tables=node_tables,
+                               graph_mode='HBM', num_threads=4)
+    load_s = time.time() - t0
+
+  loader = glt.loader.NeighborLoader(
+      ds, [10, 5], np.arange(int(n * 0.5)), batch_size=256, shuffle=True,
+      drop_last=True, seed=0, dedup='tree')
+  no, eo = train_lib.tree_hop_offsets(256, [10, 5])
+  model = GraphSAGE(hidden_dim=64, out_dim=ncls, num_layers=2,
+                    hop_node_offsets=no, hop_edge_offsets=eo,
+                    tree_dense=True, fanouts=(10, 5))
+  first = train_lib.batch_to_dict(next(iter(loader)))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  step, _ = train_lib.make_train_step(model, tx, ncls)
+  losses, accs = [], []
+  for _ in range(2):
+    for b in loader:
+      state, loss, acc = step(state, train_lib.batch_to_dict(b))
+      losses.append(loss)
+      accs.append(acc)
+
+  print(json.dumps({
+      'source': f'{len(edge_tables)} edge + {len(node_tables)} node tables',
+      'num_nodes': n, 'table_load_s': round(load_s, 2),
+      'first_loss': round(float(losses[0]), 4),
+      'final_loss': round(float(losses[-1]), 4),
+      'final_train_acc': round(float(accs[-1]), 4),
+  }), flush=True)
+
+
+if __name__ == '__main__':
+  main()
