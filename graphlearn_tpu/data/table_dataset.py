@@ -9,7 +9,6 @@ same multi-reader ingestion shape is provided for local columnar files
 (.npy/.npz/.csv), which is the portable equivalent.
 """
 import os
-import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -59,18 +58,6 @@ class TableDataset(Dataset):
             device, num_threads):
     edge_parts: List[Optional[np.ndarray]] = [None] * len(edge_tables)
     node_parts: List[Optional[dict]] = [None] * len(node_tables)
-    errors: List[BaseException] = []
-
-    def _guard(fn):
-      # reader-thread exceptions must surface to the caller — a
-      # swallowed one would resurface later as a confusing NoneType
-      # error when the part is concatenated
-      def run(*args):
-        try:
-          fn(*args)
-        except BaseException as e:  # noqa: BLE001 - re-raised below
-          errors.append(e)
-      return run
 
     def read_edge(i, path):
       arr = np.asarray(_load_table(path))
@@ -80,28 +67,21 @@ class TableDataset(Dataset):
 
     def read_node(i, path):
       z = _load_table(path)
-      assert isinstance(z, dict) and 'ids' in z and 'feats' in z, \
-          f'node table {path!r} needs ids + feats arrays'
+      if not (isinstance(z, dict) and 'ids' in z and 'feats' in z):
+        raise ValueError(f'node table {path!r} needs ids + feats arrays')
       node_parts[i] = z
 
-    threads = []
-    for i, p in enumerate(edge_tables):
-      threads.append(threading.Thread(target=_guard(read_edge),
-                                      args=(i, p)))
-    for i, p in enumerate(node_tables):
-      threads.append(threading.Thread(target=_guard(read_node),
-                                      args=(i, p)))
-    # bounded thread pool, reference-style reader threads
-    for start in range(0, len(threads), max(num_threads, 1)):
-      chunk = threads[start:start + max(num_threads, 1)]
-      for t in chunk:
-        t.start()
-      for t in chunk:
-        t.join()
-      if errors:      # abort before reading the remaining tables
-        break
-    if errors:
-      raise errors[0]
+    # bounded reader pool (reference-style threaded table readers);
+    # worker exceptions surface here — a swallowed one would resurface
+    # later as a confusing NoneType error at the concatenate
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=max(num_threads, 1)) as pool:
+      futures = [pool.submit(read_edge, i, p)
+                 for i, p in enumerate(edge_tables)]
+      futures += [pool.submit(read_node, i, p)
+                  for i, p in enumerate(node_tables)]
+      for fut in futures:
+        fut.result()   # re-raises the first worker failure
 
     if edge_parts:
       edge_index = np.concatenate([e for e in edge_parts], axis=1)

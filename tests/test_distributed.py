@@ -741,3 +741,31 @@ def test_dist_hetero_tree_batches_support_hierarchical_model():
     o_hier = np.asarray(hier.apply(params, x, ei, em))
     np.testing.assert_allclose(o_full[:nseed], o_hier[:nseed],
                                rtol=2e-5, atol=2e-5)
+
+
+def test_dist_tree_with_node_budget():
+  """dedup='tree' + node_budget in the sharded engine: buffers shrink to
+  the budgeted layout and every emitted edge still decodes correctly."""
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, [2, 2], mesh, seed=0, dedup='tree', node_budget=6)
+  seeds = np.array([[0, 4, 8, 12], [1, 5, 9, 13]], np.int32)
+  out = sampler.sample_from_nodes(seeds)
+  node = np.asarray(out.node)
+  from graphlearn_tpu.sampler.neighbor_sampler import (capacity_plan,
+                                                       tree_layout_from_caps)
+  no, _ = tree_layout_from_caps(capacity_plan(4, [2, 2], 6), [2, 2])
+  assert node.shape == (num_parts, no[-1])
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  em = np.asarray(out.edge_mask)
+  for p in range(num_parts):
+    assert em[p].sum() > 0
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N)

@@ -75,3 +75,16 @@ def test_graph_modes(mode):
   np.testing.assert_array_equal(np.asarray(g.indptr), topo.indptr)
   np.testing.assert_array_equal(np.asarray(g.indices), topo.indices)
   assert g.degree([0, 3]).tolist() == [2, 1]
+
+
+def test_table_dataset_reader_errors_surface(tmp_path):
+  """Reader-thread failures (malformed or missing tables) must raise
+  clearly in the constructor, not as a NoneType error later."""
+  import pytest
+  import graphlearn_tpu as glt
+  bad = tmp_path / 'bad.npz'
+  np.savez(bad, wrong=np.arange(3))
+  with pytest.raises(ValueError, match='needs ids \\+ feats'):
+    glt.data.TableDataset(node_tables=[str(bad)])
+  with pytest.raises(FileNotFoundError):
+    glt.data.TableDataset(edge_tables=[str(tmp_path / 'missing.npy')])
