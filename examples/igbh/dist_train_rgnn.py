@@ -78,11 +78,18 @@ def main():
   loader = glt.distributed.DistNeighborLoader(
       ds, fanouts, ('paper', np.arange(n_tr)),
       batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0,
-      mesh=mesh)
+      mesh=mesh, dedup='tree')
 
+  # the typed sharded engine emits the same positional layout as
+  # sampler.hetero_tree_layout, so each shard's RGNN runs the
+  # HIERARCHICAL (trim-per-layer) forward — the reference's
+  # trim_to_layer analog, per-shard (tests prove numerical equality)
   etypes = tuple(glt.typing.reverse_edge_type(et) for et in typed)
+  no, eo = glt.sampler.hetero_tree_layout({'paper': args.batch_size},
+                                          tuple(typed), fanouts)
   model = RGNN(etypes=etypes, hidden_dim=args.hidden, out_dim=ncls,
-               num_layers=2, out_ntype='paper')
+               num_layers=2, out_ntype='paper',
+               hop_node_offsets=no, hop_edge_offsets=eo)
 
   first = next(iter(loader))
 
@@ -99,7 +106,9 @@ def main():
 
   def loss_fn(params, x, ei, em, y, nseed):
     logits = model.apply(params, x, ei, em)
-    seed_mask = jnp.arange(logits.shape[0]) < nseed
+    n = min(logits.shape[0], y.shape[0])  # hierarchical seed-side prefix
+    logits, y = logits[:n], y[:n]
+    seed_mask = jnp.arange(n) < nseed
     ce = optax.softmax_cross_entropy(logits, jax.nn.one_hot(y, ncls))
     loss = jnp.where(seed_mask, ce, 0.0).sum() / jnp.maximum(
         seed_mask.sum(), 1)

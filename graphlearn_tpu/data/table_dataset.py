@@ -9,6 +9,7 @@ same multi-reader ingestion shape is provided for local columnar files
 (.npy/.npz/.csv), which is the portable equivalent.
 """
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -74,14 +75,17 @@ class TableDataset(Dataset):
     # bounded reader pool (reference-style threaded table readers);
     # worker exceptions surface here — a swallowed one would resurface
     # later as a confusing NoneType error at the concatenate
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=max(num_threads, 1)) as pool:
+    pool = ThreadPoolExecutor(max_workers=max(num_threads, 1))
+    try:
       futures = [pool.submit(read_edge, i, p)
                  for i, p in enumerate(edge_tables)]
       futures += [pool.submit(read_node, i, p)
                   for i, p in enumerate(node_tables)]
       for fut in futures:
         fut.result()   # re-raises the first worker failure
+    finally:
+      # on failure, drop still-queued reads instead of finishing them
+      pool.shutdown(wait=True, cancel_futures=True)
 
     if edge_parts:
       edge_index = np.concatenate([e for e in edge_parts], axis=1)
