@@ -86,6 +86,9 @@ def main():
 
   fanouts = {CITES: [10, 5], WRITES: [5, 3], REV_WRITES: [3, 2]}
   n_tr = int(args.n_paper * 0.1)
+  # small smoke runs: fewer train seeds than one batch would yield zero
+  # batches under drop_last
+  args.batch_size = min(args.batch_size, max(1, n_tr))
   loader = glt.loader.NeighborLoader(
       ds, fanouts, ('paper', np.arange(n_tr)),
       batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0)
