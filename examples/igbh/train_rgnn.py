@@ -85,10 +85,10 @@ def main():
   ds.init_node_labels({'paper': label})
 
   fanouts = {CITES: [10, 5], WRITES: [5, 3], REV_WRITES: [3, 2]}
-  n_tr = int(args.n_paper * 0.1)
   # small smoke runs: fewer train seeds than one batch would yield zero
-  # batches under drop_last
-  args.batch_size = min(args.batch_size, max(1, n_tr))
+  # batches under drop_last (and n_paper < 10 would yield zero seeds)
+  n_tr = max(1, int(args.n_paper * 0.1))
+  args.batch_size = min(args.batch_size, n_tr)
   loader = glt.loader.NeighborLoader(
       ds, fanouts, ('paper', np.arange(n_tr)),
       batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0)

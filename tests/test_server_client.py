@@ -151,3 +151,91 @@ def test_server_client_end_to_end():
   glt.distributed.shutdown_client()
   server.join(timeout=30)
   assert not server.is_alive()
+
+
+def _matrix_server_main(rank, q, ready):
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except RuntimeError:
+    pass
+  import graphlearn_tpu as glt_mod
+  host, port = glt_mod.distributed.init_server(
+      num_servers=2, num_clients=2, server_rank=rank,
+      dataset=make_dataset())
+  q.put((rank, host, port))
+  ready.wait(timeout=120)
+  glt_mod.distributed.wait_and_shutdown_server(timeout=180)
+
+
+def _matrix_client_main(rank, addrs, out_q):
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except RuntimeError:
+    pass
+  import graphlearn_tpu as glt_mod
+  try:
+    glt_mod.distributed.init_client(
+        num_servers=2, num_clients=2, client_rank=rank,
+        server_addrs=addrs)
+    seeds = np.arange(rank * (N // 2), (rank + 1) * (N // 2))
+    opts = glt_mod.distributed.RemoteDistSamplingWorkerOptions(
+        server_rank=[0, 1], num_workers=1, prefetch_size=2,
+        worker_key=f'client{rank}')
+    loader = glt_mod.distributed.RemoteDistNeighborLoader(
+        [2, 2], seeds, batch_size=4, collect_features=True,
+        worker_options=opts, seed=rank)
+    seen = []
+    for batch in loader:
+      node = np.asarray(batch.node)
+      nn = int(batch.num_nodes)
+      x = np.asarray(batch.x)
+      np.testing.assert_allclose(x[:nn, 0], node[:nn])
+      seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+    loader.shutdown()
+    glt_mod.distributed.shutdown_client()
+    out_q.put((rank, sorted(seen)))
+  except Exception as e:  # surface child failure to the parent
+    out_q.put((rank, f'{type(e).__name__}: {e}'))
+
+
+def test_two_servers_two_clients_matrix():
+  """The reference's remote-mode matrix (2 sampling servers x 2 training
+  clients, each client splitting its seeds across BOTH servers —
+  test_dist_neighbor_loader.py:450): every client sees exactly its seed
+  range, features resolve, and the client-0 shutdown fans out to both
+  servers."""
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  ready = ctx.Event()
+  servers = [ctx.Process(target=_matrix_server_main, args=(r, q, ready))
+             for r in range(2)]
+  for s in servers:
+    s.start()
+  addrs_by_rank = {}
+  for _ in range(2):
+    r, host, port = q.get(timeout=120)
+    addrs_by_rank[r] = (host, port)
+  addrs = [addrs_by_rank[0], addrs_by_rank[1]]
+  ready.set()
+
+  out_q = ctx.Queue()
+  clients = [ctx.Process(target=_matrix_client_main,
+                         args=(r, addrs, out_q))
+             for r in range(2)]
+  for c in clients:
+    c.start()
+  results = {}
+  for _ in range(2):
+    r, seen = out_q.get(timeout=300)
+    results[r] = seen
+  for c in clients:
+    c.join(timeout=60)
+    assert not c.is_alive()
+  for s in servers:
+    s.join(timeout=60)
+    assert not s.is_alive()
+  for r in range(2):
+    assert isinstance(results[r], list), results[r]
+    assert results[r] == list(range(r * (N // 2), (r + 1) * (N // 2)))
