@@ -211,31 +211,40 @@ def test_two_servers_two_clients_matrix():
   ready = ctx.Event()
   servers = [ctx.Process(target=_matrix_server_main, args=(r, q, ready))
              for r in range(2)]
-  for s in servers:
-    s.start()
-  addrs_by_rank = {}
-  for _ in range(2):
-    r, host, port = q.get(timeout=120)
-    addrs_by_rank[r] = (host, port)
-  addrs = [addrs_by_rank[0], addrs_by_rank[1]]
-  ready.set()
+  clients = []
+  try:
+    for s in servers:
+      s.start()
+    addrs_by_rank = {}
+    for _ in range(2):
+      r, host, port = q.get(timeout=120)
+      addrs_by_rank[r] = (host, port)
+    addrs = [addrs_by_rank[0], addrs_by_rank[1]]
+    ready.set()
 
-  out_q = ctx.Queue()
-  clients = [ctx.Process(target=_matrix_client_main,
-                         args=(r, addrs, out_q))
-             for r in range(2)]
-  for c in clients:
-    c.start()
-  results = {}
-  for _ in range(2):
-    r, seen = out_q.get(timeout=300)
-    results[r] = seen
-  for c in clients:
-    c.join(timeout=60)
-    assert not c.is_alive()
-  for s in servers:
-    s.join(timeout=60)
-    assert not s.is_alive()
-  for r in range(2):
-    assert isinstance(results[r], list), results[r]
-    assert results[r] == list(range(r * (N // 2), (r + 1) * (N // 2)))
+    out_q = ctx.Queue()
+    clients = [ctx.Process(target=_matrix_client_main,
+                           args=(r, addrs, out_q))
+               for r in range(2)]
+    for c in clients:
+      c.start()
+    results = {}
+    for _ in range(2):
+      r, seen = out_q.get(timeout=300)
+      results[r] = seen
+    for c in clients:
+      c.join(timeout=60)
+      assert not c.is_alive()
+    for s in servers:
+      s.join(timeout=60)
+      assert not s.is_alive()
+    for r in range(2):
+      assert isinstance(results[r], list), results[r]
+      assert results[r] == list(range(r * (N // 2), (r + 1) * (N // 2)))
+  finally:
+    # a mid-test failure must not leak live server/client processes
+    # (held ports + spawn children would poison later tests)
+    for proc in clients + servers:
+      if proc.is_alive():
+        proc.terminate()
+        proc.join(timeout=10)
