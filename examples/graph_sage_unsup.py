@@ -60,7 +60,13 @@ def main():
       0.5 * rng.standard_normal((n, 64)).astype(np.float32)
 
   # hold 10% of edges out of BOTH the graph and the training supervision
-  # so the reported link accuracy is on genuinely unseen pairs
+  # so the reported link accuracy is on genuinely unseen pairs; dedupe
+  # (u, v) pairs first — sampling with replacement would otherwise leave
+  # a held-out edge's twin in the training graph
+  uniq = np.unique(rows.astype(np.int64) * n + cols)
+  rows = (uniq // n).astype(np.int32)
+  cols = (uniq % n).astype(np.int32)
+  e = rows.shape[0]
   perm = rng.permutation(e)
   tr_idx, te_idx = perm[: int(e * 0.9)], perm[int(e * 0.9):]
   g_rows, g_cols = rows[tr_idx], cols[tr_idx]
@@ -73,6 +79,7 @@ def main():
       ds, [10, 5], np.stack([g_rows, g_cols]),
       neg_sampling=NegativeSampling('binary', 1),
       batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0)
+  # drop_last truncates < one batch of the holdout (noted, not padded)
   test_loader = glt.loader.LinkNeighborLoader(
       ds, [10, 5], np.stack([rows[te_idx], cols[te_idx]]),
       neg_sampling=NegativeSampling('binary', 1),

@@ -27,9 +27,14 @@ class DistLoader:
   def __init__(self, data: DistDataset, sampler: DistNeighborSampler,
                input_nodes, batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = True, collect_features: bool = True,
-               seed: Optional[int] = None):
+               seed: Optional[int] = None,
+               seed_labels_only: bool = False):
     self.data = data
     self.sampler = sampler
+    # seed_labels_only: gather y for the per-shard seed block only
+    # (supervision reads seed slots; skips a full-capacity sharded
+    # label gather — the same knob as the local loaders)
+    self.seed_labels_only = seed_labels_only
     if isinstance(input_nodes, tuple) and isinstance(input_nodes[0], str):
       self.input_type, input_nodes = input_nodes
     else:
@@ -100,8 +105,8 @@ class DistLoader:
     from ..loader import HeteroData
     from ..sampler import HeteroSamplerOutput
     x, y = self.sampler.collate(
-        out, self.data.node_labels if self.data.node_labels is not None
-        else None)
+        out, self.data.node_labels,
+        label_cap=(self.batch_size if self.seed_labels_only else None))
     if isinstance(out, HeteroSamplerOutput):
       ei = {et: ops.stack2_batched(out.row[et], out.col[et])
             for et in out.row}
@@ -396,7 +401,8 @@ class DistNeighborLoader(DistLoader):
                drop_last: bool = True, with_edge: bool = False,
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
-               with_weight: bool = False, dedup: str = 'sort'):
+               with_weight: bool = False, dedup: str = 'sort',
+               seed_labels_only: bool = False):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -407,4 +413,5 @@ class DistNeighborLoader(DistLoader):
         node_budget=node_budget, collect_features=collect_features,
         with_weight=with_weight, dedup=dedup)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
-                     drop_last, collect_features, seed)
+                     drop_last, collect_features, seed,
+                     seed_labels_only=seed_labels_only)

@@ -1029,7 +1029,7 @@ class DistNeighborSampler:
           1, int(np.max(np.diff(self.graph.indptr, axis=1))))
     return self._max_deg
 
-  def collate(self, out, node_labels=None):
+  def collate(self, out, node_labels=None, label_cap=None):
     """Attach features (sharded all_to_all gather) and labels.
 
     Reference: _colloate_fn (dist_neighbor_sampler.py:650-744). Labels
@@ -1037,6 +1037,10 @@ class DistNeighborSampler:
     nodes' labels as a 1-wide sharded table and the gather rides the same
     all_to_all path — not replicated per device (which at papers100M
     scale would put the full [N] array on every chip).
+
+    ``label_cap``: gather labels only for the first ``label_cap`` node
+    slots per shard (the seed block leads each shard's buffer); for
+    hetero, only the seed (input) type carries labels then.
     """
     if isinstance(out, HeteroSamplerOutput):
       x = y = None
@@ -1044,16 +1048,24 @@ class DistNeighborSampler:
         x = {t: self.dist_feature[t].get(out.node[t])
              for t in out.node if t in self.dist_feature}
       if node_labels is not None:
-        y = {t: self._label_dist(node_labels[t], t).get(
-                out.node[t])[..., 0]
-             for t in out.node if t in node_labels}
+        y = {}
+        for t in out.node:
+          if t not in node_labels:
+            continue
+          if label_cap is not None and t != out.input_type:
+            continue
+          buf = (out.node[t] if label_cap is None
+                 else out.node[t][:, :label_cap])
+          y[t] = self._label_dist(node_labels[t], t).get(buf)[..., 0]
       return x, y
     x = None
     if self.collect_features:
       x = self.dist_feature.get(out.node)
     y = None
     if node_labels is not None:
-      y = self._label_dist(node_labels).get(out.node)[..., 0]
+      buf = (out.node if label_cap is None
+             else out.node[:, :label_cap])
+      y = self._label_dist(node_labels).get(buf)[..., 0]
     return x, y
 
   def _label_dist(self, labels, key=None):

@@ -769,3 +769,28 @@ def test_dist_tree_with_node_budget():
         continue
       u, v = int(node[p][c]), int(node[p][r])
       assert v in ((u + 1) % N, (u + 2) % N)
+
+
+def test_dist_seed_labels_only():
+  """seed_labels_only on the dist loader: y is the per-shard seed block
+  only (homo), or the input type's seed block only (hetero)."""
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  df = glt.distributed.DistFeature(num_parts, feats, node_pb, mesh)
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df,
+                                   node_labels=np.arange(N) % 4)
+  loader = glt.distributed.DistNeighborLoader(
+      ds, [2, 2], np.arange(N), batch_size=4, shuffle=False, seed=0,
+      mesh=mesh, seed_labels_only=True)
+  batch = next(iter(loader))
+  y = np.asarray(batch.y)
+  node = np.asarray(batch.node)
+  assert y.shape == (num_parts, 4)
+  for p in range(num_parts):
+    # the capped slots must BE the seed block (the invariant
+    # seed_labels_only depends on), not just any aligned node ids
+    np.testing.assert_array_equal(node[p, :4],
+                                  np.arange(p * 4, (p + 1) * 4))
+    np.testing.assert_array_equal(y[p], node[p, :4] % 4)
