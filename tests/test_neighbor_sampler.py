@@ -356,3 +356,59 @@ def test_hetero_sample_from_nodes():
     item = int(out.node['item'][r])
     user = int(out.node['user'][c])
     assert (user, item) in adj
+
+
+@pytest.mark.parametrize('dedup', ['map', 'sort', 'tree'])
+@pytest.mark.parametrize('strategy,padded', [('random', None),
+                                             ('block', None),
+                                             ('random', 8)])
+def test_sampler_invariants_random_graphs(dedup, strategy, padded):
+  """Property sweep over the mode matrix on random graphs: every valid
+  emitted edge decodes to a REAL graph edge, seed slots lead, exact
+  modes produce a duplicate-free compact node buffer, and masked slots
+  never leak ids."""
+  import zlib
+  rng = np.random.default_rng(
+      zlib.adler32(f'{dedup}-{strategy}-{padded}'.encode()))
+  # fixed fanouts/batch so every mode shares ONE compiled program
+  # (_fused_homo_fn is module-cached on the static signature); the
+  # randomness lives in the graphs and seeds
+  fanouts = [3, 2]
+  b = 8
+  assert padded is None or padded >= max(fanouts)
+  for trial in range(3):
+    n = int(rng.integers(30, 200))
+    e = int(rng.integers(2 * n, 8 * n))
+    rows = rng.integers(0, n, e)
+    cols = rng.integers(0, n, e)
+    adj = {(int(r), int(c)) for r, c in zip(rows, cols)}
+    graph = glt.data.Graph(
+        glt.data.Topology(np.stack([rows, cols]), num_nodes=n), 'CPU')
+    s = glt.sampler.NeighborSampler(graph, fanouts, seed=trial,
+                                    fused=True, dedup=dedup,
+                                    strategy=strategy,
+                                    padded_window=padded)
+    seeds = rng.integers(0, n, b)
+    out = s.sample_from_nodes(NodeSamplerInput(seeds), batch_cap=b)
+    node = np.asarray(out.node)
+    r = np.asarray(out.row)
+    c = np.asarray(out.col)
+    em = np.asarray(out.edge_mask)
+    nn = int(out.num_nodes)
+    # seeds lead (dedup modes compact; tree keeps positional seeds)
+    if dedup in ('map', 'sort'):
+      uniq_seeds = len(set(seeds.tolist()))
+      assert set(node[:uniq_seeds]) <= set(seeds.tolist())
+      valid = node[:nn]
+      assert len(set(valid.tolist())) == nn        # no dupes
+      assert (node[nn:] == -1).all()               # compact
+    else:
+      np.testing.assert_array_equal(node[:b], seeds)
+    for j in np.where(em)[0]:
+      assert node[r[j]] >= 0 and node[c[j]] >= 0
+      # padded mode samples from the table's W-subset of real neighbors;
+      # all modes must emit only real edges
+      assert (int(node[c[j]]), int(node[r[j]])) in adj
+    # masked edge slots must not carry live local indices
+    dead = ~em
+    assert ((r[dead] == -1) | (c[dead] == -1)).all() or not dead.any()
