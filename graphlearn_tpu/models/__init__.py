@@ -2,6 +2,6 @@ from .conv import (GATConv, GCNConv, SAGEConv, segment_max_agg,
                    segment_mean_agg, segment_sum_agg)
 from .hgt import HGT, HGTConv
 from .models import (GAT, GCN, GraphSAGE, HeteroConv, RGNN,
-                     TreeSAGEConv)
+                     TreeGATConv, TreeSAGEConv)
 from .train import (TrainState, batch_to_dict, create_train_state,
                     make_train_step)

@@ -18,6 +18,27 @@ from .conv import GATConv, GCNConv, SAGEConv
 _CONVS = {'sage': SAGEConv, 'gcn': GCNConv, 'gat': GATConv}
 
 
+def _tree_blocks(node_offsets, fanouts, n_rows):
+  """(blocks, edge_offsets) of a tree layout slice, with the
+  un-truncated-layout guard shared by the dense-tree convs: a truncated
+  (node_budget) layout can accidentally satisfy any divisibility check,
+  so blocks are validated against the REAL fanouts."""
+  no = tuple(node_offsets)
+  assert no[-1] == n_rows, (no, n_rows)
+  blocks = (no[0],) + tuple(no[i + 1] - no[i] for i in range(len(no) - 1))
+  assert fanouts is not None and len(fanouts) >= len(blocks) - 1, (
+      'dense-tree convs require the true fanouts to validate the layout')
+  eo = [0]
+  for d in range(len(blocks) - 1):
+    assert blocks[d + 1] == blocks[d] * fanouts[d], (
+        'dense-tree aggregation requires un-truncated tree blocks '
+        f'(block {d + 1} = {blocks[d + 1]} != parent block '
+        f'{blocks[d]} * fanout {fanouts[d]}); node_budget batches must '
+        'use the segment-op path')
+    eo.append(eo[-1] + blocks[d + 1])
+  return blocks, eo
+
+
 class TreeSAGEConv(nn.Module):
   """SAGEConv over tree-positional batches, aggregation as DENSE reshape.
 
@@ -43,23 +64,8 @@ class TreeSAGEConv(nn.Module):
   def __call__(self, x, edge_mask):
     if self.dtype is not None:
       x = x.astype(self.dtype)
+    blocks, eo = _tree_blocks(self.node_offsets, self.fanouts, x.shape[0])
     no = tuple(self.node_offsets)
-    assert no[-1] == x.shape[0], (no, x.shape)
-    blocks = (no[0],) + tuple(no[i + 1] - no[i] for i in range(len(no) - 1))
-    # a truncated (node_budget) layout can accidentally satisfy any
-    # divisibility check (e.g. equal consecutive blocks), so the guard
-    # must compare against the REAL fanouts: un-truncated means
-    # block[d+1] == block[d] * fanouts[d] exactly
-    assert self.fanouts is not None and         len(self.fanouts) >= len(blocks) - 1, (
-        'TreeSAGEConv requires the true fanouts to validate the layout')
-    eo = [0]
-    for d in range(len(blocks) - 1):
-      assert blocks[d + 1] == blocks[d] * self.fanouts[d], (
-          'dense-tree aggregation requires un-truncated tree blocks '
-          f'(block {d + 1} = {blocks[d + 1]} != parent block '
-          f'{blocks[d]} * fanout {self.fanouts[d]}); node_budget '
-          'batches must use the segment-op path')
-      eo.append(eo[-1] + blocks[d + 1])
     aggs = []
     for d in range(len(blocks) - 1):   # target block d <- child block d+1
       b, k = blocks[d], self.fanouts[d]
@@ -76,6 +82,68 @@ class TreeSAGEConv(nn.Module):
                  name='lin_self')(x)
     return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
                         name='lin_nbr')(agg)
+
+
+class TreeGATConv(nn.Module):
+  """GATConv over tree-positional batches: per-parent DENSE softmax.
+
+  On tree batches every target's in-edges are exactly its contiguous
+  child block, so GAT's segment softmax over in-edges becomes a plain
+  masked softmax over the ``[parents, k]`` reshape — no segment ops, no
+  gathers (children are a slice), dense gradients. Numerically matches
+  ``GATConv`` on tree batches (same param names: ``lin``/``att_src``/
+  ``att_dst``); valid only for un-truncated layouts (no node_budget).
+  """
+  out_dim: int
+  node_offsets: Any
+  fanouts: Any
+  heads: int = 1
+  negative_slope: float = 0.2
+  concat: bool = True
+  dtype: Any = None
+
+  @nn.compact
+  def __call__(self, x, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
+    no = tuple(self.node_offsets)
+    blocks, eo = _tree_blocks(no, self.fanouts, x.shape[0])
+    n, heads, hd = x.shape[0], self.heads, self.out_dim
+    w = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
+                 name='lin')(x).reshape(n, heads, hd)
+    a_src = self.param('att_src', nn.initializers.glorot_uniform(),
+                       (heads, hd))
+    a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
+                       (heads, hd))
+    wf = w.astype(jnp.float32)
+    alpha_src = (wf * a_src[None]).sum(-1)        # [n, H]
+    alpha_dst = (wf * a_dst[None]).sum(-1)
+    outs = []
+    for d in range(len(blocks) - 1):   # parents block d <- children d+1
+      b, k = blocks[d], self.fanouts[d]
+      lo = 0 if d == 0 else no[d - 1]
+      ch = slice(no[d], no[d] + blocks[d + 1])
+      e = (alpha_src[ch].reshape(b, k, heads) +
+           alpha_dst[lo:lo + b][:, None, :])      # [b, k, H]
+      e = nn.leaky_relu(e, self.negative_slope)
+      m = edge_mask[eo[d]:eo[d + 1]].reshape(b, k)
+      e = jnp.where(m[..., None], e, -jnp.inf)
+      # subtract the TRUE per-parent max (clamping at 0 would underflow
+      # exp when every valid logit is very negative — the same
+      # stabilization GATConv's segment softmax uses); all-masked
+      # parents fall back to 0
+      mx = e.max(axis=1, keepdims=True)
+      e = e - jnp.where(jnp.isfinite(mx), mx, 0.0)
+      ex = jnp.where(m[..., None], jnp.exp(e), 0.0)
+      denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-9)
+      attn = (ex / denom).astype(w.dtype)         # [b, k, H]
+      msgs = w[ch].reshape(b, k, heads, hd)
+      outs.append((msgs * attn[..., None]).sum(axis=1))  # [b, H, D]
+    outs.append(jnp.zeros((blocks[-1], heads, hd), w.dtype))
+    out = jnp.concatenate(outs)
+    if self.concat:
+      return out.reshape(n, heads * hd)
+    return out.mean(axis=1)
 
 
 class GraphSAGE(nn.Module):
@@ -173,21 +241,58 @@ class GCN(nn.Module):
 
 
 class GAT(nn.Module):
+  """Multi-head GAT stack; like GraphSAGE, tree-mode batches unlock the
+  layered forward (``hop_node_offsets``/``hop_edge_offsets``) and the
+  dense per-parent attention (``tree_dense=True`` + ``fanouts``)."""
   hidden_dim: int
   out_dim: int
   num_layers: int = 2
   heads: int = 4
   dropout: float = 0.0
   dtype: Any = None
+  hop_node_offsets: Any = None
+  hop_edge_offsets: Any = None
+  tree_dense: bool = False
+  fanouts: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask, train: bool = False):
+    layered = self.hop_node_offsets is not None
+    if self.tree_dense:
+      assert layered and self.fanouts is not None, (
+          'tree_dense GAT requires hop offsets + the true fanouts')
+    if layered:
+      # trace-time layout check (see GraphSAGE): jnp never errors on
+      # oversized slices, so a mismatched batch would slice garbage
+      assert len(self.hop_node_offsets) >= self.num_layers + 1 and \
+          len(self.hop_edge_offsets) >= self.num_layers
+      assert self.hop_node_offsets[self.num_layers] == x.shape[0], (
+          f'layered GAT: hop offsets {self.hop_node_offsets} do not '
+          f'match the batch node buffer ({x.shape[0]}); build them with '
+          'models.train.tree_hop_offsets from the SAME batch_size/'
+          'fanouts as the tree-mode loader')
     for i in range(self.num_layers):
       last = i == self.num_layers - 1
-      x = GATConv(self.out_dim if last else self.hidden_dim,
-                  heads=1 if last else self.heads, concat=not last,
-                  dtype=self.dtype, name=f'conv{i}')(
-          x, edge_index, edge_mask)
+      dim = self.out_dim if last else self.hidden_dim
+      heads = 1 if last else self.heads
+      if layered:
+        hops_used = self.num_layers - i
+        n_in = self.hop_node_offsets[hops_used]
+        e_used = self.hop_edge_offsets[hops_used - 1]
+        if self.tree_dense:
+          x = TreeGATConv(
+              dim, node_offsets=tuple(self.hop_node_offsets[:hops_used + 1]),
+              fanouts=tuple(self.fanouts[:hops_used]), heads=heads,
+              concat=not last, dtype=self.dtype, name=f'conv{i}')(
+              x[:n_in], edge_mask[:e_used])
+        else:
+          x = GATConv(dim, heads=heads, concat=not last,
+                      dtype=self.dtype, name=f'conv{i}')(
+              x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
+      else:
+        x = GATConv(dim, heads=heads, concat=not last,
+                    dtype=self.dtype, name=f'conv{i}')(
+            x, edge_index, edge_mask)
       if not last:
         x = nn.elu(x)
         if self.dropout > 0:

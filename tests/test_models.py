@@ -352,3 +352,36 @@ def test_tree_dense_matches_segment():
   with pytest.raises(AssertionError, match='un-truncated'):
     bad.init(jax.random.PRNGKey(0), bb['x'], bb['edge_index'],
              bb['edge_mask'])
+
+
+def test_tree_dense_gat_matches_segment():
+  """TreeGATConv (per-parent dense softmax) equals the segment-softmax
+  GATConv on tree batches, for the full layered GAT stack."""
+  import jax
+  from graphlearn_tpu.models import train as train_lib
+  rng = np.random.default_rng(1)
+  n = 200
+  rows = rng.integers(0, n, 2000)
+  cols = rng.integers(0, n, 2000)
+  keep = rows != n - 1               # zero-child parents exist
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows[keep], cols[keep]]), num_nodes=n,
+                graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 12)).astype(np.float32))
+  loader = glt.loader.NeighborLoader(
+      ds, [4, 3], np.array([n - 1] + list(range(15))), batch_size=16,
+      seed=0, dedup='tree')
+  b = next(iter(loader))
+  no, eo = train_lib.tree_hop_offsets(16, [4, 3])
+  seg = glt.models.GAT(hidden_dim=16, out_dim=4, num_layers=2, heads=2,
+                       hop_node_offsets=no, hop_edge_offsets=eo)
+  dense = glt.models.GAT(hidden_dim=16, out_dim=4, num_layers=2, heads=2,
+                         hop_node_offsets=no, hop_edge_offsets=eo,
+                         tree_dense=True, fanouts=(4, 3))
+  params = seg.init(jax.random.PRNGKey(0), b.x, b.edge_index, b.edge_mask)
+  o_seg = np.asarray(seg.apply(params, b.x, b.edge_index, b.edge_mask))
+  o_dense = np.asarray(dense.apply(params, b.x, b.edge_index,
+                                   b.edge_mask))
+  nseed = int(b.num_sampled_nodes[0])
+  np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
+                             rtol=5e-5, atol=5e-5)
