@@ -125,7 +125,14 @@ class HGTConv(nn.Module):
 class HGT(nn.Module):
   """HGT stack (reference examples/hetero/train_hgt_mag.py HGT class):
   per-type input Dense + relu, ``num_layers`` HGTConv layers, linear
-  head on ``out_ntype`` (None = return the full dict)."""
+  head on ``out_ntype`` (None = return the full dict).
+
+  ``hop_node_offsets``/``hop_edge_offsets`` (from
+  ``sampler.hetero_tree_layout`` with the loader's seed caps/fanouts)
+  enable the HIERARCHICAL forward over hetero tree-mode batches: layer l
+  only processes the typed node/edge prefixes its depth needs — the same
+  trim-per-layer scheme as RGNN's, applied to typed attention.
+  """
   ntypes: Sequence[NodeType]
   etypes: Sequence[EdgeType]
   hidden_dim: int
@@ -134,19 +141,33 @@ class HGT(nn.Module):
   num_layers: int = 2
   out_ntype: NodeType = None
   dtype: Any = None
+  hop_node_offsets: Any = None
+  hop_edge_offsets: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
+    from .models import check_hetero_offsets, hetero_trim
+    hier = self.hop_node_offsets is not None
+    if hier:
+      check_hetero_offsets(x_dict, edge_index_dict,
+                           self.hop_node_offsets, self.hop_edge_offsets,
+                           self.num_layers)
     x_dict = {t: nn.relu(nn.Dense(self.hidden_dim, dtype=self.dtype,
                                   name=f'lin_{t}')(
         x.astype(self.dtype) if self.dtype is not None else x))
         for t, x in x_dict.items()}
     meta = (tuple(self.ntypes), tuple(tuple(e) for e in self.etypes))
     for i in range(self.num_layers):
+      if hier:
+        x_in, ei, em = hetero_trim(
+            x_dict, edge_index_dict, edge_mask_dict,
+            self.hop_node_offsets, self.hop_edge_offsets,
+            self.num_layers - i)
+      else:
+        x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
       x_dict = HGTConv(self.hidden_dim, meta, heads=self.heads,
-                       dtype=self.dtype, name=f'conv{i}')(
-          x_dict, edge_index_dict, edge_mask_dict)
+                       dtype=self.dtype, name=f'conv{i}')(x_in, ei, em)
     head = nn.Dense(self.out_dim, dtype=self.dtype, name='head')
     if self.out_ntype is None:
       return {t: head(x) for t, x in x_dict.items()}

@@ -18,6 +18,48 @@ from .conv import GATConv, GCNConv, SAGEConv
 _CONVS = {'sage': SAGEConv, 'gcn': GCNConv, 'gat': GATConv}
 
 
+def check_hetero_offsets(x_dict, edge_index_dict, hop_node_offsets,
+                         hop_edge_offsets, num_layers):
+  """Trace-time layout validation shared by the hierarchical hetero
+  forwards (RGNN/HGT): jnp never errors on oversized slices, so a
+  mismatched layout would silently slice wrong blocks."""
+  for t, x in x_dict.items():
+    assert t in hop_node_offsets, (
+        f'hierarchical forward: batch has node type {t!r} but '
+        f'hop_node_offsets only covers {list(hop_node_offsets)}')
+    assert len(hop_node_offsets[t]) >= num_layers + 1, (
+        f'hierarchical forward: hop_node_offsets for {t!r} has '
+        f'{len(hop_node_offsets[t])} entries, need num_layers+1='
+        f'{num_layers + 1} — layout fanouts must cover every layer')
+    assert hop_node_offsets[t][-1] == x.shape[0], (
+        f'hierarchical forward: node offsets for {t!r} '
+        f'({hop_node_offsets[t]}) do not match the batch buffer '
+        f'({x.shape[0]}); build them with sampler.hetero_tree_layout '
+        'from the SAME seed caps/fanouts as the tree-mode loader')
+  for et in edge_index_dict:
+    assert tuple(et) in hop_edge_offsets, (
+        f'hierarchical forward: batch has edge type {tuple(et)!r} but '
+        f'hop_edge_offsets only covers {list(hop_edge_offsets)} — '
+        'check the edge_dir orientation the layout was built with '
+        '(batches key edges by the message-flow/reversed type)')
+    assert len(hop_edge_offsets[tuple(et)]) >= num_layers, (
+        f'hierarchical forward: hop_edge_offsets for {tuple(et)!r} must '
+        f'cover {num_layers} hops')
+
+
+def hetero_trim(x_dict, edge_index_dict, edge_mask_dict,
+                hop_node_offsets, hop_edge_offsets, hops_used):
+  """Slice the typed node/edge prefixes layer ``hops_used`` needs (the
+  trim-per-layer step shared by RGNN and HGT hierarchical forwards)."""
+  x_in = {t: x[:hop_node_offsets[t][hops_used]]
+          for t, x in x_dict.items()}
+  ei = {et: v[:, :hop_edge_offsets[tuple(et)][hops_used - 1]]
+        for et, v in edge_index_dict.items()}
+  em = {et: v[:hop_edge_offsets[tuple(et)][hops_used - 1]]
+        for et, v in edge_mask_dict.items()}
+  return x_in, ei, em
+
+
 def _tree_blocks(node_offsets, fanouts, n_rows):
   """(blocks, edge_offsets) of a tree layout slice, with the
   un-truncated-layout guard shared by the dense-tree convs: a truncated
@@ -356,20 +398,9 @@ class RGNN(nn.Module):
                train: bool = False):
     hier = self.hop_node_offsets is not None
     if hier:
-      for t, x in x_dict.items():
-        assert t in self.hop_node_offsets, (
-            f'hierarchical forward: batch has node type {t!r} but '
-            f'hop_node_offsets only covers {list(self.hop_node_offsets)}')
-        assert len(self.hop_node_offsets[t]) >= self.num_layers + 1, (
-            f'hierarchical forward: hop_node_offsets for {t!r} has '
-            f'{len(self.hop_node_offsets[t])} entries, need '
-            f'num_layers+1={self.num_layers + 1} — layout fanouts must '
-            'cover every layer')
-        assert self.hop_node_offsets[t][-1] == x.shape[0], (
-            f'hierarchical forward: node offsets for {t!r} '
-            f'({self.hop_node_offsets[t]}) do not match the batch buffer '
-            f'({x.shape[0]}); build them with sampler.hetero_tree_layout '
-            'from the SAME seed caps/fanouts as the tree-mode loader')
+      check_hetero_offsets(x_dict, edge_index_dict,
+                           self.hop_node_offsets, self.hop_edge_offsets,
+                           self.num_layers)
     x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
                           name=f'embed_{t}')(x)
               for t, x in x_dict.items()}
@@ -380,15 +411,10 @@ class RGNN(nn.Module):
                if self.conv == 'sage' else GATConv(dim, dtype=self.dtype)
                for et in self.etypes}
       if hier:
-        hops_used = self.num_layers - i
-        x_in = {t: x[:self.hop_node_offsets[t][hops_used]]
-                for t, x in x_dict.items()}
-        ei = {et: v[:, :self.hop_edge_offsets[tuple(et)][hops_used - 1]]
-              for et, v in edge_index_dict.items()
-              if tuple(et) in self.hop_edge_offsets}
-        em = {et: v[:self.hop_edge_offsets[tuple(et)][hops_used - 1]]
-              for et, v in edge_mask_dict.items()
-              if tuple(et) in self.hop_edge_offsets}
+        x_in, ei, em = hetero_trim(
+            x_dict, edge_index_dict, edge_mask_dict,
+            self.hop_node_offsets, self.hop_edge_offsets,
+            self.num_layers - i)
       else:
         x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
       x_dict = HeteroConv(convs, name=f'hetero{i}')(x_in, ei, em)

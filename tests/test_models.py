@@ -385,3 +385,32 @@ def test_tree_dense_gat_matches_segment():
   nseed = int(b.num_sampled_nodes[0])
   np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
                              rtol=5e-5, atol=5e-5)
+
+
+def test_hierarchical_hgt_matches_full():
+  """HGT with hetero tree hop offsets (trim-per-layer) matches the full
+  forward on the seed slots."""
+  import jax
+  ds, (CITES, WRITES), n_p = make_hetero_cluster()
+  fanouts = {CITES: [3, 2], WRITES: [2, 2]}
+  loader = glt.loader.NeighborLoader(
+      ds, fanouts, ('paper', np.arange(32)), batch_size=16, seed=0,
+      dedup='tree')
+  b = next(iter(loader))
+  etypes = tuple(glt.typing.reverse_edge_type(et)
+                 for et in (CITES, WRITES))
+  no, eo = glt.sampler.hetero_tree_layout({'paper': 16}, (CITES, WRITES),
+                                          fanouts)
+  full = glt.models.HGT(ntypes=('paper', 'author'), etypes=etypes,
+                        hidden_dim=16, out_dim=4, heads=2, num_layers=2,
+                        out_ntype='paper')
+  hier = glt.models.HGT(ntypes=('paper', 'author'), etypes=etypes,
+                        hidden_dim=16, out_dim=4, heads=2, num_layers=2,
+                        out_ntype='paper', hop_node_offsets=no,
+                        hop_edge_offsets=eo)
+  params = full.init(jax.random.PRNGKey(0), b.x, b.edge_index, b.edge_mask)
+  o_full = np.asarray(full.apply(params, b.x, b.edge_index, b.edge_mask))
+  o_hier = np.asarray(hier.apply(params, b.x, b.edge_index, b.edge_mask))
+  nseed = int(b.num_sampled_nodes['paper'][0])
+  np.testing.assert_allclose(o_full[:nseed], o_hier[:nseed],
+                             rtol=5e-5, atol=5e-5)
