@@ -25,9 +25,6 @@ reference-parity exact-dedup mode ('map') is reported alongside as
 the GLT-CUDA A100 scale read off that figure (~40M sampled edges/s for this
 config). Prints ONE JSON line.
 """
-import collections
-import glob
-import gzip
 import json
 import shutil
 import time
@@ -66,28 +63,9 @@ def build_graph():
 
 
 def _device_program_ms(trace_dir):
-  """Per-program average device ms from the newest trace in trace_dir,
-  keyed by jit program name (TPU lane only)."""
-  paths = sorted(glob.glob(trace_dir + '/**/*.trace.json.gz',
-                           recursive=True))
-  if not paths:
-    return {}
-  with gzip.open(paths[-1]) as f:
-    t = json.load(f)
-  pids = {}
-  for e in t.get('traceEvents', []):
-    if e.get('ph') == 'M' and e.get('name') == 'process_name':
-      pids[e['pid']] = e['args'].get('name', '')
-  durs = collections.defaultdict(lambda: [0.0, 0])
-  for e in t.get('traceEvents', []):
-    if e.get('ph') == 'X' and 'dur' in e and \
-        'TPU' in pids.get(e.get('pid'), ''):
-      n = e.get('name', '')
-      if n.startswith('jit_'):
-        d = durs[n]
-        d[0] += e['dur']
-        d[1] += 1
-  return {n: (tot / cnt / 1000.0, cnt) for n, (tot, cnt) in durs.items()}
+  """Shared helper: graphlearn_tpu.utils.device_program_ms."""
+  from graphlearn_tpu.utils import device_program_ms
+  return device_program_ms(trace_dir)
 
 
 def _run_mode(sampler, rng, jax):
@@ -208,7 +186,9 @@ def main():
     return None
 
   result = {}
-  tree_ms, map_ms = mode_ms('tree'), mode_ms('map')
+  # dedup='map' resolves to the merge-sort exact engine (the program is
+  # named sample_merge); the semantics are unchanged exact dedup
+  tree_ms, map_ms = mode_ms('tree'), mode_ms('merge')
   pad_ms = mode_ms('tree_padded')
   blk_ms = mode_ms('tree_block')
   if tree_ms is None or map_ms is None:

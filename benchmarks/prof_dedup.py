@@ -1,0 +1,155 @@
+"""Probe suite for the exact-dedup (map) inducer redesign (round 3).
+
+Measures, with device-trace truth (PERF.md timing rules):
+  - element gather/scatter rates vs TABLE size (is a small table faster,
+    i.e. does XLA keep it in VMEM?)
+  - XLA sort cost for 1-D [S] vs lane-parallel (R, 128) shapes
+  - whether Mosaic lowers a dynamic gather over a VMEM-resident table
+    inside a Pallas kernel, and at what speed
+
+Run: python benchmarks/prof_dedup.py
+"""
+import functools
+import json
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TRACE_DIR = '/tmp/glt_prof_dedup'
+S = 768 * 1024          # candidate stream size (bench hop-3 scale)
+ITERS = 8
+
+
+def _device_program_ms(trace_dir):
+  from graphlearn_tpu.utils import device_program_ms
+  return device_program_ms(trace_dir)
+
+
+def named_jit(name, fn, *static):
+  fn.__name__ = name
+  return jax.jit(fn, static_argnames=static)
+
+
+def main():
+  rng = np.random.default_rng(0)
+  probes = {}  # name -> (fn, args)
+
+  # --- element gather from tables of varying size ---
+  for logn in (13, 16, 20, 24):
+    n = 1 << logn
+    table = jnp.asarray(rng.integers(0, 1 << 30, n, dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, n, S, dtype=np.int32))
+    def g(t, i):
+      return t[i].sum()
+    probes[f'gather_n{logn}'] = (named_jit(f'gather_n{logn}', g),
+                                 (table, idx))
+
+  # --- element scatter-set into tables of varying size ---
+  for logn in (16, 20, 24):
+    n = 1 << logn
+    table = jnp.zeros((n,), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, n, S, dtype=np.int32))
+    vals = jnp.asarray(rng.integers(0, 1 << 30, S, dtype=np.int32))
+    def sc(t, i, v):
+      return t.at[i].set(v).sum()
+    probes[f'scatter_n{logn}'] = (named_jit(f'scatter_n{logn}', sc),
+                                  (table, idx, vals))
+
+  # --- sorts ---
+  ids = jnp.asarray(rng.integers(0, 1 << 20, S, dtype=np.int32))
+  probes['sort_1d'] = (named_jit('sort_1d', lambda x: jnp.sort(x).sum()),
+                       (ids,))
+  ids2 = ids.reshape(-1, 128)
+  probes['sort_lanes'] = (named_jit(
+      'sort_lanes', lambda x: jnp.sort(x, axis=0).sum()), (ids2,))
+  ids2b = ids.reshape(-1, 512)
+  probes['sort_lanes512'] = (named_jit(
+      'sort_lanes512', lambda x: jnp.sort(x, axis=0).sum()), (ids2b,))
+  probes['argsort_1d'] = (named_jit(
+      'argsort_1d', lambda x: jnp.argsort(x).sum()), (ids,))
+  # sort (key, payload) pair — what dedup+relabel actually needs
+  pay = jnp.arange(S, dtype=jnp.int32)
+  def sortpair(x, p):
+    xs, ps = jax.lax.sort((x, p), num_keys=1)
+    return xs.sum() + ps.sum()
+  probes['sort_pair_1d'] = (named_jit('sort_pair_1d', sortpair), (ids, pay))
+  def sortpair2(x, p):
+    xs, ps = jax.lax.sort((x, p), dimension=0, num_keys=1)
+    return xs.sum() + ps.sum()
+  probes['sort_pair_lanes'] = (named_jit('sort_pair_lanes', sortpair2),
+                               (ids2, pay.reshape(-1, 128)))
+
+  # --- take_along_axis per-lane gather (Mosaic DynamicGather probe, XLA) ---
+  tbl2 = jnp.asarray(rng.integers(0, 1 << 30, (8192, 128), dtype=np.int32))
+  li = jnp.asarray(rng.integers(0, 8192, (S // 128, 128), dtype=np.int32))
+  def tala(t, i):
+    return jnp.take_along_axis(t, i, axis=0).sum()
+  probes['take_along_lanes'] = (named_jit('take_along_lanes', tala),
+                                (tbl2, li))
+
+  # --- pallas VMEM-table gather probe ---
+  try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    TN = 1 << 16  # 256KB table in VMEM
+
+    def pk(table_ref, idx_ref, out_ref):
+      t = table_ref[:]                     # [TN] table in VMEM (as value)
+      idx = idx_ref[:]                     # [S/128, 128]
+      out_ref[:] = jnp.take(t.reshape(-1), idx.reshape(-1),
+                            axis=0).reshape(idx.shape)
+
+    ptable = jnp.asarray(rng.integers(0, 1 << 30, TN, dtype=np.int32))
+    pidx = jnp.asarray(
+        rng.integers(0, TN, (S // 128, 128), dtype=np.int32))
+
+    def pallas_gather(t, i):
+      return pl.pallas_call(
+          pk,
+          out_shape=jax.ShapeDtypeStruct(i.shape, jnp.int32),
+          in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec(memory_space=pltpu.VMEM)],
+          out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+      )(t, i).sum()
+    probes['pallas_vmem_take'] = (named_jit('pallas_vmem_take',
+                                            pallas_gather),
+                                  (ptable, pidx))
+  except Exception as e:  # noqa: BLE001
+    print(f'# pallas probe setup failed: {type(e).__name__}: {e}')
+
+  # compile everything outside the trace; drop probes that fail to lower
+  live = {}
+  for name, (fn, args) in probes.items():
+    try:
+      out = fn(*args)
+      jax.block_until_ready(out)
+      live[name] = (fn, args)
+    except Exception as e:  # noqa: BLE001
+      print(f'# {name}: COMPILE/RUN FAILED: {type(e).__name__}: '
+            f'{str(e)[:200]}')
+
+  shutil.rmtree(TRACE_DIR, ignore_errors=True)
+  jax.profiler.start_trace(TRACE_DIR)
+  outs = []
+  for name, (fn, args) in live.items():
+    for _ in range(ITERS):
+      outs.append(fn(*args))
+  jax.block_until_ready(outs)
+  jax.profiler.stop_trace()
+
+  progs = _device_program_ms(TRACE_DIR)
+  for name in live:
+    ms = None
+    for n, (m, _) in progs.items():
+      if n == f'jit_{name}' or n.startswith(f'jit_{name}('):
+        ms = m
+    rate = S / ms / 1e3 if ms else float('nan')  # M elem/s
+    print(f'{name:24s} {ms if ms is not None else -1:8.3f} ms   '
+          f'{rate:8.1f} M elem/s')
+
+
+if __name__ == '__main__':
+  main()

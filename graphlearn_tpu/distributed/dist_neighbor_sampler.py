@@ -114,7 +114,8 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
   for i, k in enumerate(fanouts):
     nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
                                hop_keys[i], nparts, with_edge, weighted)
-    state, out = induce(state, fidx, nbrs, m, node_offs[i])
+    state, out = induce(state, fidx, nbrs, m, node_offs[i],
+                        final=(i + 1 == len(fanouts)))
     rows.append(out['cols'])   # message direction: neighbor -> seed
     cols.append(out['rows'])
     emasks.append(out['edge_mask'])
@@ -189,13 +190,17 @@ class DistNeighborSampler:
     self.with_weight = with_weight
     self.collect_features = collect_features and dist_feature is not None
     self.node_budget = node_budget
-    # 'sort' = exact dedup; 'tree' ('none' aliases it) = positional
-    # computation-tree batches, ~4x faster inducer (PERF.md). The sharded
-    # engine has no 'map' mode (a [N] table per shard defeats sharding).
+    # 'sort'/'map'/'merge' = exact dedup (all run the merge-sort engine,
+    # ops/induce_merge.py — batch-sized memory, so it shards cleanly);
+    # 'tree' ('none' aliases it) = positional computation-tree batches
+    # with a zero-random-access inducer (PERF.md).
     dedup = 'tree' if dedup == 'none' else dedup
-    if dedup not in ('sort', 'tree'):
+    if dedup in ('sort', 'map', 'merge'):
+      dedup = 'merge'
+    elif dedup != 'tree':
       raise ValueError(f'unknown dedup mode {dedup!r}; the distributed '
-                       "engine supports 'sort' (exact) and 'tree'")
+                       "engine supports 'sort'/'map'/'merge' (exact) and "
+                       "'tree'")
     self.dedup = dedup
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     self._dev = dist_graph.device_arrays(mesh)
@@ -586,7 +591,14 @@ class DistNeighborSampler:
     ki = 0
     for hop in range(num_hops):
       new_parts = {t: [] for t in ntypes}
-      for et, (fcap, k) in hop_caps[hop].items():
+      items = list(hop_caps[hop].items())
+      # last-hop per-type final induce: merge engine skips its
+      # sorted-view rebuild (see the local hetero engine)
+      last_touch = {}
+      if hop + 1 == num_hops:
+        for j, (et, _) in enumerate(items):
+          last_touch[et[2] if edge_dir == 'out' else et[0]] = j
+      for j, (et, (fcap, k)) in enumerate(items):
         key_t = et[0] if edge_dir == 'out' else et[2]
         res_t = et[2] if edge_dir == 'out' else et[0]
         out_et = out_et_of[et]
@@ -597,7 +609,8 @@ class DistNeighborSampler:
                                    self._weighted_for(et))
         ki += 1
         states[res_t], iout = induce(states[res_t], fidx, nbrs, m,
-                                     offsets[res_t])
+                                     offsets[res_t],
+                                     final=last_touch.get(res_t) == j)
         offsets[res_t] += fcap * k
         rows.setdefault(out_et, []).append(iout['cols'])
         cols.setdefault(out_et, []).append(iout['rows'])

@@ -3,6 +3,8 @@ from .collate import (collate_batch, gather_rows, stack2, stack2_batched,
 from .gather_pallas import gather_rows_hbm
 from .induce import InducerState, induce_next, init_empty, init_node
 from .induce_map import (MapInducerState, induce_next_map, init_node_map)
+from .induce_merge import (MergeInducerState, induce_next_merge,
+                           init_empty_merge, init_node_merge)
 from .induce_tree import (TreeInducerState, induce_next_tree,
                           init_empty_tree, init_node_tree)
 from .negative import (random_negative_sample, random_negative_sample_local,

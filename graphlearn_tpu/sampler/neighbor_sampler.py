@@ -35,27 +35,41 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
   """(init_seed, init_empty, induce_fn(state, fidx, nbrs, m, offset)) per
   dedup mode — the single source of truth for inducer dispatch across the
   local homo/hetero and distributed engines. ``offset`` (static
-  positional slot base) is only consumed by 'tree'."""
-  if mode == 'map':
+  positional slot base / prefix cap) is consumed by 'tree' and the merge
+  engine. ``final=True`` marks the last hop induced on a state (lets the
+  merge engine skip its sorted-view rebuild)."""
+  if mode in ('map', 'sort', 'merge'):
+    # exact dedup: all three names run the merge-sort engine — the
+    # fastest exact engine on TPU (sorts beat random scatters ~3x,
+    # ops/induce_merge.py) and the only one whose memory scales with the
+    # batch rather than the graph. The historical engines stay available
+    # for parity/bisection: 'map_table' = direct-address [N] table
+    # (ops/induce_map.py), 'sort_legacy' = searchsorted engine
+    # (ops/induce.py).
+    return ops.init_node_merge, ops.init_empty_merge, \
+        lambda st, fi, nb, m, off, compact=True, final=False: \
+        ops.induce_next_merge(st, fi, nb, m, prefix_cap=off,
+                              update_view=not final)
+  if mode == 'map_table':
     init = functools.partial(ops.init_node_map,
                              num_graph_nodes=num_graph_nodes)
 
     def _no_empty_map(capacity):
       raise NotImplementedError(
-          'map-mode lazy (empty) inducer states are not implemented — '
-          'the hetero engines use sort/tree modes; add an '
-          'ops.init_empty_map before wiring map into a typed path')
+          'map-table lazy (empty) inducer states are not implemented — '
+          'the hetero engines use merge/tree modes; add an '
+          'ops.init_empty_map before wiring map_table into a typed path')
 
     return init, _no_empty_map, \
-        lambda st, fi, nb, m, off, compact=True: \
+        lambda st, fi, nb, m, off, compact=True, final=False: \
         ops.induce_next_map(st, fi, nb, m, compact_frontier=compact)
-  if mode == 'sort':
+  if mode == 'sort_legacy':
     return ops.init_node, ops.init_empty, \
-        lambda st, fi, nb, m, off, compact=True: \
+        lambda st, fi, nb, m, off, compact=True, final=False: \
         ops.induce_next(st, fi, nb, m)
   assert mode == 'tree', f'unknown dedup mode {mode!r}'
   return ops.init_node_tree, ops.init_empty_tree, \
-      lambda st, fi, nb, m, off, compact=True: \
+      lambda st, fi, nb, m, off, compact=True, final=False: \
       ops.induce_next_tree(st, fi, nb, m, offset=off)
 
 
@@ -227,7 +241,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
       # positionally and skip two S-element compaction scatters
       compact = (i + 1 < len(caps)) and caps[i + 1] < caps[i] * k
       state, out = induce_fn(state, fidx, nbrs, m, node_offs[i],
-                             compact)
+                             compact, final=(i + 1 == len(fanouts)))
       # message direction: neighbor -> seed
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -388,23 +402,24 @@ class NeighborSampler(BaseSampler):
   # ------------------------------------------------------------------ hops
 
   def _dedup_mode(self) -> str:
-    """'map' | 'sort' | 'tree' ('none' aliases 'tree').
+    """Resolved engine name ('none' aliases 'tree').
 
-    Profiler-measured on v5e-1 (products-scale, [15,10,5] @ 1024,
-    PERF.md): map = 53.7 ms/batch (random table scatters/gathers
-    dominate), sort = 213 ms, tree = positional relabeling with zero
-    random access in the inducer. 'auto' keeps reference-parity exact
-    dedup ('map'); pass dedup='tree' for the fast computation-tree
-    semantics.
+    'map' / 'sort' / 'merge' / 'auto' all run the merge-sort exact-dedup
+    engine (ops/induce_merge.py — the fastest exact engine on TPU, and
+    memory scales with the batch, not the graph, so it also covers
+    billion-node graphs). 'map_table' forces the direct-address [N]
+    table (ops/induce_map.py, the literal GPU-hash-table analog),
+    'sort_legacy' the searchsorted engine (ops/induce.py) — both kept
+    for parity/bisection. 'tree' is the computation-tree relaxation
+    (positional relabeling, zero random access — PERF.md).
     """
     if self.dedup in ('tree', 'none'):
       return 'tree'
-    if self.dedup in ('map', 'sort'):
+    if self.dedup in ('map_table', 'sort_legacy'):
       return self.dedup
-    return 'map' if self._get_graph().num_nodes <= 64_000_000 else 'sort'
-
-  def _use_map_dedup(self) -> bool:
-    return self._dedup_mode() == 'map'
+    if self.dedup in ('map', 'sort', 'merge', 'auto'):
+      return 'merge'
+    raise ValueError(f'unknown dedup mode {self.dedup!r}')
 
   def _inducer_fns(self):
     """(init_fn(seeds, mask, capacity), induce_fn(..., offset)) for the
@@ -460,7 +475,7 @@ class NeighborSampler(BaseSampler):
         tuple(fanouts), tuple(caps), self._node_cap(caps, fanouts),
         self.with_edge,
         self.with_weight and g.edge_weights is not None,
-        mode, g.num_nodes if mode == 'map' else 0,
+        mode, g.num_nodes if mode == 'map_table' else 0,
         padded=self.padded_window is not None,
         block_num_edges=nblk_edges)
 
@@ -571,7 +586,8 @@ class NeighborSampler(BaseSampler):
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
                                            fmask, k, keys[i])
       compact = caps[i + 1] < caps[i] * k   # see _fused_homo_fn note
-      state, out = induce_fn(state, fidx, nbrs, m, offset, compact)
+      state, out = induce_fn(state, fidx, nbrs, m, offset, compact,
+                             final=(i + 1 == len(fanouts)))
       offset += caps[i] * k
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -685,7 +701,11 @@ class NeighborSampler(BaseSampler):
     nodes_per_hop: Dict[NodeType, list] = {t: [] for t in ntypes}
     edges_per_hop: Dict[EdgeType, list] = {}
 
-    mode = 'tree' if self.dedup in ('tree', 'none') else 'sort'
+    mode = self._dedup_mode()
+    if mode == 'map_table':
+      raise ValueError("dedup='map_table' is homogeneous-only (no lazy "
+                       "empty inducer state); use 'map'/'sort'/'merge' "
+                       'or tree for hetero graphs')
     init_seed, init_empty, induce = _inducer_for(mode)
     offsets = {t: caps_in.get(t, 0) for t in ntypes}  # positional layout
     inv_d = {}
@@ -703,7 +723,15 @@ class NeighborSampler(BaseSampler):
 
     for hop in range(num_hops):
       new_parts: Dict[NodeType, list] = {t: [] for t in ntypes}
-      for et, (fcap, k) in hop_caps[hop].items():
+      items = list(hop_caps[hop].items())
+      # on the last hop, mark each type's LAST induce so the merge
+      # engine can skip its sorted-view rebuild (only nodes/num_nodes
+      # are read afterwards)
+      last_touch = {}
+      if hop + 1 == num_hops:
+        for j, (et, _) in enumerate(items):
+          last_touch[et[2] if self.edge_dir == 'out' else et[0]] = j
+      for j, (et, (fcap, k)) in enumerate(items):
         key_t = et[0] if self.edge_dir == 'out' else et[2]
         res_t = et[2] if self.edge_dir == 'out' else et[0]
         out_et = reverse_edge_type(et) if self.edge_dir == 'out' else et
@@ -713,7 +741,8 @@ class NeighborSampler(BaseSampler):
         if res_t not in states:
           states[res_t] = init_empty(node_caps[res_t])
         states[res_t], iout = induce(states[res_t], fidx, hop_out.nbrs,
-                                     hop_out.mask, offsets[res_t])
+                                     hop_out.mask, offsets[res_t],
+                                     final=last_touch.get(res_t) == j)
         offsets[res_t] += fcap * k
         rows.setdefault(out_et, []).append(iout['cols'])
         cols.setdefault(out_et, []).append(iout['rows'])

@@ -41,6 +41,40 @@ def step_annotation(name: str, step: int):
   return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
+def device_program_ms(trace_dir: str):
+  """Per-program average device ms from the newest trace under
+  ``trace_dir``, keyed by jitted program name, TPU lane only — the
+  device-trace clock every benchmark uses (PERF.md 'Timing on the axon
+  tunnel': wall clocks are untrustworthy on remote-dispatch runtimes).
+
+  Returns {name: (avg_ms, call_count)}.
+  """
+  import collections
+  import glob
+  import gzip
+  import json
+  paths = sorted(glob.glob(trace_dir + '/**/*.trace.json.gz',
+                           recursive=True))
+  if not paths:
+    return {}
+  with gzip.open(paths[-1]) as f:
+    t = json.load(f)
+  pids = {}
+  for e in t.get('traceEvents', []):
+    if e.get('ph') == 'M' and e.get('name') == 'process_name':
+      pids[e['pid']] = e['args'].get('name', '')
+  durs = collections.defaultdict(lambda: [0.0, 0])
+  for e in t.get('traceEvents', []):
+    if e.get('ph') == 'X' and 'dur' in e and \
+        'TPU' in pids.get(e.get('pid'), ''):
+      n = e.get('name', '')
+      if n.startswith('jit_'):
+        d = durs[n]
+        d[0] += e['dur']
+        d[1] += 1
+  return {n: (tot / cnt / 1000.0, cnt) for n, (tot, cnt) in durs.items()}
+
+
 _active = False
 
 

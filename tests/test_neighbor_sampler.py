@@ -358,7 +358,8 @@ def test_hetero_sample_from_nodes():
     assert (user, item) in adj
 
 
-@pytest.mark.parametrize('dedup', ['map', 'sort', 'tree'])
+@pytest.mark.parametrize('dedup', ['map', 'map_table', 'sort_legacy',
+                                   'tree'])
 @pytest.mark.parametrize('strategy,padded', [('random', None),
                                              ('block', None),
                                              ('random', 8)])
@@ -396,7 +397,7 @@ def test_sampler_invariants_random_graphs(dedup, strategy, padded):
     em = np.asarray(out.edge_mask)
     nn = int(out.num_nodes)
     # seeds lead (dedup modes compact; tree keeps positional seeds)
-    if dedup in ('map', 'sort'):
+    if dedup != 'tree':
       uniq_seeds = len(set(seeds.tolist()))
       assert set(node[:uniq_seeds]) <= set(seeds.tolist())
       valid = node[:nn]
