@@ -1,0 +1,78 @@
+"""Probe: does XLA interleave batch n+1's sample+collate with batch n's
+train step when fused into one program? (loader/pipeline.py rationale)
+
+Measures, at the bench e2e config (1M nodes, [15,10,5] @ 1024, SAGE h=256
+tree_dense bf16, block sampling), with device-trace truth:
+  serial: sample + collate + train as separate programs (sum of ms)
+  fused:  OverlappedTrainer's program (ms/call)
+Overlap won = fused_ms < serial_sum; ideal = max(train, sample+collate).
+
+Run: python benchmarks/prof_overlap.py
+"""
+import os
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # repo-root bench config/helpers  # noqa: E402
+
+FANOUT = bench.FANOUT
+BATCH = bench.BATCH
+
+
+def main():
+  import jax
+  import jax.numpy as jnp
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import GraphSAGE
+  from graphlearn_tpu.models import train as train_lib
+  glt.utils.enable_compilation_cache()
+
+  graph = bench.build_graph()
+  rng = np.random.default_rng(2)
+  feat = rng.standard_normal((bench.NUM_NODES, bench.E2E_FEAT_DIM),
+                             dtype=np.float32)
+  labels = rng.integers(0, bench.E2E_CLASSES, bench.NUM_NODES)
+  ds = glt.data.Dataset(graph=graph)
+  ds.init_node_features(feat)
+  ds.init_node_labels(labels)
+  iters = 10
+  train_idx = rng.integers(0, bench.NUM_NODES, BATCH * (iters + 6))
+
+  loader = glt.loader.NeighborLoader(
+      ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
+      drop_last=True, seed=0, dedup='tree', strategy='block',
+      seed_labels_only=True)
+  no, eo = train_lib.tree_hop_offsets(BATCH, FANOUT)
+  model = GraphSAGE(hidden_dim=bench.E2E_HIDDEN, out_dim=bench.E2E_CLASSES,
+                    num_layers=len(FANOUT), hop_node_offsets=no,
+                    hop_edge_offsets=eo, dtype=jnp.bfloat16,
+                    tree_dense=True, fanouts=tuple(FANOUT))
+  it = iter(loader)
+  first = train_lib.batch_to_dict(next(it))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+
+  trainer = glt.loader.OverlappedTrainer(loader, model, tx,
+                                         bench.E2E_CLASSES)
+  # compile + warmup outside the trace
+  state, losses = trainer.run_epoch(state, max_steps=3)
+  jax.block_until_ready(losses)
+
+  trace_dir = '/tmp/glt_prof_overlap'
+  shutil.rmtree(trace_dir, ignore_errors=True)
+  jax.profiler.start_trace(trace_dir)
+  state, losses = trainer.run_epoch(state, max_steps=iters)
+  jax.block_until_ready(losses)
+  jax.profiler.stop_trace()
+
+  progs = glt.utils.device_program_ms(trace_dir)
+  for n, (ms, cnt) in sorted(progs.items()):
+    print(f'{n[:72]:74s} {ms:8.3f} ms x{cnt}')
+
+
+if __name__ == '__main__':
+  main()

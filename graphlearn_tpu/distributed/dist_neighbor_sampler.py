@@ -592,12 +592,9 @@ class DistNeighborSampler:
     for hop in range(num_hops):
       new_parts = {t: [] for t in ntypes}
       items = list(hop_caps[hop].items())
-      # last-hop per-type final induce: merge engine skips its
-      # sorted-view rebuild (see the local hetero engine)
-      last_touch = {}
-      if hop + 1 == num_hops:
-        for j, (et, _) in enumerate(items):
-          last_touch[et[2] if edge_dir == 'out' else et[0]] = j
+      from ..sampler.neighbor_sampler import _final_touch_map
+      last_touch = (_final_touch_map(items, edge_dir)
+                    if hop + 1 == num_hops else {})
       for j, (et, (fcap, k)) in enumerate(items):
         key_t = et[0] if edge_dir == 'out' else et[2]
         res_t = et[2] if edge_dir == 'out' else et[0]

@@ -1,0 +1,149 @@
+"""Overlapped (double-buffered) sample -> collate -> train pipeline.
+
+The reference's defining architecture is the asynchronous producer-consumer
+pipeline: sampling runs decoupled from training and its latency hides
+behind the train step
+(/root/reference/graphlearn_torch/python/distributed/dist_sampling_producer.py:53-151;
+docs/get_started/dist_train.md:3-8 "asynchronous producer consumer model",
+via CUDA streams / separate processes).
+
+A single TPU core has no concurrent streams — XLA programs execute one at
+a time — so the TPU-native equivalent is PROGRAM FUSION with software
+double-buffering: batch n's train step and batch n+1's sample+collate are
+traced into ONE XLA program with no data dependency between the two
+subgraphs. XLA's scheduler is then free to interleave the sampler/collate
+work (DMA-latency/HBM-bound gathers) with the train step's MXU-bound
+matmul pipeline, which is exactly the resource overlap the reference gets
+from its producer streams. Whether the scheduler exploits it is an
+empirical question — bench.py measures the fused step against the serial
+sum with device-trace truth (PERF.md reports the measured overlap).
+
+Usage:
+    loader = NeighborLoader(ds, fanouts, idx, batch_size=B, ...)
+    trainer = OverlappedTrainer(loader, model, tx, num_classes)
+    state, losses = trainer.run_epoch(state)   # losses stay on device
+
+The host loop stays dispatch-only (no device->host fetches, PERF.md
+rules); fetch the returned loss array once per epoch if needed.
+"""
+from typing import Optional
+
+import numpy as np
+
+from .. import ops
+from .node_loader import NodeLoader
+
+
+class OverlappedTrainer:
+  """Fuses batch n's train step with batch n+1's sample+collate.
+
+  Requirements: homogeneous graph, fused sampler, device-resident
+  feature/label tables, no edge features (the overlapped program keeps
+  the reference fast path's scope: supervised node classification).
+  """
+
+  def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
+               seed_labels_only: Optional[bool] = None):
+    import jax
+    sampler = loader.sampler
+    if getattr(sampler, 'is_hetero', False):
+      raise ValueError('OverlappedTrainer is homogeneous-only')
+    if not sampler.fused:
+      raise ValueError('OverlappedTrainer needs the fused sampler path')
+    if sampler.with_edge:
+      raise ValueError('with_edge batches are not supported in the '
+                       'overlapped program')
+    self.loader = loader
+    self.model = model
+    self.num_classes = num_classes
+    self._sampler = sampler
+    self._batch_size = loader.batch_size
+    fanouts = tuple(sampler.num_neighbors)
+    self._sample_fn = sampler._homo_fn(self._batch_size, fanouts)
+    if seed_labels_only is None:
+      seed_labels_only = loader.seed_labels_only
+    self._label_cap = self._batch_size if seed_labels_only else None
+
+    dt = loader.data.node_features.device_table() \
+        if loader.data.node_features is not None else None
+    if dt is None:
+      raise ValueError('OverlappedTrainer needs a device-resident '
+                       'feature table (Feature on HBM)')
+    self._feats, self._id2i = dt
+    self._labels = loader._label_table()
+    if self._labels is None:
+      raise ValueError('OverlappedTrainer needs node labels')
+
+    from ..models import train as train_lib
+    self._train_step, _ = train_lib.make_train_step(model, tx, num_classes)
+
+    sample_fn, label_cap = self._sample_fn, self._label_cap
+    train_step = self._train_step
+
+    def _sample_collate(fargs, feats, id2i, labels, seeds, smask, key):
+      res = sample_fn(*fargs, seeds, smask, key)
+      col = ops.collate_batch(res['node'], res['num_nodes'], res['row'],
+                              res['col'], feats, id2i, labels, None, None,
+                              label_cap=label_cap)
+      return dict(x=col['x'], edge_index=col['edge_index'],
+                  edge_mask=res['edge_mask'], y=col['y'],
+                  num_seed_nodes=res['num_sampled_nodes'][0])
+
+    def _fused(state, batch, fargs, feats, id2i, labels, seeds, smask,
+               key):
+      # two independent subgraphs in one program: XLA may interleave
+      new_state, loss, acc = train_step(state, batch)
+      next_batch = _sample_collate(fargs, feats, id2i, labels, seeds,
+                                   smask, key)
+      return new_state, loss, acc, next_batch
+
+    # donate the consumed batch buffers (state update buffers are small
+    # relative to the 938k-slot batch; donation keeps HBM flat at two
+    # batches in flight)
+    self._prime_fn = jax.jit(_sample_collate)
+    self._fused_fn = jax.jit(_fused, donate_argnums=(1,))
+
+  # ---------------------------------------------------------------- loop
+
+  def _seed_batches(self):
+    for idx in self.loader._batcher:
+      seeds = self.loader.input_seeds[idx]
+      n = seeds.shape[0]
+      padded = np.zeros((self._batch_size,), np.int32)
+      padded[:n] = seeds
+      yield padded, np.arange(self._batch_size) < n
+
+  def _dispatch_prime(self, padded, mask):
+    import jax.numpy as jnp
+    return self._prime_fn(self._sampler._fused_args(), self._feats,
+                          self._id2i, self._labels, jnp.asarray(padded),
+                          jnp.asarray(mask), self._sampler._next_key())
+
+  def run_epoch(self, state, max_steps: Optional[int] = None):
+    """One epoch of overlapped steps. Returns (state, losses) with
+    ``losses`` a list of device scalars (one per step) — fetch once,
+    after the epoch, to keep the hot loop pipelined."""
+    import jax.numpy as jnp
+    losses = []
+    batch = None
+    truncated = False
+    for padded, mask in self._seed_batches():
+      if batch is None:
+        batch = self._dispatch_prime(padded, mask)
+        continue
+      state, loss, _, batch = self._fused_fn(
+          state, batch, self._sampler._fused_args(), self._feats,
+          self._id2i, self._labels, jnp.asarray(padded),
+          jnp.asarray(mask), self._sampler._next_key())
+      losses.append(loss)
+      if max_steps is not None and len(losses) >= max_steps:
+        truncated = True
+        break
+    if batch is not None and not truncated:
+      # natural epoch end: flush the last sampled batch with a plain
+      # train step. A max_steps break drops the pending batch instead —
+      # exactly max_steps optimizer updates, step-exact for benchmarks
+      # and LR schedules.
+      state, loss, _ = self._train_step(state, batch)
+      losses.append(loss)
+    return state, losses

@@ -167,8 +167,21 @@ def induce_next_merge(state: MergeInducerState, src_idx: jax.Array,
   _, block_full = jax.lax.sort((wkey, keys_s), num_keys=1)
   in_new = jnp.arange(size) < num_new
   block = jnp.where(in_new, jax.lax.slice(block_full, (0,), (size,)), FILL)
-  nodes = jax.lax.dynamic_update_slice(state.nodes, block,
-                                       (state.num_nodes,))
+  if c + size <= cap:
+    # un-budgeted plan: the append block always fits past the prefix —
+    # one contiguous dynamic-update-slice
+    nodes = jax.lax.dynamic_update_slice(state.nodes, block,
+                                         (state.num_nodes,))
+  else:
+    # node_budget-clamped plan: the hop may overflow the buffer; drop
+    # nodes past capacity like the legacy engines (scatter mode='drop').
+    # Budget semantics caveat (shared with the legacy engines): local
+    # indices for dropped nodes still count past the capacity, so
+    # budgeted batches are a truncation approximation, not exact.
+    slot = jnp.where(in_new,
+                     state.num_nodes + jnp.arange(size, dtype=jnp.int32),
+                     cap)
+    nodes = state.nodes.at[slot].set(block, mode='drop')
   frontier = block
   frontier_idx = jnp.where(
       in_new, state.num_nodes + jnp.arange(size, dtype=jnp.int32), -1)

@@ -73,6 +73,17 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
       ops.induce_next_tree(st, fi, nb, m, offset=off)
 
 
+def _final_touch_map(items, edge_dir):
+  """{result node type -> index of its LAST induce within a hop's
+  (edge_type, caps) items} — used by both hetero engines to pass
+  final=True on the last hop so the merge engine skips its sorted-view
+  rebuild (only nodes/num_nodes are read afterwards)."""
+  last = {}
+  for j, (et, _) in enumerate(items):
+    last[et[2] if edge_dir == 'out' else et[0]] = j
+  return last
+
+
 def capacity_plan(batch_cap: int, fanouts, node_budget=None):
   """Per-hop frontier capacities [b, c1, ...] with the node_budget
   clamp — the shared base of every buffer/offset computation below."""
@@ -724,13 +735,8 @@ class NeighborSampler(BaseSampler):
     for hop in range(num_hops):
       new_parts: Dict[NodeType, list] = {t: [] for t in ntypes}
       items = list(hop_caps[hop].items())
-      # on the last hop, mark each type's LAST induce so the merge
-      # engine can skip its sorted-view rebuild (only nodes/num_nodes
-      # are read afterwards)
-      last_touch = {}
-      if hop + 1 == num_hops:
-        for j, (et, _) in enumerate(items):
-          last_touch[et[2] if self.edge_dir == 'out' else et[0]] = j
+      last_touch = (_final_touch_map(items, self.edge_dir)
+                    if hop + 1 == num_hops else {})
       for j, (et, (fcap, k)) in enumerate(items):
         key_t = et[0] if self.edge_dir == 'out' else et[2]
         res_t = et[2] if self.edge_dir == 'out' else et[0]

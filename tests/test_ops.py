@@ -287,6 +287,32 @@ def test_merge_inducer_matches_table_engine():
     assert (np.asarray(st_a2.nodes)[na:] == -1).all()
 
 
+def test_merge_inducer_node_budget_truncates_safely():
+  """Budget-clamped plans overflow the node buffer: the merge engine
+  must scatter-drop past capacity (legacy parity) — never corrupt
+  earlier entries — and in-buffer nodes stay deduplicated."""
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.sampler import NodeSamplerInput
+  rng = np.random.default_rng(5)
+  n, e = 200, 1600
+  rows, cols = rng.integers(0, n, e), rng.integers(0, n, e)
+  g = glt.data.Graph(glt.data.Topology(np.stack([rows, cols]),
+                                       num_nodes=n), 'CPU')
+  s = glt.sampler.NeighborSampler(g, [15, 10], seed=0, dedup='map',
+                                  node_budget=24)
+  seeds = rng.integers(0, n, 32)
+  out = s.sample_from_nodes(NodeSamplerInput(seeds), batch_cap=32)
+  node = np.asarray(out.node)
+  cap = node.shape[0]
+  nn = int(out.num_nodes)
+  valid = node[:min(nn, cap)]
+  valid = valid[valid >= 0]
+  assert len(set(valid.tolist())) == len(valid)
+  # the seed block survives un-corrupted
+  uniq_seeds = sorted(set(seeds.tolist()))
+  assert node[:len(uniq_seeds)].tolist() == uniq_seeds
+
+
 # ---------------------------------------------------------------- subgraph
 
 def test_node_subgraph():
