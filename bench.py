@@ -89,27 +89,43 @@ def _run_mode(sampler, rng, jax):
   return edges, dispatch_dt
 
 
-def _run_e2e(ds, train_idx, dtype, jax, trace_dir):
-  """One full train-step pipeline (block sampling + collate + layered
-  SAGE fwd/bwd/adam) traced for E2E_ITERS batches; returns total device
-  ms per batch summed across the pipeline's programs (the same breakdown
-  methodology as PERF.md 'End-to-end training step')."""
+def _run_e2e(ds, train_idx, dtype, jax, trace_dir, variant='tree',
+             cal_caps=None):
+  """One full train-step pipeline (sample + collate + layered SAGE
+  fwd/bwd/adam) traced for E2E_ITERS batches; returns total device ms
+  per batch summed across the pipeline's programs (the same breakdown
+  methodology as PERF.md 'End-to-end training step').
+
+  variant='tree': block sampling + tree_dense layered model (the
+  relaxed-semantics fast path). variant='exact': calibrated exact-dedup
+  sampling + prefix-layered segment model — reference semantics."""
   import graphlearn_tpu as glt
   from graphlearn_tpu.models import GraphSAGE
   from graphlearn_tpu.models import train as train_lib
 
-  loader = glt.loader.NeighborLoader(
-      ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
-      drop_last=True, seed=0, dedup='tree', strategy='block',
-      seed_labels_only=True)
-  no, eo = train_lib.tree_hop_offsets(BATCH, FANOUT)
-  # tree_dense: contiguous child blocks -> reshape aggregation (no
-  # gathers/segment scatters); exact for un-budgeted tree batches and
-  # 2.8x on the fwd/bwd (PERF.md)
-  model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
-                    num_layers=len(FANOUT), hop_node_offsets=no,
-                    hop_edge_offsets=eo, dtype=dtype, tree_dense=True,
-                    fanouts=tuple(FANOUT))
+  if variant == 'exact':
+    loader = glt.loader.NeighborLoader(
+        ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
+        drop_last=True, seed=0, dedup='map', frontier_caps=cal_caps,
+        seed_labels_only=True)
+    no, eo = train_lib.merge_hop_offsets(BATCH, FANOUT,
+                                         frontier_caps=cal_caps)
+    model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
+                      num_layers=len(FANOUT), hop_node_offsets=no,
+                      hop_edge_offsets=eo, dtype=dtype)
+  else:
+    loader = glt.loader.NeighborLoader(
+        ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
+        drop_last=True, seed=0, dedup='tree', strategy='block',
+        seed_labels_only=True)
+    no, eo = train_lib.tree_hop_offsets(BATCH, FANOUT)
+    # tree_dense: contiguous child blocks -> reshape aggregation (no
+    # gathers/segment scatters); exact for un-budgeted tree batches and
+    # 2.8x on the fwd/bwd (PERF.md)
+    model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
+                      num_layers=len(FANOUT), hop_node_offsets=no,
+                      hop_edge_offsets=eo, dtype=dtype, tree_dense=True,
+                      fanouts=tuple(FANOUT))
   it = iter(loader)
   first = train_lib.batch_to_dict(next(it))
   state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
@@ -158,6 +174,14 @@ def main():
   # CSR, exact uniform marginals, row-gather speed (PERF.md)
   s_blk = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True,
                                       dedup='tree', strategy='block')
+  # calibrated exact dedup: identical semantics to 'map' while every
+  # batch stays under the calibrated per-hop frontier caps (numpy probe
+  # simulation, slack 1.5x); buffers shrink from the worst-case static
+  # plan to ~actual unique counts (sampler/calibrate.py)
+  cal_caps = glt.sampler.estimate_frontier_caps(
+      graph, FANOUT, BATCH, num_probes=5, slack=1.5)
+  s_cal = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True,
+                                      dedup='map', frontier_caps=cal_caps)
   rng = np.random.default_rng(1)
 
   # compile all programs outside the trace
@@ -165,6 +189,7 @@ def main():
   _run_mode(s_map, rng, jax)
   _run_mode(s_pad, rng, jax)
   _run_mode(s_blk, rng, jax)
+  _run_mode(s_cal, rng, jax)
 
   shutil.rmtree(TRACE_DIR, ignore_errors=True)
   jax.profiler.start_trace(TRACE_DIR)
@@ -172,6 +197,7 @@ def main():
   map_edges, _ = _run_mode(s_map, rng, jax)
   pad_edges, _ = _run_mode(s_pad, rng, jax)
   blk_edges, _ = _run_mode(s_blk, rng, jax)
+  cal_edges, _ = _run_mode(s_cal, rng, jax)
   jax.profiler.stop_trace()
 
   progs = _device_program_ms(TRACE_DIR)
@@ -221,6 +247,14 @@ def main():
     result['block_device_ms_per_batch'] = round(float(blk_ms), 3)
   else:
     result['block_edges_per_sec_m'] = None
+  cal_ms = mode_ms('merge_capped')
+  if cal_ms:
+    cal_rate = np.mean(cal_edges) / cal_ms / 1e3
+    result['map_calibrated_edges_per_sec_m'] = round(float(cal_rate), 3)
+    result['map_calibrated_device_ms_per_batch'] = round(float(cal_ms), 3)
+    result['calibrated_caps'] = cal_caps
+  else:
+    result['map_calibrated_edges_per_sec_m'] = None
 
   # ---- end-to-end train step (sample + collate + layered SAGE) ----
   try:
@@ -241,6 +275,13 @@ def main():
                                    if e2e_f32 else None)
     result['train_step_ms_bf16'] = (round(float(e2e_bf16), 3)
                                     if e2e_bf16 else None)
+    # reference-semantics e2e: calibrated exact dedup + prefix-layered
+    # segment model (smaller buffers beat tree_dense at this scale)
+    e2e_exact = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
+                         '/tmp/glt_bench_e2e_exact', variant='exact',
+                         cal_caps=cal_caps)
+    result['train_step_ms_exact_bf16'] = (round(float(e2e_exact), 3)
+                                          if e2e_exact else None)
   except Exception as e:                        # never break the headline
     result['train_step_error'] = f'{type(e).__name__}: {e}'[:200]
   print(json.dumps(result))

@@ -1,0 +1,91 @@
+"""Probe: e2e train step on CALIBRATED exact-dedup batches.
+
+Tree-mode fast path processes 938k slots (no dedup); a calibrated map
+batch is ~145k slots — smaller collate gather and smaller model rows,
+at the cost of segment aggregation instead of tree_dense reshapes.
+Device-trace comparison at the bench config.
+"""
+import os
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def run(loader_kw, model_kw, tag, dtype, ds, train_idx):
+  import jax
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import GraphSAGE
+  from graphlearn_tpu.models import train as train_lib
+  loader = glt.loader.NeighborLoader(
+      ds, bench.FANOUT, train_idx, batch_size=bench.BATCH, shuffle=True,
+      drop_last=True, seed=0, seed_labels_only=True, **loader_kw)
+  model = GraphSAGE(hidden_dim=bench.E2E_HIDDEN, out_dim=bench.E2E_CLASSES,
+                    num_layers=len(bench.FANOUT), dtype=dtype, **model_kw)
+  it = iter(loader)
+  first = train_lib.batch_to_dict(next(it))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  step, _ = train_lib.make_train_step(model, tx, bench.E2E_CLASSES)
+  state, loss, _ = step(state, first)
+  for _ in range(2):
+    state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
+  jax.block_until_ready(loss)
+  td = f'/tmp/glt_e2e_{tag}'
+  shutil.rmtree(td, ignore_errors=True)
+  jax.profiler.start_trace(td)
+  losses = []
+  for _ in range(8):
+    state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
+    losses.append(loss)
+  jax.block_until_ready(losses)
+  jax.profiler.stop_trace()
+  progs = glt.utils.device_program_ms(td)
+  tot = sum(ms for ms, _ in progs.values())
+  print(f'{tag:22s} total {tot:7.2f} ms/step')
+  for n, (ms, cnt) in sorted(progs.items(), key=lambda x: -x[1][0])[:4]:
+    print(f'    {ms:8.3f} ms  {n[:64]}')
+  return tot
+
+
+def main():
+  import jax.numpy as jnp
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import train as train_lib
+  glt.utils.enable_compilation_cache()
+  graph = bench.build_graph()
+  rng = np.random.default_rng(2)
+  ds = glt.data.Dataset(graph=graph)
+  ds.init_node_features(rng.standard_normal(
+      (bench.NUM_NODES, bench.E2E_FEAT_DIM), dtype=np.float32))
+  ds.init_node_labels(rng.integers(0, bench.E2E_CLASSES, bench.NUM_NODES))
+  train_idx = rng.integers(0, bench.NUM_NODES, bench.BATCH * 16)
+
+  cal = glt.sampler.estimate_frontier_caps(graph, bench.FANOUT, bench.BATCH,
+                                           num_probes=5, slack=1.5)
+  print('cal caps:', cal)
+  node_offs, edge_offs = train_lib.merge_hop_offsets(
+      bench.BATCH, bench.FANOUT, frontier_caps=cal)
+  print('node_offs:', node_offs, 'edge_offs:', edge_offs)
+
+  # naive segment model on calibrated map batches
+  run(dict(dedup='map', frontier_caps=cal), {}, 'map_cal_naive',
+      jnp.bfloat16, ds, train_idx)
+  # layered segment model (prefix trimming) on calibrated map batches
+  run(dict(dedup='map', frontier_caps=cal),
+      dict(hop_node_offsets=node_offs, hop_edge_offsets=edge_offs),
+      'map_cal_layered', jnp.bfloat16, ds, train_idx)
+  # reference fast path: tree + block + tree_dense
+  no, eo = train_lib.tree_hop_offsets(bench.BATCH, bench.FANOUT)
+  run(dict(dedup='tree', strategy='block'),
+      dict(hop_node_offsets=no, hop_edge_offsets=eo, tree_dense=True,
+           fanouts=tuple(bench.FANOUT)), 'tree_block_dense',
+      jnp.bfloat16, ds, train_idx)
+
+
+if __name__ == '__main__':
+  main()

@@ -109,13 +109,19 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
   rows, cols, edges, emasks = [], [], [], []
   nodes_per_hop = [state.num_nodes]
   edges_per_hop = []
-  from ..sampler.neighbor_sampler import tree_layout_from_caps
-  node_offs, _ = tree_layout_from_caps(caps, fanouts)
+  from ..sampler.neighbor_sampler import (merge_layout_from_caps,
+                                          tree_layout_from_caps)
+  if dedup == 'tree':
+    node_offs, _ = tree_layout_from_caps(caps, fanouts)
+  else:
+    # merge engine: clamped occupancy bound (see _fused_homo_fn)
+    node_offs, _ = merge_layout_from_caps(caps, fanouts)
   for i, k in enumerate(fanouts):
     nbrs, m, e = _exchange_hop(gdev, pb, frontier, fmask, k,
                                hop_keys[i], nparts, with_edge, weighted)
     state, out = induce(state, fidx, nbrs, m, node_offs[i],
-                        final=(i + 1 == len(fanouts)))
+                        final=(i + 1 == len(fanouts)),
+                        max_new=caps[i + 1])
     rows.append(out['cols'])   # message direction: neighbor -> seed
     cols.append(out['rows'])
     emasks.append(out['edge_mask'])

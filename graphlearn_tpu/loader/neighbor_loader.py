@@ -22,12 +22,13 @@ class NeighborLoader(NodeLoader):
                seed: Optional[int] = None,
                node_budget: Optional[int] = None, dedup: str = 'auto',
                padded_window: Optional[int] = None,
-               seed_labels_only: bool = False):
+               seed_labels_only: bool = False,
+               frontier_caps=None):
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
         seed=seed, node_budget=node_budget, dedup=dedup,
-        padded_window=padded_window)
+        padded_window=padded_window, frontier_caps=frontier_caps)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, with_edge, collect_features, to_device,
                      seed, seed_labels_only=seed_labels_only)

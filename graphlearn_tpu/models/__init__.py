@@ -4,4 +4,4 @@ from .hgt import HGT, HGTConv
 from .models import (GAT, GCN, GraphSAGE, HeteroConv, RGNN,
                      TreeGATConv, TreeSAGEConv)
 from .train import (TrainState, batch_to_dict, create_train_state,
-                    make_train_step)
+                    make_train_step, merge_hop_offsets, tree_hop_offsets)

@@ -95,6 +95,28 @@ def tree_hop_offsets(batch_cap: int, fanouts, node_budget=None):
   return tree_layout(batch_cap, list(fanouts), node_budget)  # shared plan
 
 
+def merge_hop_offsets(batch_cap: int, fanouts, node_budget=None,
+                      frontier_caps=None):
+  """(hop_node_offsets, hop_edge_offsets) for the layered forward over
+  exact-dedup ('map'/'sort'/'merge') batches.
+
+  The merge inducer appends each hop's new unique nodes as a contiguous
+  block (prefix widths = cumulative clamped frontier caps) and emits
+  each hop's edges as a contiguous ``caps[i] * k`` block, so the same
+  layer-trimming the tree layout enables applies: layer ``l`` only needs
+  the node prefix reachable in ``L - l`` hops and the edge blocks of
+  hops ``<= L - l``. Exactness holds because dedup expands every node at
+  most once — each target's in-edges live entirely in the single hop
+  block that expanded it (equivalence-tested against the full forward).
+  Delegates to the sampler's capacity plan so the two can never diverge.
+  """
+  from ..sampler.neighbor_sampler import (capacity_plan,
+                                          merge_layout_from_caps)
+  caps = capacity_plan(batch_cap, list(fanouts), node_budget,
+                       frontier_caps)
+  return merge_layout_from_caps(caps, list(fanouts))
+
+
 def make_link_train_step(model, tx):
   """Jitted unsupervised/link-prediction step: dot-product scores on the
   batch's ``edge_label_index`` pairs, sigmoid BCE against ``edge_label``

@@ -28,7 +28,8 @@ from .unique import FILL
 
 
 @functools.partial(jax.jit, static_argnames=('k',))
-def uniform_sample(indptr, indices, seeds, seed_mask, k: int, key):
+def uniform_sample(indptr, indices, seeds, seed_mask, k: int, key,
+                   meta=None):
   """Sample up to ``k`` neighbors per seed.
 
   Args:
@@ -38,6 +39,11 @@ def uniform_sample(indptr, indices, seeds, seed_mask, k: int, key):
     seed_mask: [B] bool validity.
     k: fanout (static).
     key: jax PRNG key.
+    meta: optional [N, 2] (start, degree) row table
+      (``build_csr_meta``). Folds the two indptr ELEMENT gathers into
+      one ROW gather — on TPU both cost ~one HBM transaction per seed,
+      so this halves the row-pointer lookup time (the same trick block
+      mode uses for its metadata).
 
   Returns:
     nbrs:  [B, K] neighbor ids, FILL where invalid.
@@ -47,8 +53,12 @@ def uniform_sample(indptr, indices, seeds, seed_mask, k: int, key):
   """
   b = seeds.shape[0]
   safe_seeds = jnp.where(seed_mask, seeds, 0)
-  start = indptr[safe_seeds]
-  deg = indptr[safe_seeds + 1] - start
+  if meta is not None:
+    row = meta[safe_seeds]
+    start, deg = row[:, 0], row[:, 1]
+  else:
+    start = indptr[safe_seeds]
+    deg = indptr[safe_seeds + 1] - start
   u = jax.random.uniform(key, (b, k))
   rand_off = jnp.floor(u * deg[:, None].astype(u.dtype)).astype(jnp.int32)
   rand_off = jnp.minimum(rand_off, jnp.maximum(deg[:, None] - 1, 0))

@@ -2,6 +2,7 @@ from .base import (BaseSampler, EdgeSamplerInput, HeteroSamplerOutput,
                    NegativeSampling, NeighborOutput, NodeSamplerInput,
                    RemoteNodePathSamplerInput, RemoteSamplerInput,
                    SamplerOutput, SamplingConfig, SamplingType)
+from .calibrate import check_no_overflow, estimate_frontier_caps
 from .negative_sampler import RandomNegativeSampler
 from .neighbor_sampler import (NeighborSampler, hetero_tree_layout,
                                tree_layout)

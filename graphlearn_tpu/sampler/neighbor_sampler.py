@@ -47,9 +47,10 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
     # (ops/induce_map.py), 'sort_legacy' = searchsorted engine
     # (ops/induce.py).
     return ops.init_node_merge, ops.init_empty_merge, \
-        lambda st, fi, nb, m, off, compact=True, final=False: \
+        lambda st, fi, nb, m, off, compact=True, final=False, \
+        max_new=None: \
         ops.induce_next_merge(st, fi, nb, m, prefix_cap=off,
-                              update_view=not final)
+                              max_new=max_new, update_view=not final)
   if mode == 'map_table':
     init = functools.partial(ops.init_node_map,
                              num_graph_nodes=num_graph_nodes)
@@ -61,15 +62,18 @@ def _inducer_for(mode: str, num_graph_nodes: int = 0):
           'ops.init_empty_map before wiring map_table into a typed path')
 
     return init, _no_empty_map, \
-        lambda st, fi, nb, m, off, compact=True, final=False: \
+        lambda st, fi, nb, m, off, compact=True, final=False, \
+        max_new=None: \
         ops.induce_next_map(st, fi, nb, m, compact_frontier=compact)
   if mode == 'sort_legacy':
     return ops.init_node, ops.init_empty, \
-        lambda st, fi, nb, m, off, compact=True, final=False: \
+        lambda st, fi, nb, m, off, compact=True, final=False, \
+        max_new=None: \
         ops.induce_next(st, fi, nb, m)
   assert mode == 'tree', f'unknown dedup mode {mode!r}'
   return ops.init_node_tree, ops.init_empty_tree, \
-      lambda st, fi, nb, m, off, compact=True, final=False: \
+      lambda st, fi, nb, m, off, compact=True, final=False, \
+      max_new=None: \
       ops.induce_next_tree(st, fi, nb, m, offset=off)
 
 
@@ -84,14 +88,29 @@ def _final_touch_map(items, edge_dir):
   return last
 
 
-def capacity_plan(batch_cap: int, fanouts, node_budget=None):
-  """Per-hop frontier capacities [b, c1, ...] with the node_budget
-  clamp — the shared base of every buffer/offset computation below."""
+def capacity_plan(batch_cap: int, fanouts, node_budget=None,
+                  frontier_caps=None):
+  """Per-hop frontier capacities [b, c1, ...] with the node_budget and
+  per-hop frontier_caps clamps — the shared base of every buffer/offset
+  computation below.
+
+  ``frontier_caps[i]`` clamps hop i's post-dedup frontier (and therefore
+  every downstream buffer: the next hop's candidate width, the node
+  buffer, the collate gather). Worst-case static capacities are the
+  single biggest cost of exact-dedup sampling on TPU — real unique
+  counts run ~5x below ``caps[i] * k`` on products-like graphs — so
+  calibrated caps (sampler.calibrate.estimate_frontier_caps) recover
+  most of that factor while staying exact as long as no batch exceeds
+  them; overflow is detectable per batch as
+  ``num_sampled_nodes[i+1] > caps[i+1]``."""
   caps = [batch_cap]
-  for k in fanouts:
+  for i, k in enumerate(fanouts):
     nxt = caps[-1] * k
     if node_budget is not None:
       nxt = min(nxt, node_budget)
+    if frontier_caps is not None and i < len(frontier_caps) and \
+        frontier_caps[i] is not None:
+      nxt = min(nxt, frontier_caps[i])
     caps.append(nxt)
   return caps
 
@@ -107,6 +126,25 @@ def tree_layout_from_caps(caps, fanouts):
     total_e += seg
     edge_offs.append(total_e)
     node_offs.append(node_offs[-1] + seg)
+  return tuple(node_offs), tuple(edge_offs)
+
+
+def merge_layout_from_caps(caps, fanouts):
+  """(prefix_offsets, edge_offsets) of the merge-engine layout for a
+  capacity plan: ``prefix_offsets[i]`` is the CLAMPED max occupancy
+  before hop i (what the inducer needs as ``prefix_cap`` to keep its
+  contiguous append statically safe — the clamped-growth invariant),
+  with the node capacity as the last entry; edge block i is
+  ``caps[i] * k`` wide. The single source of truth for every
+  merge-engine consumer (fused/chained/distributed samplers and
+  models.train.merge_hop_offsets)."""
+  node_offs = [caps[0]]
+  edge_offs = []
+  tot_e = 0
+  for i, k in enumerate(fanouts):
+    tot_e += caps[i] * k
+    edge_offs.append(tot_e)
+    node_offs.append(node_offs[-1] + caps[i + 1])
   return tuple(node_offs), tuple(edge_offs)
 
 
@@ -232,7 +270,13 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
     nodes_per_hop = [state.num_nodes]
     edges_per_hop = []
     keys = jax.random.split(key, len(fanouts))
-    node_offs, _ = tree_layout_from_caps(caps, fanouts)
+    if mode == 'tree':
+      node_offs, _ = tree_layout_from_caps(caps, fanouts)
+    else:
+      # merge engine: prefix = CLAMPED occupancy bound before hop i —
+      # smaller sorts under calibrated plans, and keeps the contiguous
+      # node append statically safe
+      node_offs, _ = merge_layout_from_caps(caps, fanouts)
     for i, k in enumerate(fanouts):
       if padded:
         nbrs, epos, m = ops.uniform_sample_padded(
@@ -245,14 +289,17 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
                                             fmask, k, keys[i])
       else:
+        # deg slot carries the [N, 2] csr_meta row table for plain
+        # uniform sampling (see _fused_args / ops.uniform_sample)
         nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
-                                           fmask, k, keys[i])
+                                           fmask, k, keys[i], meta=deg)
       # the frontier feeds the next hop at caps[i+1] width; when nothing
       # truncates it (no node_budget clamp) the map inducer can emit it
       # positionally and skip two S-element compaction scatters
       compact = (i + 1 < len(caps)) and caps[i + 1] < caps[i] * k
       state, out = induce_fn(state, fidx, nbrs, m, node_offs[i],
-                             compact, final=(i + 1 == len(fanouts)))
+                             compact, final=(i + 1 == len(fanouts)),
+                             max_new=caps[i + 1])
       # message direction: neighbor -> seed
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -276,9 +323,14 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
         seed_inverse=inv)
 
   # distinguishable per-mode trace name (bench.py keys device-trace
-  # events by the jitted program name)
+  # events by the jitted program name); '_capped' marks a clamped
+  # (budget/frontier_caps) capacity plan
+  full = True
+  for i, k in enumerate(fanouts):
+    full = full and caps[i + 1] == caps[i] * k
   fn.__name__ = f'sample_{mode}' + ('_padded' if padded else '') + \
-      ('_block' if block_num_edges else '')
+      ('_block' if block_num_edges else '') + \
+      ('' if full else '_capped')
   fn.__qualname__ = fn.__name__
   return jax.jit(fn)
 
@@ -296,9 +348,14 @@ class NeighborSampler(BaseSampler):
     strategy: 'random' (uniform) — 'weighted' selected via with_weight.
     edge_dir: 'out' (CSR: neighbors = out-edges) or 'in' (CSC).
     seed: PRNG seed.
-    node_budget: optional clamp on any hop's frontier capacity (controls the
-      worst-case padded size; overflow new nodes keep their features/labels
-      but are not expanded further).
+    node_budget: optional clamp on any hop's frontier capacity (controls
+      the worst-case padded size). Under the exact-dedup merge engine,
+      overflow new nodes are truncated cleanly: not stored, not
+      expanded, and edges targeting them are masked out (the legacy
+      engines kept them half-alive past capacity).
+    frontier_caps: per-hop post-dedup frontier capacity clamps — the
+      calibrated-capacity mechanism (capacity_plan /
+      sampler.calibrate.estimate_frontier_caps). Homogeneous only.
   """
 
   def __init__(self, graph: Union[Graph, Dict[EdgeType, Graph]],
@@ -307,7 +364,8 @@ class NeighborSampler(BaseSampler):
                edge_dir: str = 'out', seed: Optional[int] = None,
                node_budget: Optional[int] = None, fused: bool = True,
                dedup: str = 'auto',
-               padded_window: Optional[int] = None):
+               padded_window: Optional[int] = None,
+               frontier_caps=None):
     import jax
     self.graph = graph
     self.num_neighbors = num_neighbors
@@ -317,6 +375,16 @@ class NeighborSampler(BaseSampler):
     self.strategy = strategy
     self.edge_dir = edge_dir
     self.node_budget = node_budget
+    # frontier_caps: per-hop post-dedup frontier capacity clamps — the
+    # calibrated-capacity mechanism (see capacity_plan /
+    # sampler.calibrate). Exact while no batch overflows them.
+    if frontier_caps is not None and isinstance(graph, dict):
+      raise ValueError('frontier_caps is homogeneous-only (the typed '
+                       'engine plans capacities per edge type; clamp '
+                       'seeds via batch_size / hops via node_budget '
+                       'instead)')
+    self.frontier_caps = (tuple(frontier_caps)
+                          if frontier_caps is not None else None)
     # fused=True (default) compiles the whole multi-hop sample into one
     # XLA program — one dispatch per batch, and in-program op fusion. The
     # chained path (fused=False) dispatches each per-op kernel from the
@@ -467,7 +535,18 @@ class NeighborSampler(BaseSampler):
 
   def _homo_capacities(self, batch_cap: int, fanouts) -> List[int]:
     """Frontier capacity per hop (hop 0 = seeds)."""
-    return capacity_plan(batch_cap, fanouts, self.node_budget)
+    return capacity_plan(batch_cap, fanouts, self.node_budget,
+                         self.frontier_caps)
+
+  def hop_caps(self, batch_cap: int) -> List[int]:
+    """Public view of the resolved per-hop frontier capacities — compare
+    ``out.num_sampled_nodes[i+1] > hop_caps[i+1]`` to detect truncation
+    under calibrated frontier_caps (fetch once per epoch, not per
+    batch)."""
+    if self.is_hetero:
+      raise ValueError('hop_caps is homogeneous-only (the typed engine '
+                       'plans capacities per edge type)')
+    return self._homo_capacities(batch_cap, tuple(self.num_neighbors))
 
   def _node_cap(self, caps, fanouts) -> int:
     if self._dedup_mode() == 'tree':
@@ -523,6 +602,22 @@ class NeighborSampler(BaseSampler):
       self._garrs[key] = (ind.reshape(-1, ops.BLOCK), meta)
     return self._garrs[key]
 
+  def _csr_meta(self, etype=None):
+    """Packed [N, 2] (start, degree) row table for uniform sampling —
+    one ROW gather replaces two indptr ELEMENT gathers per frontier
+    (both ~1 HBM transaction/seed on TPU; see ops.uniform_sample)."""
+    import jax.numpy as jnp
+    g = self._get_graph(etype)
+    key = ('csr_meta', id(g))
+    if key not in self._garrs:
+      # int32 everywhere: jnp arrays are 32-bit in this stack anyway
+      # (x64 disabled), which bounds single-shard graphs at 2^31 edges —
+      # beyond that, shard via the distributed engine
+      ptr = jnp.asarray(g.indptr)
+      self._garrs[key] = jnp.stack([ptr[:-1], ptr[1:] - ptr[:-1]],
+                                   axis=1).astype(jnp.int32)
+    return self._garrs[key]
+
   def refresh_padded_table(self, seed: Optional[int] = None):
     """Rebuild the padded adjacency with a fresh shuffle so truncated
     rows (deg > window) sample a NEW random window-subset — call between
@@ -547,7 +642,8 @@ class NeighborSampler(BaseSampler):
       blocks, meta = self._block_arrays()
       return (ga['indptr'], ga['indices'], ga['eids'], cum, blocks,
               meta, None)
-    return ga['indptr'], ga['indices'], ga['eids'], cum, None, None, None
+    return (ga['indptr'], ga['indices'], ga['eids'], cum, None,
+            None if weighted else self._csr_meta(), None)
 
   def _homo_fn(self, batch_cap: int, fanouts):
     sig = ('homo', batch_cap, tuple(fanouts), self.with_edge,
@@ -598,8 +694,12 @@ class NeighborSampler(BaseSampler):
                                            fmask, k, keys[i])
       compact = caps[i + 1] < caps[i] * k   # see _fused_homo_fn note
       state, out = induce_fn(state, fidx, nbrs, m, offset, compact,
-                             final=(i + 1 == len(fanouts)))
-      offset += caps[i] * k
+                             final=(i + 1 == len(fanouts)),
+                             max_new=caps[i + 1])
+      # tree consumes slot bases (full hop widths); merge consumes the
+      # clamped occupancy bound (merge_layout_from_caps)
+      offset += (caps[i] * k if self._dedup_mode() == 'tree'
+                 else caps[i + 1])
       rows.append(out['cols'])
       cols.append(out['rows'])
       emasks.append(out['edge_mask'])

@@ -123,6 +123,43 @@ def test_layered_forward_matches_full():
   assert np.isfinite(float(loss))
 
 
+def test_layered_forward_matches_full_merge_batches():
+  """Layered prefix-trimming on exact-dedup (merge) batches: seed
+  logits identical to the full forward, including under calibrated
+  frontier caps."""
+  import jax
+  from graphlearn_tpu.models import train as train_lib
+  rng = np.random.default_rng(7)
+  n = 300
+  rows = rng.integers(0, n, 3000)
+  cols = rng.integers(0, n, 3000)
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), num_nodes=n, graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 16)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, 4, n))
+  for caps in (None, [40, 72]):
+    loader = glt.loader.NeighborLoader(ds, [3, 2], np.arange(48),
+                                       batch_size=16, seed=0, dedup='map',
+                                       frontier_caps=caps)
+    no, eo = train_lib.merge_hop_offsets(16, [3, 2], frontier_caps=caps)
+    full = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2)
+    layered = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2,
+                                   hop_node_offsets=no,
+                                   hop_edge_offsets=eo)
+    for i, batch in enumerate(loader):
+      b = train_lib.batch_to_dict(batch)
+      if i == 0:
+        params = full.init(jax.random.PRNGKey(0), b['x'],
+                           b['edge_index'], b['edge_mask'])
+      out_full = np.asarray(full.apply(params, b['x'], b['edge_index'],
+                                       b['edge_mask']))
+      out_lay = np.asarray(layered.apply(params, b['x'], b['edge_index'],
+                                         b['edge_mask']))
+      nseed = int(b['num_seed_nodes'])
+      np.testing.assert_allclose(out_full[:nseed], out_lay[:nseed],
+                                 rtol=1e-5, atol=1e-5)
+
+
 def test_bf16_model_path():
   """dtype=bfloat16 models: params stay f32, outputs are bf16, training
   converges on the cluster task, and bf16 outputs track f32 closely."""

@@ -288,11 +288,13 @@ def test_merge_inducer_matches_table_engine():
 
 
 def test_merge_inducer_node_budget_truncates_safely():
-  """Budget-clamped plans overflow the node buffer: the merge engine
-  must scatter-drop past capacity (legacy parity) — never corrupt
-  earlier entries — and in-buffer nodes stay deduplicated."""
+  """Budget-clamped plans can overflow per-hop caps: the merge engine
+  truncates cleanly — num_nodes stays within capacity, earlier entries
+  (seeds included) are never corrupted, in-buffer nodes stay
+  deduplicated, and the raw per-hop new counts still expose the
+  overflow (num_sampled_nodes[i+1] > caps[i+1])."""
   import graphlearn_tpu as glt
-  from graphlearn_tpu.sampler import NodeSamplerInput
+  from graphlearn_tpu.sampler import NodeSamplerInput, check_no_overflow
   rng = np.random.default_rng(5)
   n, e = 200, 1600
   rows, cols = rng.integers(0, n, e), rng.integers(0, n, e)
@@ -305,12 +307,23 @@ def test_merge_inducer_node_budget_truncates_safely():
   node = np.asarray(out.node)
   cap = node.shape[0]
   nn = int(out.num_nodes)
-  valid = node[:min(nn, cap)]
+  assert nn <= cap                       # clamped growth invariant
+  valid = node[:nn]
   valid = valid[valid >= 0]
   assert len(set(valid.tolist())) == len(valid)
+  assert (node[nn:] == -1).all()
   # the seed block survives un-corrupted
   uniq_seeds = sorted(set(seeds.tolist()))
   assert node[:len(uniq_seeds)].tolist() == uniq_seeds
+  # a 15-fanout hop from 32 seeds blows a 24-cap: detectable
+  assert not check_no_overflow(s, out, batch_cap=32)
+  # no mask-valid edge may reference an unstored (truncated) node —
+  # models would silently aggregate clamped-garbage rows otherwise
+  r, c = np.asarray(out.row), np.asarray(out.col)
+  em = np.asarray(out.edge_mask)
+  assert em.any()
+  assert (r[em] < nn).all() and (c[em] < nn).all()
+  assert (r[em] >= 0).all() and (c[em] >= 0).all()
 
 
 # ---------------------------------------------------------------- subgraph
