@@ -145,13 +145,38 @@ def _run_e2e(ds, train_idx, dtype, jax, trace_dir, variant='tree',
   jax.profiler.stop_trace()
   progs = _device_program_ms(trace_dir)
   if not progs:
-    return None
+    return None, None
   # every pipeline program (sample / collate / train_step / bookkeeping)
   # runs exactly once per batch, so ms/step = sum of PER-CALL averages —
   # robust to steps leaking across the trace window on this rig, where
   # block_until_ready returns at dispatch (module docstring); a
   # count-weighted total / E2E_ITERS would not be
-  return sum(ms for ms, _ in progs.values())
+  train_ms = None
+  for n, (ms, _) in progs.items():
+    if n.startswith('jit_train_step'):
+      train_ms = ms
+  return sum(ms for ms, _ in progs.values()), train_ms
+
+
+# v5e peak dense matmul throughput (bf16); MFU below is matmul-FLOPs /
+# device-time / this peak — the aggregation segment ops / gathers are
+# memory ops and carry no model FLOPs under the standard convention
+V5E_PEAK_BF16_TFLOPS = 197.0
+
+
+def _sage_matmul_gflops(layer_rows, feat_dim, hidden, classes):
+  """Analytic matmul FLOPs for one layered-SAGE fwd+bwd+adam step.
+
+  Each SAGEConv layer runs TWO dense matmuls (self + aggregated
+  neighbors) over its node-prefix row count; backward costs ~2x forward
+  (grads w.r.t. inputs + weights). rows are the per-layer prefix widths
+  (widest first), dims follow the bench model config.
+  """
+  dims = [feat_dim] + [hidden] * (len(layer_rows) - 1)
+  outs = [hidden] * (len(layer_rows) - 1) + [classes]
+  fwd = sum(2 * r * di * do * 2
+            for r, di, do in zip(layer_rows, dims, outs))
+  return 3 * fwd / 1e9
 
 
 def main():
@@ -268,20 +293,47 @@ def main():
     ds.init_node_labels(labels)
     n_seeds = BATCH * (E2E_ITERS + 4)
     train_idx = frng.integers(0, NUM_NODES, n_seeds)
-    e2e_f32 = _run_e2e(ds, train_idx, None, jax, '/tmp/glt_bench_e2e_f32')
-    e2e_bf16 = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
-                        '/tmp/glt_bench_e2e_bf16')
+    e2e_f32, _ = _run_e2e(ds, train_idx, None, jax,
+                          '/tmp/glt_bench_e2e_f32')
+    e2e_bf16, tr_bf16 = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
+                                 '/tmp/glt_bench_e2e_bf16')
     result['train_step_ms_f32'] = (round(float(e2e_f32), 3)
                                    if e2e_f32 else None)
     result['train_step_ms_bf16'] = (round(float(e2e_bf16), 3)
                                     if e2e_bf16 else None)
     # reference-semantics e2e: calibrated exact dedup + prefix-layered
     # segment model (smaller buffers beat tree_dense at this scale)
-    e2e_exact = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
-                         '/tmp/glt_bench_e2e_exact', variant='exact',
-                         cal_caps=cal_caps)
+    e2e_exact, tr_exact = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
+                                   '/tmp/glt_bench_e2e_exact',
+                                   variant='exact', cal_caps=cal_caps)
     result['train_step_ms_exact_bf16'] = (round(float(e2e_exact), 3)
                                           if e2e_exact else None)
+
+    # ---- MFU / FLOP accounting (driver's perf lens; PERF.md roofline)
+    from graphlearn_tpu.models import train as train_lib
+    no_t, _ = train_lib.tree_hop_offsets(BATCH, FANOUT)
+    no_e, _ = train_lib.merge_hop_offsets(BATCH, FANOUT,
+                                          frontier_caps=cal_caps)
+    # layer l transforms the prefix of sources it aggregates from:
+    # widest prefix first (PERF.md 'layered forward')
+    g_tree = _sage_matmul_gflops([no_t[-1], no_t[-2], no_t[-3]],
+                                 E2E_FEAT_DIM, E2E_HIDDEN, E2E_CLASSES)
+    g_exact = _sage_matmul_gflops([no_e[-1], no_e[-2], no_e[-3]],
+                                  E2E_FEAT_DIM, E2E_HIDDEN, E2E_CLASSES)
+    result['model_gflops_per_step_tree'] = round(g_tree, 1)
+    result['model_gflops_per_step_exact'] = round(g_exact, 1)
+    if e2e_bf16:
+      tf = g_tree / e2e_bf16  # GFLOP / ms == TFLOP/s
+      result['model_tflops_per_sec_bf16'] = round(tf, 2)
+      result['mfu_pct_bf16'] = round(100 * tf / V5E_PEAK_BF16_TFLOPS, 2)
+      if tr_bf16:
+        result['mfu_pct_train_program_bf16'] = round(
+            100 * g_tree / tr_bf16 / V5E_PEAK_BF16_TFLOPS, 2)
+    if e2e_exact:
+      tf = g_exact / e2e_exact
+      result['model_tflops_per_sec_exact_bf16'] = round(tf, 2)
+      result['mfu_pct_exact_bf16'] = round(
+          100 * tf / V5E_PEAK_BF16_TFLOPS, 2)
   except Exception as e:                        # never break the headline
     result['train_step_error'] = f'{type(e).__name__}: {e}'[:200]
   print(json.dumps(result))

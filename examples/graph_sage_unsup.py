@@ -60,16 +60,22 @@ def main():
       0.5 * rng.standard_normal((n, 64)).astype(np.float32)
 
   # hold 10% of edges out of BOTH the graph and the training supervision
-  # so the reported link accuracy is on genuinely unseen pairs; dedupe
-  # (u, v) pairs first — sampling with replacement would otherwise leave
-  # a held-out edge's twin in the training graph
-  uniq = np.unique(rows.astype(np.int64) * n + cols)
+  # so the reported link accuracy is on genuinely unseen pairs. Split on
+  # CANONICAL UNDIRECTED pairs — a directed-only dedup would leave a
+  # held-out edge's reverse twin (v, u) in the training graph, leaking
+  # structure into the test metric — then re-emit BOTH directions of the
+  # retained pairs (a lo->hi-only graph would be a DAG where high-id
+  # nodes have no out-neighbors to sample).
+  lo = np.minimum(rows, cols).astype(np.int64)
+  hi = np.maximum(rows, cols).astype(np.int64)
+  uniq = np.unique(lo * n + hi)
   rows = (uniq // n).astype(np.int32)
   cols = (uniq % n).astype(np.int32)
   e = rows.shape[0]
   perm = rng.permutation(e)
   tr_idx, te_idx = perm[: int(e * 0.9)], perm[int(e * 0.9):]
-  g_rows, g_cols = rows[tr_idx], cols[tr_idx]
+  g_rows = np.concatenate([rows[tr_idx], cols[tr_idx]])
+  g_cols = np.concatenate([cols[tr_idx], rows[tr_idx]])
 
   ds = glt.data.Dataset()
   ds.init_graph(np.stack([g_rows, g_cols]), num_nodes=n, graph_mode='HBM')

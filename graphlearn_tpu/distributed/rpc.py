@@ -15,14 +15,23 @@ RpcCalleeBase (reference rpc.py:371-473), barrier/all_gather
 (rpc.py:109-233).
 
 TRUST MODEL: frames are deserialized with pickle, so anyone who can
-connect can execute arbitrary code — identical to the reference's
-torch-RPC posture (TensorPipe performs no authentication either). Deploy
-only on trusted, isolated cluster networks. The default bind is loopback;
-when passing a routable ``master_addr``, the network boundary (VPC /
-firewall / pod network policy) IS the security boundary.
+connect can execute arbitrary code — the reference's torch-RPC posture
+(TensorPipe performs no authentication either). This stack removes the
+sharpest edge with a shared-secret HMAC handshake: set ``GLT_RPC_SECRET``
+in the environment (or pass ``secret=``) and every accepted connection
+must answer an HMAC-SHA256 challenge before any frame is processed.
+The handshake is REQUIRED for non-loopback binds (a routable server
+without a secret refuses to start unless ``insecure=True``); loopback
+binds may omit it for parity with local multiprocess use. The network
+boundary (VPC / firewall) remains the outer wall — the handshake
+authenticates peers, it does not encrypt traffic.
 """
+import hashlib
+import hmac
 import logging
+import os
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import struct
@@ -33,6 +42,16 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 logger = logging.getLogger('graphlearn_tpu.rpc')
 
 _HDR = struct.Struct('<Q')
+_SECRET_ENV = 'GLT_RPC_SECRET'
+
+
+def _env_secret() -> Optional[bytes]:
+  s = os.environ.get(_SECRET_ENV)
+  return s.encode() if s else None
+
+
+def _hmac_of(secret: bytes, nonce: bytes) -> bytes:
+  return hmac.new(secret, nonce, hashlib.sha256).digest()
 
 
 def _send_frame(sock: socket.socket, obj: Any):
@@ -67,11 +86,20 @@ class RpcServer:
   """Threaded socket server dispatching registered callees."""
 
   def __init__(self, host: str = '127.0.0.1', port: int = 0,
-               handlers: Optional[Dict[str, Callable]] = None):
+               handlers: Optional[Dict[str, Callable]] = None,
+               secret: Optional[bytes] = None, insecure: bool = False):
     # handlers passed here are registered BEFORE the server starts
     # accepting — register() after construction races incoming requests
     self._handlers: Dict[str, Callable] = dict(handlers) if handlers \
         else {}
+    self._secret = secret if secret is not None else _env_secret()
+    loopback = host in ('127.0.0.1', 'localhost', '::1')
+    if self._secret is None and not loopback and not insecure:
+      raise ValueError(
+          f'RpcServer binding routable address {host!r} without a '
+          f'shared secret: set {_SECRET_ENV} (or pass secret=) so peers '
+          'must pass the HMAC handshake, or pass insecure=True to '
+          'accept unauthenticated pickle RPC on this network')
     outer = self
 
     class Handler(socketserver.BaseRequestHandler):
@@ -79,6 +107,17 @@ class RpcServer:
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
+          if outer._secret is not None:
+            # challenge-response BEFORE any pickle leaves the wire:
+            # an unauthenticated peer never reaches the deserializer
+            nonce = _secrets.token_bytes(32)
+            sock.sendall(nonce)
+            answer = _recv_exact(sock, 32)
+            if not hmac.compare_digest(
+                answer, _hmac_of(outer._secret, nonce)):
+              logger.warning('rejected RPC connection from %s: bad '
+                             'HMAC handshake', self.client_address)
+              return
           while True:
             req = _recv_frame(sock)
             try:
@@ -118,10 +157,12 @@ class RpcServer:
 class RpcClient:
   """Per-target connection pool + sync/async requests."""
 
-  def __init__(self, max_workers: int = 8):
+  def __init__(self, max_workers: int = 8,
+               secret: Optional[bytes] = None):
     self._pool = ThreadPoolExecutor(max_workers=max_workers)
     self._local = threading.local()
     self._addrs: Dict[int, Tuple[str, int]] = {}
+    self._secret = secret if secret is not None else _env_secret()
 
   def add_target(self, rank: int, host: str, port: int):
     self._addrs[rank] = (host, port)
@@ -137,6 +178,22 @@ class RpcClient:
     if rank not in conns:
       s = socket.create_connection(self._addrs[rank], timeout=180)
       s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+      if self._secret is not None:
+        # answer the server's HMAC challenge (see module trust model).
+        # Short timeout on the nonce read: a secret-less server sends
+        # no challenge, and without this the config mismatch would hang
+        # for the full 180 s socket timeout with a generic error.
+        s.settimeout(10)
+        try:
+          nonce = _recv_exact(s, 32)
+        except socket.timeout:
+          s.close()
+          raise ConnectionError(
+              'server sent no HMAC challenge within 10s — secret '
+              f'configured on this client (via {_SECRET_ENV} or '
+              'secret=) but probably not on the server') from None
+        s.settimeout(180)
+        s.sendall(_hmac_of(self._secret, nonce))
       conns[rank] = s
     return conns[rank]
 

@@ -154,10 +154,16 @@ class NodeLoader:
             continue
           if self.seed_labels_only:
             # supervision reads seed slots only, and seeds lead the
-            # INPUT type's buffer; other types carry no seed block
+            # INPUT type's buffer; other types carry no seed block.
+            # Slice by the ENGINE's actual seed cap (out.batch carries
+            # the padded seed block) — the hetero engine rounds seed
+            # caps up, so batch_size alone could misalign labels
             if t != out.input_type:
               continue
-            buf = buf[:self.batch_size]
+            cap = (out.batch[t].shape[0]
+                   if out.batch is not None and t in out.batch
+                   else self.batch_size)
+            buf = buf[:cap]
           y[t] = ops.gather_rows(labels, None, buf)
       return to_hetero_data(out, x, y)
 

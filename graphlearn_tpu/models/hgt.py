@@ -41,6 +41,11 @@ class HGTConv(nn.Module):
   metadata: Tuple[Sequence[NodeType], Sequence[EdgeType]]
   heads: int = 4
   dtype: Any = None
+  # per-type input widths for types ABSENT from a batch: lets the dummy
+  # param materialization (below) match the real kernel shapes when
+  # in-dims differ from out_dim. Inside the HGT stack every conv input
+  # is hidden_dim == out_dim, so the default suffices there.
+  in_dims: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict):
@@ -54,6 +59,19 @@ class HGTConv(nn.Module):
     v = {}
     for t in ntypes:
       if t not in x_dict:
+        # absent node type: still materialize its params (k/q/v here,
+        # a/skip below) so the param STRUCTURE never depends on batch
+        # content — flax requires an identical tree across calls, and a
+        # type first seen at apply time would otherwise miss params.
+        # Dummy width: in_dims[t] when provided, else out_dim — the HGT
+        # stack invariant (conv inputs are the hidden dim). Standalone
+        # users whose in-dims differ from out_dim must pass in_dims
+        # (or provide every metadata type at init).
+        w = (self.in_dims or {}).get(t, self.out_dim)
+        dummy = jnp.zeros((1, w), self.dtype or jnp.float32)
+        for proj in ('k', 'q', 'v'):
+          nn.Dense(self.out_dim, dtype=self.dtype,
+                   name=f'{proj}_{t}')(dummy)
         continue
       x = x_dict[t]
       if self.dtype is not None:
@@ -109,7 +127,13 @@ class HGTConv(nn.Module):
           tgt, num_segments=n_dst + 1)[:n_dst]
 
     out = {}
-    for t in k:
+    for t in ntypes:
+      if t not in k:
+        # absent type: params only (see the k/q/v note above)
+        nn.Dense(self.out_dim, dtype=self.dtype, name=f'a_{t}')(
+            jnp.zeros((1, self.out_dim), self.dtype or jnp.float32))
+        self.param(f'skip_{t}', nn.initializers.ones, ())
+        continue
       n = agg[t].shape[0]
       a = nn.Dense(self.out_dim, dtype=self.dtype, name=f'a_{t}')(
           nn.gelu(agg[t].reshape(n, self.out_dim)))
@@ -143,6 +167,10 @@ class HGT(nn.Module):
   dtype: Any = None
   hop_node_offsets: Any = None
   hop_edge_offsets: Any = None
+  # per-type RAW feature widths: when given, the input Dense lin_{t} is
+  # materialized for every ntype even if absent from the init batch, so
+  # the param tree never depends on batch content (see HGTConv.in_dims)
+  in_dims: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
@@ -157,6 +185,14 @@ class HGT(nn.Module):
                                   name=f'lin_{t}')(
         x.astype(self.dtype) if self.dtype is not None else x))
         for t, x in x_dict.items()}
+    if self.in_dims:
+      # absent-type lin params (batch-independent param tree; the conv
+      # layers handle their own absent-type params via HGTConv)
+      for t in self.ntypes:
+        if t not in x_dict and t in self.in_dims:
+          nn.Dense(self.hidden_dim, dtype=self.dtype, name=f'lin_{t}')(
+              jnp.zeros((1, self.in_dims[t]),
+                        self.dtype or jnp.float32))
     meta = (tuple(self.ntypes), tuple(tuple(e) for e in self.etypes))
     for i in range(self.num_layers):
       if hier:

@@ -455,16 +455,23 @@ class NeighborSampler(BaseSampler):
     return jax.random.fold_in(self._key, self._call_count)
 
   def state_dict(self):
-    """The fold_in counter is the whole PRNG state (base key is derived
-    from the constructor seed, which the restoring loader re-supplies)."""
-    return {'call_count': int(self._call_count)}
+    """fold_in counter + the base key itself. Serializing the key (not
+    just the counter) makes restores exact even when the sampler was
+    constructed with seed=None (random base key) — a counter-only
+    restore would silently replay a different sampling stream."""
+    return {'call_count': int(self._call_count),
+            'base_key': np.asarray(self._key).tolist()}
 
   def load_state_dict(self, state):
+    import jax.numpy as jnp
     if 'call_count' not in state:
       raise ValueError(
           f'checkpoint sampler state {sorted(state)} was written by a '
           'different sampler type; resuming would diverge')
     self._call_count = int(state['call_count'])
+    if 'base_key' in state:
+      self._key = jnp.asarray(np.asarray(state['base_key'],
+                                         dtype=np.uint32))
 
   def _get_graph(self, etype: Optional[EdgeType] = None) -> Graph:
     return self.graph[etype] if self.is_hetero else self.graph

@@ -160,6 +160,36 @@ def test_layered_forward_matches_full_merge_batches():
                                  rtol=1e-5, atol=1e-5)
 
 
+def test_hgt_param_structure_batch_independent():
+  """HGTConv materializes per-node-type params for EVERY metadata type,
+  so a type absent at init but present at a later apply (or vice versa)
+  neither fails nor changes the param tree."""
+  import jax
+  import jax.numpy as jnp
+  from graphlearn_tpu.models.hgt import HGTConv
+  ntypes = ['a', 'b']
+  etypes = [('a', 'r', 'b')]
+  conv = HGTConv(out_dim=8, metadata=(ntypes, etypes), heads=2)
+  ei = jnp.zeros((2, 4), jnp.int32)
+  em = jnp.ones((4,), bool)
+  # init WITHOUT type 'a' present
+  params = conv.init(jax.random.PRNGKey(0),
+                     {'b': jnp.ones((3, 8))},
+                     {}, {})
+  # apply WITH both types — params for 'a' must already exist
+  out = conv.apply(params, {'a': jnp.ones((2, 8)),
+                            'b': jnp.ones((3, 8))},
+                   {('a', 'r', 'b'): ei}, {('a', 'r', 'b'): em})
+  assert set(out) == {'a', 'b'}
+  # param tree identical when initialized with the full dict
+  params2 = conv.init(jax.random.PRNGKey(0),
+                      {'a': jnp.ones((2, 8)), 'b': jnp.ones((3, 8))},
+                      {('a', 'r', 'b'): ei}, {('a', 'r', 'b'): em})
+  t1 = jax.tree_util.tree_structure(params)
+  t2 = jax.tree_util.tree_structure(params2)
+  assert t1 == t2
+
+
 def test_bf16_model_path():
   """dtype=bfloat16 models: params stay f32, outputs are bf16, training
   converges on the cluster task, and bf16 outputs track f32 closely."""

@@ -43,6 +43,47 @@ def test_rpc_roundtrip():
   server.shutdown()
 
 
+def test_rpc_hmac_handshake():
+  """Shared-secret HMAC challenge: authenticated clients round-trip,
+  unauthenticated / wrong-secret clients never reach the deserializer,
+  and a routable bind without a secret refuses to start."""
+  from graphlearn_tpu.distributed import RpcClient, RpcServer
+  server = RpcServer(secret=b'sesame')
+  server.register('add', lambda a, b: a + b)
+
+  good = RpcClient(secret=b'sesame')
+  good.add_target(0, server.host, server.port)
+  assert good.request_sync(0, 'add', 2, 3) == 5
+  good.close()
+
+  # no secret: server sends a challenge the client never answers — the
+  # server closes, the request errors out (never executes)
+  calls = []
+  server.register('probe', lambda: calls.append(1))
+  bad = RpcClient()
+  bad.add_target(0, server.host, server.port)
+  with pytest.raises((TimeoutError, RuntimeError)):
+    bad.request_sync(0, 'probe', timeout=2.0)
+  bad.close()
+
+  # wrong secret: rejected at the handshake
+  wrong = RpcClient(secret=b'wrong')
+  wrong.add_target(0, server.host, server.port)
+  with pytest.raises((TimeoutError, RuntimeError)):
+    wrong.request_sync(0, 'probe', timeout=2.0)
+  wrong.close()
+  assert not calls
+  server.shutdown()
+
+  # routable bind without a secret is refused by default
+  import unittest.mock as mock
+  with mock.patch.dict('os.environ', {}, clear=False):
+    import os
+    os.environ.pop('GLT_RPC_SECRET', None)
+    with pytest.raises(ValueError, match='routable'):
+      RpcServer(host='0.0.0.0')
+
+
 def test_mp_dist_neighbor_loader():
   ds = make_dataset()
   loader = glt.distributed.MpDistNeighborLoader(
