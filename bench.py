@@ -334,6 +334,9 @@ def main():
       result['model_tflops_per_sec_exact_bf16'] = round(tf, 2)
       result['mfu_pct_exact_bf16'] = round(
           100 * tf / V5E_PEAK_BF16_TFLOPS, 2)
+      if tr_exact:
+        result['mfu_pct_train_program_exact_bf16'] = round(
+            100 * g_exact / tr_exact / V5E_PEAK_BF16_TFLOPS, 2)
   except Exception as e:                        # never break the headline
     result['train_step_error'] = f'{type(e).__name__}: {e}'[:200]
   print(json.dumps(result))

@@ -1,0 +1,66 @@
+"""Accuracy/epoch-time matrix across sampling modes (VERDICT r2 item 3).
+
+Runs the products gate (examples/train_sage_ogbn_products.py, now tuned
+to plateau in the discriminative 0.70-0.85 band: p_intra=0.58,
+feat_snr=0.1) under every sampling mode at IDENTICAL budgets, one
+subprocess per mode (clean device state; the XLA compile cache is
+shared), and prints a table for PERF.md.
+
+Run: python benchmarks/accuracy_matrix.py [--num-nodes N] [--epochs E]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+EXAMPLE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'examples', 'train_sage_ogbn_products.py')
+
+MODES = [
+    ('exact (map+calibrated)', ['--dedup', 'map', '--calibrate']),
+    ('tree', ['--dedup', 'tree']),
+    ('tree+block', ['--dedup', 'tree', '--strategy', 'block']),
+    ('padded16', ['--dedup', 'tree', '--padded-window', '16']),
+    ('padded64', ['--dedup', 'tree', '--padded-window', '64']),
+]
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-nodes', type=int, default=2_449_029)
+  ap.add_argument('--epochs', type=int, default=4)
+  ap.add_argument('--eval-batches', type=int, default=100)
+  args = ap.parse_args()
+
+  rows = []
+  for name, extra in MODES:
+    cmd = [sys.executable, EXAMPLE, '--num-nodes', str(args.num_nodes),
+           '--epochs', str(args.epochs), '--eval-batches',
+           str(args.eval_batches), '--bf16-model'] + extra
+    print(f'# running {name}: {" ".join(cmd)}', flush=True)
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    line = None
+    for ln in out.stdout.splitlines():
+      if ln.startswith('{'):
+        line = json.loads(ln)
+    if line is None:
+      print(f'# {name} FAILED:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}')
+      rows.append((name, None))
+      continue
+    rows.append((name, line))
+    print(f'# {name}: test_acc={line["test_acc"]} '
+          f'epoch_s={line["epoch_time_s"]}', flush=True)
+
+  print('\n| mode | test acc | final train acc | epoch wall s |')
+  print('|---|---|---|---|')
+  for name, r in rows:
+    if r is None:
+      print(f'| {name} | FAILED | - | - |')
+    else:
+      print(f'| {name} | {r["test_acc"]:.4f} | {r["final_train_acc"]:.4f}'
+            f' | {r["epoch_time_s"]} |')
+
+
+if __name__ == '__main__':
+  main()

@@ -93,8 +93,8 @@ def main():
   ap.add_argument('--lr', type=float, default=3e-3)
   ap.add_argument('--num-nodes', type=int, default=2_449_029)
   ap.add_argument('--avg-deg', type=int, default=25)
-  ap.add_argument('--feat-snr', type=float, default=0.4)
-  ap.add_argument('--p-intra', type=float, default=0.85)
+  ap.add_argument('--feat-snr', type=float, default=0.1)
+  ap.add_argument('--p-intra', type=float, default=0.58)
   ap.add_argument('--eval-batches', type=int, default=200,
                   help='cap on test batches (full test split is 90%% of '
                        'the graph; the reference evaluates it all, cap '
@@ -104,10 +104,20 @@ def main():
                   help='bf16 compute in the convs (MXU at 2x f32 rate); '
                        'params/optimizer/loss stay f32')
   ap.add_argument('--dedup', default='tree',
-                  choices=['auto', 'map', 'sort', 'tree'],
+                  choices=['auto', 'map', 'sort', 'merge', 'map_table',
+                           'sort_legacy', 'tree'],
                   help="batch construction: 'map' = reference-parity "
-                       "exact dedup; 'tree' (default) = computation-tree "
-                       "batches, 4x faster sampling on TPU (PERF.md)")
+                       "exact dedup (merge-sort engine); 'tree' "
+                       '(default) = computation-tree batches (PERF.md)')
+  ap.add_argument('--padded-window', type=int, default=None,
+                  help='dense [N, W] padded adjacency sampling (rows '
+                       'with deg > W sample a fixed W-subset; fastest '
+                       'hops, disclosed truncation bias — PERF.md)')
+  ap.add_argument('--calibrate', action='store_true',
+                  help='estimate per-hop frontier caps from a numpy '
+                       'probe simulation and run exact dedup with '
+                       'calibrated buffers (PERF.md round 3); implies '
+                       'the layered merge forward')
   ap.add_argument('--node-budget', type=int, default=None,
                   help='clamp any hop frontier to this many nodes: '
                        'shrinks the padded batch buffers (and so the '
@@ -145,10 +155,26 @@ def main():
   ds.init_node_labels(label)
   print(f'# dataset built in {time.time()-t0:.1f}s', flush=True)
 
+  cal_caps = None
+  if args.calibrate:
+    if args.dedup in ('tree', 'map_table', 'sort_legacy'):
+      # calibrated caps are post-dedup sizes — only the merge-engine
+      # exact modes consume them (the sampler rejects tree+caps)
+      print(f"# --calibrate implies exact dedup; switching --dedup "
+            f"{args.dedup} -> map", flush=True)
+      args.dedup = 'map'
+    t0 = time.time()
+    cal_caps = glt.sampler.estimate_frontier_caps(
+        ds.graph, args.fanout, args.batch_size, input_nodes=train_idx,
+        num_probes=5, slack=1.5)
+    print(f'# calibrated frontier caps {cal_caps} in '
+          f'{time.time()-t0:.1f}s', flush=True)
+
   loader = glt.loader.NeighborLoader(
       ds, args.fanout, train_idx, batch_size=args.batch_size, shuffle=True,
       drop_last=True, seed=0, dedup=args.dedup, strategy=args.strategy,
-      node_budget=args.node_budget)
+      node_budget=args.node_budget, padded_window=args.padded_window,
+      frontier_caps=cal_caps)
 
   depth = len(args.fanout)
   mdtype = jnp.bfloat16 if args.bf16_model else None
@@ -165,7 +191,16 @@ def main():
                       hop_edge_offsets=eo, dtype=mdtype,
                       tree_dense=args.node_budget is None,
                       fanouts=tuple(args.fanout))
+  elif args.dedup in ('auto', 'map', 'sort', 'merge'):
+    # exact-dedup batches support the same layered trimming via the
+    # merge layout (prefix-contiguous hop blocks; PERF.md round 3)
+    no, eo = train_lib.merge_hop_offsets(args.batch_size, args.fanout,
+                                         args.node_budget, cal_caps)
+    model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
+                      num_layers=depth, hop_node_offsets=no,
+                      hop_edge_offsets=eo, dtype=mdtype)
   else:
+    # legacy bisection engines: full (un-layered) forward
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
                       num_layers=depth, dtype=mdtype)
   first = train_lib.batch_to_dict(next(iter(loader)))
@@ -189,7 +224,8 @@ def main():
   test_loader = glt.loader.NeighborLoader(
       ds, args.fanout, test_idx, batch_size=args.batch_size, shuffle=False,
       drop_last=False, seed=1, dedup=args.dedup, strategy=args.strategy,
-      node_budget=args.node_budget)
+      node_budget=args.node_budget, padded_window=args.padded_window,
+      frontier_caps=cal_caps)
   correct = total = None
   t0 = time.perf_counter()
   for i, batch in enumerate(test_loader):

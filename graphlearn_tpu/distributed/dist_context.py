@@ -57,22 +57,40 @@ def get_context() -> Optional[DistContext]:
   return _dist_context
 
 
+def _build_mesh(devs, nparts: int, mesh_shape=None):
+  """Flat ('g',) mesh, or a 2-axis ('slice', 'chip') mesh when
+  ``mesh_shape=(S, C)`` is given (S*C == nparts). Device order is kept,
+  so consecutive groups of C devices form one slice — on a pod that is
+  one ICI domain, and the 'chip' axis collectives ride ICI while
+  'slice' crosses DCN. The samplers run unchanged on either layout
+  (collectives/specs use the full axis tuple)."""
+  from jax.sharding import Mesh
+  if mesh_shape is None:
+    return Mesh(np.array(devs[:nparts]), ('g',))
+  s, c = mesh_shape
+  if s * c != nparts:
+    raise ValueError(f'mesh_shape {mesh_shape} != num_partitions '
+                     f'{nparts}')
+  return Mesh(np.array(devs[:nparts]).reshape(s, c), ('slice', 'chip'))
+
+
 def init_worker_group(world_size: int = 1, rank: int = 0,
                       group_name: str = 'worker',
                       num_partitions: Optional[int] = None,
-                      devices=None):
+                      devices=None, mesh_shape=None):
   """Create the worker context + graph mesh
   (reference: dist_context.py:169-183).
 
   ``num_partitions`` defaults to the device count: one graph partition per
   chip, the TPU analog of one partition per worker process.
+  ``mesh_shape=(slices, chips)`` builds the 2-axis multi-slice mesh
+  instead of the flat 'g' axis (see _build_mesh).
   """
   global _dist_context
   import jax
-  from jax.sharding import Mesh
   devs = list(devices) if devices is not None else jax.devices()
   nparts = num_partitions or len(devs)
-  mesh = Mesh(np.array(devs[:nparts]), ('g',))
+  mesh = _build_mesh(devs, nparts, mesh_shape)
   _dist_context = DistContext(world_size, rank, DistRole.WORKER,
                               group_name, nparts, mesh)
   return _dist_context
@@ -82,7 +100,8 @@ def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None,
                    group_name: str = 'worker',
-                   num_partitions: Optional[int] = None):
+                   num_partitions: Optional[int] = None,
+                   mesh_shape=None):
   """Multi-host worker context: initialize the JAX distributed runtime and
   build ONE GLOBAL mesh spanning every process's devices.
 
@@ -116,7 +135,12 @@ def init_multihost(coordinator_address: Optional[str] = None,
         f'{len(procs_in_mesh)}/{jax.process_count()} processes; use a '
         'multiple of the per-process device count (or omit it) so every '
         'host participates in the mesh')
-  mesh = Mesh(np.array(mesh_devs), ('g',))
+  # default multi-slice layout: one slice per process (jax.devices()
+  # orders by process, so each process's devices form one 'chip' row —
+  # the ICI domain on a pod, the per-process group on the CPU harness)
+  if mesh_shape == 'per_process':
+    mesh_shape = (jax.process_count(), nparts // jax.process_count())
+  mesh = _build_mesh(devs, nparts, mesh_shape)
   _dist_context = DistContext(jax.process_count(), jax.process_index(),
                               DistRole.WORKER, group_name, nparts, mesh)
   return _dist_context

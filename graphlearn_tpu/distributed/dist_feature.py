@@ -59,7 +59,7 @@ class DistFeature:
     if self._dev is None:
       from jax.sharding import NamedSharding, PartitionSpec as P
       from ..utils import global_device_put
-      shard = NamedSharding(self.mesh, P('g'))
+      shard = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
       repl = NamedSharding(self.mesh, P())
       self._dev = dict(
           feat_ids=global_device_put(self.feat_ids, shard),
@@ -79,6 +79,9 @@ class DistFeature:
     dev = self.device_arrays()
     fdim = self.feature_dim
     fdtype = self.feats.dtype
+    # collectives/specs over every mesh axis: works identically on the
+    # flat ('g',) mesh and a 2-axis ('slice', 'chip') mesh
+    ax = tuple(self.mesh.axis_names)
 
     def body(feat_ids, feats, pb, ids, mask):
       # per-shard views: feat_ids [1, n], feats [1, n, F], ids [1, b]
@@ -87,21 +90,21 @@ class DistFeature:
       dest = jnp.where(mask, pb[jnp.maximum(ids, 0)], nparts)
       slot, ok = ops.route_slots(dest, mask, capacity=b)
       send = ops.scatter_to_buckets(ids, dest, slot, ok, nparts, b)
-      req = jax.lax.all_to_all(send, 'g', 0, 0)           # [P, b] requests
+      req = jax.lax.all_to_all(send, ax, 0, 0)            # [P, b] requests
       flat = req.reshape(-1)
       pos = jnp.clip(jnp.searchsorted(feat_ids, flat), 0,
                      feat_ids.shape[0] - 1)
       found = feat_ids[pos] == flat
       rows = jnp.where(found[:, None], feats[pos], 0)
       rows = rows.reshape(nparts, b, fdim)
-      resp = jax.lax.all_to_all(rows, 'g', 0, 0)          # [P, b] responses
+      resp = jax.lax.all_to_all(rows, ax, 0, 0)           # [P, b] responses
       out = ops.gather_from_buckets(resp, dest, slot, ok, fill=0)
       return out.astype(fdtype)[None]
 
     fn = shard_map(
         body, mesh=self.mesh,
-        in_specs=(P('g'), P('g'), P(), P('g'), P('g')),
-        out_specs=P('g'))
+        in_specs=(P(ax), P(ax), P(), P(ax), P(ax)),
+        out_specs=P(ax))
     jfn = jax.jit(fn)
     return lambda ids, mask: jfn(dev['feat_ids'], dev['feats'],
                                  dev['feature_pb'], ids, mask)

@@ -137,12 +137,13 @@ class DistGraph:
     return self.edge_pb[np.asarray(eids)]
 
   def device_arrays(self, mesh):
-    """Place the stacked arrays on the mesh: leading axis sharded over 'g',
-    partition book replicated. Works on multi-host meshes (only this
-    process's shards are placed — utils.global_device_put)."""
+    """Place the stacked arrays on the mesh: leading axis sharded over
+    every mesh axis (flat 'g' or 2-axis ('slice', 'chip')), partition
+    book replicated. Works on multi-host meshes (only this process's
+    shards are placed — utils.global_device_put)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..utils import global_device_put
-    shard = NamedSharding(mesh, P('g'))
+    shard = NamedSharding(mesh, P(tuple(mesh.axis_names)))
     repl = NamedSharding(mesh, P())
     out = dict(
         row_ids=global_device_put(self.row_ids, shard),

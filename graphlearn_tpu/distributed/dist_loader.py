@@ -321,7 +321,8 @@ class DistLinkNeighborLoader(DistLoader):
                neg_sampling=None, with_edge: bool = False,
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
-               with_weight: bool = False, dedup: str = 'sort'):
+               with_weight: bool = False, dedup: str = 'sort',
+               bucket_frac=2.0):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -341,7 +342,7 @@ class DistLinkNeighborLoader(DistLoader):
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
-        with_weight=with_weight, dedup=dedup)
+        with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac)
     super().__init__(data, sampler, np.zeros(0, np.int64), batch_size,
                      shuffle, drop_last, collect_features, seed)
     self.input_type = input_type  # EdgeType for hetero link sampling
@@ -373,7 +374,8 @@ class DistSubGraphLoader(DistLoader):
                batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = True, with_edge: bool = False,
                collect_features: bool = True, seed: Optional[int] = None,
-               max_degree: Optional[int] = None, mesh=None):
+               max_degree: Optional[int] = None, mesh=None,
+               bucket_frac=2.0):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -381,7 +383,7 @@ class DistSubGraphLoader(DistLoader):
     sampler = DistNeighborSampler(
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
-        collect_features=collect_features)
+        collect_features=collect_features, bucket_frac=bucket_frac)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, collect_features, seed)
     self.max_degree = max_degree
@@ -402,7 +404,7 @@ class DistNeighborLoader(DistLoader):
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
                with_weight: bool = False, dedup: str = 'sort',
-               seed_labels_only: bool = False):
+               seed_labels_only: bool = False, bucket_frac=2.0):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -411,7 +413,7 @@ class DistNeighborLoader(DistLoader):
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
-        with_weight=with_weight, dedup=dedup)
+        with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, collect_features, seed,
                      seed_labels_only=seed_labels_only)

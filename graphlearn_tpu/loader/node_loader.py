@@ -103,6 +103,13 @@ class NodeLoader:
 
   def __iter__(self):
     from ..utils import step_annotation
+    # per-epoch padded-table reseed: rows with deg > window expose a
+    # fresh random window-subset each epoch, de-biasing the truncation
+    # (ops.build_padded_adjacency; no-op for non-padded samplers)
+    if getattr(self.sampler, 'padded_window', None) is not None:
+      if getattr(self, '_epochs_started', 0) > 0:
+        self.sampler.refresh_padded_table()
+      self._epochs_started = getattr(self, '_epochs_started', 0) + 1
     for i, idx in enumerate(self._batcher):
       with step_annotation('glt_batch', i):
         seeds = self.input_seeds[idx]

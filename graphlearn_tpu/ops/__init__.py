@@ -10,9 +10,11 @@ from .induce_tree import (TreeInducerState, induce_next_tree,
 from .negative import (random_negative_sample, random_negative_sample_local,
                        sort_csr_segments)
 from .neighbor import (BLOCK, build_padded_adjacency, build_row_cumsum,
-                       edge_in_csr, uniform_sample, uniform_sample_block,
-                       uniform_sample_local, uniform_sample_padded,
-                       weighted_sample, weighted_sample_local)
+                       choose_padded_window, edge_in_csr,
+                       padded_table_stats, uniform_sample,
+                       uniform_sample_block, uniform_sample_local,
+                       uniform_sample_padded, weighted_sample,
+                       weighted_sample_local)
 from .route import gather_from_buckets, route_slots, scatter_to_buckets
 from .stitch import stitch_rows
 from .subgraph import (node_subgraph, node_subgraph_bucketed,

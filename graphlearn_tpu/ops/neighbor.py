@@ -126,6 +126,70 @@ def weighted_sample(indptr, indices, row_cumsum, seeds, seed_mask, k: int,
   return nbrs, jnp.where(mask, epos, 0), mask
 
 
+def choose_padded_window(fanouts, candidates=(16, 64, 128)) -> int:
+  """Pick the padded-adjacency window for a fanout list.
+
+  The window must cover max(fanout) (smaller would systematically
+  under-sample). Among sufficient widths the measured order on v5e is
+  16 > 64 > 128 >> 32 (PERF.md: W=32 hits a reproducible XLA
+  tiling/codegen cliff — 10.0 ms vs 4.97 at W=16 and 6.52 at W=64 — so
+  it is deliberately absent from ``candidates``).
+  """
+  need = max(fanouts)
+  for w in candidates:
+    if w >= need:
+      return w
+  return _round_up_pow2(need)
+
+
+def _round_up_pow2(n: int) -> int:
+  w = 1
+  while w < n:
+    w *= 2
+  return w
+
+
+def padded_table_stats(indptr, window: int):
+  """Degree-conditional neighbor-recall of a [N, window] padded table.
+
+  Quantifies the padded mode's disclosed truncation: rows with
+  deg > window expose only a random ``window``-subset per epoch.
+  Returns:
+    node_recall: mean over nodes of min(deg, W)/deg (deg > 0).
+    edge_recall: sum(min(deg, W)) / sum(deg) — the probability that a
+      uniformly chosen EDGE's slot survives truncation; hub-sensitive,
+      so it is the number that matters on power-law graphs.
+    frac_truncated_nodes / frac_truncated_edges: how much of the graph
+      the trade touches.
+    recall_by_degree: {decile upper bound -> mean node recall} over
+      degree deciles (only nodes with deg > 0).
+  """
+  indptr = np.asarray(indptr)
+  deg = np.diff(indptr).astype(np.int64)
+  pos = deg[deg > 0]
+  kept = np.minimum(pos, window)
+  stats = {
+      'window': int(window),
+      'node_recall': float((kept / pos).mean()) if pos.size else 1.0,
+      'edge_recall': float(kept.sum() / max(pos.sum(), 1)),
+      'frac_truncated_nodes': float((pos > window).mean()) if pos.size
+      else 0.0,
+      'frac_truncated_edges': float(pos[pos > window].sum()
+                                    / max(pos.sum(), 1)),
+  }
+  if pos.size:
+    qs = np.quantile(pos, np.linspace(0.1, 1.0, 10))
+    by_dec = {}
+    lo = 0
+    for q in qs:
+      sel = (pos > lo) & (pos <= q)
+      if sel.any():
+        by_dec[int(q)] = float((kept[sel] / pos[sel]).mean())
+      lo = q
+    stats['recall_by_degree'] = by_dec
+  return stats
+
+
 def build_padded_adjacency(indptr, indices, window: int, seed: int = 0,
                            edge_pos: bool = False):
   """Host-side: dense [N, window] neighbor table with per-row shuffling.

@@ -383,6 +383,14 @@ class NeighborSampler(BaseSampler):
                        'engine plans capacities per edge type; clamp '
                        'seeds via batch_size / hops via node_budget '
                        'instead)')
+    if frontier_caps is not None and dedup in ('tree', 'none'):
+      # tree frontiers are un-deduped (positional, ~fanout-product
+      # wide): clamping them with POST-dedup calibrated caps would
+      # silently truncate most samples. Budget-style truncation on tree
+      # batches is node_budget's job.
+      raise ValueError('frontier_caps requires an exact-dedup mode '
+                       "(map/sort/merge); use node_budget with "
+                       "dedup='tree'")
     self.frontier_caps = (tuple(frontier_caps)
                           if frontier_caps is not None else None)
     # fused=True (default) compiles the whole multi-hop sample into one
@@ -401,12 +409,19 @@ class NeighborSampler(BaseSampler):
     # padded_window: sample hops from a dense pre-shuffled [N, W]
     # adjacency table instead of the CSR — one ROW gather per hop rather
     # than per-edge ELEMENT gathers (~5x faster on TPU, PERF.md). Rows
-    # with degree > W sample from a uniformly random W-subset (rebuild
-    # with a new seed to refresh). Homo + uniform only.
-    self.padded_window = padded_window
+    # with degree > W sample from a uniformly random W-subset (the
+    # loaders reseed the table each epoch to de-bias the truncation;
+    # ops.padded_table_stats quantifies the recall). 'auto' picks the
+    # fastest sufficient window, dodging the measured W=32 cliff
+    # (ops.choose_padded_window). Homo + uniform only.
     fo = (list(num_neighbors)
           if num_neighbors is not None and
           not isinstance(num_neighbors, dict) else [])
+    if padded_window == 'auto':
+      if not fo:
+        raise ValueError("padded_window='auto' needs a fanout list")
+      padded_window = ops.choose_padded_window(fo)
+    self.padded_window = padded_window
     # strategy='block': cluster sampling over aligned 16-wide CSR blocks
     # (row-gather speed on the raw CSR, exact uniform marginals,
     # correlated within a row per hop — ops.uniform_sample_block)

@@ -181,6 +181,78 @@ def test_route_overflow_counter():
   assert int(nov) == 0 and bool(ok.all())
 
 
+@pytest.mark.parametrize('bucket_frac', [None, 2.0, 0.25])
+def test_dist_sampler_bucket_frac_loss_free(bucket_frac):
+  """Sub-frontier exchange buckets (capacity = frac * frontier / P with
+  the replicated full-width fallback) keep the loss-free contract at
+  every fraction: on the ring (deg 2, fanout 2, keep-all) every seed
+  yields exactly 2 valid edges, and all decode to real ring edges.
+  frac=0.25 at P=4 forces the overflow fallback path to run."""
+  num_parts = 4
+  parts, _, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, [2, 2], mesh, seed=0, bucket_frac=bucket_frac)
+  b = 8
+  seeds = np.arange(num_parts * b, dtype=np.int32).reshape(num_parts, b)
+  out = sampler.sample_from_nodes(seeds)
+  em = np.asarray(out.edge_mask)
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  for p in range(num_parts):
+    # hop 1: exactly 2 edges per seed (keep-all); hop 2 adds more
+    assert int(em[p].sum()) >= 2 * b, (bucket_frac, int(em[p].sum()))
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N), (bucket_frac, u, v)
+
+
+@pytest.mark.parametrize('bucket_frac', [2.0, 0.25])
+def test_dist_sampler_two_axis_mesh(bucket_frac):
+  """The same sampling program runs on a 2-axis (slice, chip) mesh —
+  the multi-slice layout: the hierarchical 2-stage exchange transposes
+  full-width along 'chip' (ICI) and fractionally along 'slice' (DCN),
+  with a replicated flat fallback on overflow. frac=0.25 forces the
+  fractional DCN capacity (and on skewed hops the fallback); both must
+  preserve the ring invariants. Feature collection runs over the same
+  mesh."""
+  import jax
+  from jax.sharding import Mesh
+  num_parts = 8
+  if len(jax.devices()) < num_parts:
+    pytest.skip('needs 8 devices')
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = Mesh(np.array(jax.devices()[:num_parts]).reshape(2, 4),
+              ('slice', 'chip'))
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  df = glt.distributed.DistFeature(num_parts, feats, node_pb, mesh)
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, [2, 2], mesh, seed=0, dist_feature=df, collect_features=True,
+      bucket_frac=bucket_frac)
+  b = 4
+  seeds = np.arange(num_parts * b, dtype=np.int32).reshape(num_parts, b)
+  out = sampler.sample_from_nodes(seeds)
+  x, _ = sampler.collate(out)
+  node = np.asarray(out.node).reshape(num_parts, -1)
+  row = np.asarray(out.row).reshape(num_parts, -1)
+  col = np.asarray(out.col).reshape(num_parts, -1)
+  em = np.asarray(out.edge_mask).reshape(num_parts, -1)
+  fx = np.asarray(x).reshape(num_parts, node.shape[1], -1)
+  for p in range(num_parts):
+    assert em[p].sum() > 0
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N), (u, v)
+    valid = node[p] >= 0
+    np.testing.assert_allclose(fx[p][valid][:, 0], node[p][valid])
+
+
 def test_dist_sampler_skewed_partition_book_no_loss():
   """Pathologically skewed node_pb (every node owned by partition 0):
   the frontier-width bucket capacity guarantees zero sample loss — every

@@ -1,5 +1,5 @@
 """Multi-host substrate test: 2 processes x 4 CPU devices each, one global
-8-device mesh, a full distributed sample + feature step.
+2-axis (slice=2, chip=4) mesh, a full distributed sample + feature step.
 
 The documented CPU harness for dist_context.init_multihost (SURVEY §2.3
 comm-backend mapping; the reference's equivalent is its multi-node RPC
@@ -25,10 +25,14 @@ import numpy as np
 import graphlearn_tpu as glt
 from graphlearn_tpu.typing import GraphPartitionData
 
+# 2-axis multi-slice layout: one slice per process (2 x 4) — the 'chip'
+# axis is the per-process ICI analog, 'slice' crosses processes (DCN)
 ctx = glt.distributed.init_multihost(f'localhost:{port}', num_processes=2,
-                                     process_id=pid)
+                                     process_id=pid,
+                                     mesh_shape='per_process')
 assert ctx.world_size == 2 and ctx.rank == pid
-assert ctx.num_partitions == 8 and ctx.mesh.shape['g'] == 8
+assert ctx.num_partitions == 8
+assert dict(ctx.mesh.shape) == {'slice': 2, 'chip': 4}, ctx.mesh.shape
 
 N = 40
 P = 8
