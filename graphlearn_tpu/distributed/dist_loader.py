@@ -322,7 +322,7 @@ class DistLinkNeighborLoader(DistLoader):
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
                with_weight: bool = False, dedup: str = 'sort',
-               bucket_frac=2.0):
+               bucket_frac=2.0, neg_strict: bool = False):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -342,7 +342,8 @@ class DistLinkNeighborLoader(DistLoader):
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
-        with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac)
+        with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac,
+        neg_strict=neg_strict)
     super().__init__(data, sampler, np.zeros(0, np.int64), batch_size,
                      shuffle, drop_last, collect_features, seed)
     self.input_type = input_type  # EdgeType for hetero link sampling

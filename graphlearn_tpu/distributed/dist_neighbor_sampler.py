@@ -39,8 +39,10 @@ one-batch-per-worker model.
 Link sampling (reference _sample_from_edges, dist_neighbor_sampler.py:369-496)
 and subgraph sampling (reference _subgraph, :499-559) are additional program
 builders over the same hop engine: negatives are drawn shard-locally inside
-the program (non-strict, like the reference's local-only distributed negative
-sampling, :380-383), and the induced-subgraph edge extraction is an
+the program (default non-strict like the reference's local-only distributed
+negative sampling, :380-383; ``neg_strict=True`` upgrades validity to
+guaranteed non-edges using the engine's edges-live-with-their-source
+invariant), and the induced-subgraph edge extraction is an
 all_gather of the node set + per-shard local extraction + all_to_all of the
 results — the collective analog of the reference's subgraph RPC fan-out.
 """
@@ -343,7 +345,7 @@ class DistNeighborSampler:
                node_budget: Optional[int] = None,
                collect_features: bool = False,
                with_weight: bool = False, dedup: str = 'sort',
-               bucket_frac=2.0):
+               bucket_frac=2.0, neg_strict: bool = False):
     import jax
     self.graph = dist_graph
     self.is_hetero = dist_graph.is_hetero
@@ -363,6 +365,12 @@ class DistNeighborSampler:
     # with a replicated full-width fallback on overflow (see
     # _exchange_hop); None = always full width (round-2 posture)
     self.bucket_frac = bucket_frac
+    # neg_strict=True: distributed negatives whose validity GUARANTEES
+    # non-edge pairs (the engine's edges-live-with-their-source
+    # invariant makes the shard-local membership check complete —
+    # ops.random_negative_sample_local); False = reference parity
+    # (always-full output, rare slip-through).
+    self.neg_strict = neg_strict
     # 'sort'/'map'/'merge' = exact dedup (all run the merge-sort engine,
     # ops/induce_merge.py — batch-sized memory, so it shards cleanly);
     # 'tree' ('none' aliases it) = positional computation-tree batches
@@ -561,6 +569,7 @@ class DistNeighborSampler:
     edge_dir = self.graph.edge_dir
     num_nodes = self.graph.num_nodes
     bucket_frac = self.bucket_frac
+    neg_strict = self.neg_strict
     ax = self._axes
     sizes = self._axis_sizes
     if mode == 'none':
@@ -587,7 +596,7 @@ class DistNeighborSampler:
       else:
         nr, nc, nvalid = ops.random_negative_sample_local(
             gdev['row_ids'], gdev['indptr'], sorted_loc[0], num_nodes,
-            num_neg, kneg)
+            num_neg, kneg, strict=neg_strict)
         # CSR key side vs user-facing (src, dst): flip for CSC ('in')
         neg_src, neg_dst = (nr, nc) if edge_dir == 'out' else (nc, nr)
         if mode == 'binary':
@@ -984,7 +993,7 @@ class DistNeighborSampler:
         gd = garr[etype]
         nr, nc, nvalid = ops.random_negative_sample_local(
             gd['row_ids'], gd['indptr'], sorted_loc, num_other, num_neg,
-            kneg)
+            kneg, strict=self.neg_strict)
         neg_src, neg_dst = (nr, nc) if edge_dir == 'out' else (nc, nr)
         if mode == 'binary':
           src_seeds = jnp.concatenate([rows_, neg_src])

@@ -27,15 +27,40 @@ class SeedBatcher:
     self.shuffle = shuffle
     self.drop_last = drop_last
     self._rng = np.random.default_rng(seed)
+    # mid-epoch resume bookkeeping (see state_dict below)
+    self._epoch_start_state = self._rng.bit_generator.state
+    self._consumed = 0
+    self._pending_skip = 0
 
   def __iter__(self):
+    # capture the stream position BEFORE the permutation draw: a
+    # mid-epoch snapshot replays this epoch's permutation from here
+    self._epoch_start_state = self._rng.bit_generator.state
+    self._consumed = 0
     order = (self._rng.permutation(self.num_seeds) if self.shuffle
              else np.arange(self.num_seeds))
+    skip, self._pending_skip = self._pending_skip, 0
+    if skip >= len(self) > 0:
+      # snapshot was taken at the epoch's end: the replayed epoch is
+      # already complete — the permutation draw above advanced the
+      # stream exactly as the original epoch did, so continue straight
+      # into the next epoch. (len == 0 epochs yield nothing and must
+      # not recurse.)
+      yield from self.__iter__()
+      return
     n_full = self.num_seeds // self.batch_size
     for i in range(n_full):
+      if i < skip:
+        self._consumed = i + 1
+        continue
+      # count BEFORE yielding: a snapshot taken while the consumer holds
+      # batch i must record it as consumed (the trainer checkpoints
+      # after finishing the step for the batch it was handed)
+      self._consumed = i + 1
       yield order[i * self.batch_size:(i + 1) * self.batch_size]
     rem = self.num_seeds - n_full * self.batch_size
     if rem and not self.drop_last:
+      self._consumed = n_full + 1
       yield order[n_full * self.batch_size:]
 
   def __len__(self):
@@ -44,15 +69,23 @@ class SeedBatcher:
     return n_full + (1 if rem and not self.drop_last else 0)
 
   # -- checkpoint/resume (utils.checkpoint) --------------------------------
-  # The shuffle stream is the only mutable state: capturing the PRNG
-  # state and restoring it in a fresh batcher (same seed/sizes) replays
-  # the exact remaining permutation sequence — epoch-boundary resume.
+  # Mid-epoch granularity: the snapshot carries the PRNG state captured
+  # at the CURRENT epoch's start plus how many batches were already
+  # yielded. A restored batcher regenerates the identical permutation
+  # and fast-forwards past the consumed batches, so training resumes at
+  # the exact next batch (not the epoch start); subsequent epochs
+  # continue the original stream. (The reference has no checkpointing
+  # at all — SURVEY §5.)
 
   def state_dict(self):
-    return {'rng_state': self._rng.bit_generator.state}
+    return {'rng_state': self._epoch_start_state,
+            'consumed': int(self._consumed)}
 
   def load_state_dict(self, state):
     self._rng.bit_generator.state = state['rng_state']
+    self._epoch_start_state = state['rng_state']
+    self._pending_skip = int(state.get('consumed', 0))
+    self._consumed = self._pending_skip
 
 
 class NodeLoader:
@@ -88,10 +121,11 @@ class NodeLoader:
     return len(self._batcher)
 
   def state_dict(self):
-    """Resumable iteration state (epoch-boundary granularity): the seed
-    shuffle stream plus the sampler's PRNG state, so a restored run
-    replays the exact batches the uninterrupted run would have
-    produced."""
+    """Resumable iteration state (MID-EPOCH granularity): the seed
+    shuffle stream + position within the current epoch's permutation,
+    plus the sampler's PRNG state — a restored run resumes at the exact
+    next batch and replays precisely what the uninterrupted run would
+    have produced (SeedBatcher.state_dict)."""
     state = self._batcher.state_dict()
     state['sampler'] = self.sampler.state_dict()
     return state

@@ -69,15 +69,23 @@ def random_negative_sample(indptr, sorted_indices, num_src, num_dst,
 
 def random_negative_sample_local(row_ids, indptr_loc, sorted_indices,
                                  num_dst: int, num_samples: int, key,
-                                 trials: int = 5):
+                                 trials: int = 5, strict: bool = False):
   """Shard-local negative sampling for the distributed engine.
 
-  Each shard draws source rows from ITS OWN partition's local CSR (the
-  reference's distributed negative sampling is likewise local-only and
-  therefore non-strict: dist_neighbor_sampler.py:380-383 "unable to fetch
-  positive edges from remote"). Candidate (local_row, dst) pairs are
-  rejected when present in the local CSR segment; survivors map to global
-  ids via ``row_ids``. Padding semantics: the output is always full.
+  Each shard draws source rows from ITS OWN partition's local CSR.
+  Candidate (local_row, dst) pairs are rejected when present in the
+  local CSR segment; survivors map to global ids via ``row_ids``.
+
+  STRICTNESS: the engine's partition invariant is that a row's COMPLETE
+  out-edge set lives on its owner's shard (the exchange samples node v
+  only on owner(v) — splitting a row across shards would undersample),
+  so the local membership check is globally complete for locally-drawn
+  sources. ``strict=False`` (reference parity: its distributed path
+  cannot check remote edges at all, dist_neighbor_sampler.py:380-383)
+  always emits ``num_samples`` pairs, letting a candidate that stayed
+  an edge through every trial slip through. ``strict=True`` marks such
+  slots invalid instead — every VALID pair is guaranteed a non-edge,
+  beyond the reference's distributed contract.
 
   Traced inside shard_map (no jit wrapper; the caller's program compiles
   it). Returns (src_global [num_samples], dst [num_samples],
@@ -98,5 +106,7 @@ def random_negative_sample_local(row_ids, indptr_loc, sorted_indices,
   order = jnp.argsort(jnp.where(is_edge, 1, 0), stable=True)
   take = order[:num_samples]
   valid = jnp.broadcast_to(num_actual > 0, (num_samples,))
+  if strict:
+    valid = valid & ~is_edge[take]
   src = jnp.where(valid, row_ids[u[take]].astype(jnp.int32), -1)
   return src, jnp.where(valid, cols[take], -1), valid

@@ -7,8 +7,9 @@ with modern expectations"). This module goes beyond the reference:
 - ``CheckpointManager.save(step, state, loader=..., extra=...)`` writes
   the train-state pytree (params/opt_state/...) plus a JSON item holding
   the loader's resumable iteration state (``loader.state_dict()`` — the
-  shuffle PRNG stream + sampler PRNG, epoch-boundary granularity) and
-  any user metadata.
+  shuffle PRNG stream + position within the current epoch + sampler
+  PRNG base key/counter: MID-EPOCH granularity, a restore resumes at
+  the exact next batch) and any user metadata.
 - ``restore(state_template, loader=...)`` loads the newest (or a given)
   step back into arrays shaped like the template and replays the loader
   position, so training continues with the exact permutation sequence it

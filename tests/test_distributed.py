@@ -380,6 +380,68 @@ def test_dist_link_sampler_binary():
     np.testing.assert_array_equal(label[p], [1, 1, 0, 0])
 
 
+def test_dist_link_negatives_strict():
+  """neg_strict=True on a dense graph: every mask-VALID negative pair is
+  guaranteed a non-edge (the shard-local check is complete because each
+  node's out-edges live on its owner's shard), while slip-through slots
+  turn invalid instead of emitting edges. Non-strict on the same dense
+  graph emits at least one edge as a 'negative' — proving the flag
+  changes behavior."""
+  from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
+  num_parts = 2
+  n = 8
+  # near-complete directed graph: all (u, v) with v != u except (u, u+4)
+  rows_l, cols_l = [], []
+  for u in range(n):
+    for v in range(n):
+      if v != u and v != (u + 4) % n:
+        rows_l.append(u)
+        cols_l.append(v)
+  rows, cols = np.array(rows_l), np.array(cols_l)
+  adj = set(zip(rows.tolist(), cols.tolist()))
+  node_pb = (np.arange(n) % num_parts).astype(np.int32)
+  edge_pb = node_pb[rows]
+  parts = []
+  for p in range(num_parts):
+    m = edge_pb == p
+    parts.append(GraphPartitionData(
+        edge_index=np.stack([rows[m], cols[m]]),
+        eids=np.arange(rows.shape[0])[m]))
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  seed_r = np.array([[0, 2], [1, 3]], np.int32)
+  seed_c = (seed_r + 1) % n
+
+  def negatives(strict):
+    sampler = glt.distributed.DistNeighborSampler(
+        dg, [2], mesh, seed=3, neg_strict=strict)
+    got = []
+    for trial in range(6):
+      out = sampler.sample_from_edges(EdgeSamplerInput(
+          seed_r, seed_c, neg_sampling=NegativeSampling('binary', 4)))
+      node = np.asarray(out.node)
+      eli = np.asarray(out.metadata['edge_label_index'])
+      for p in range(num_parts):
+        for i in range(2, 10):          # the negative block
+          s, d = eli[p, 0, i], eli[p, 1, i]
+          if s < 0 or d < 0:
+            continue                     # strict-invalidated slot
+          u, v = int(node[p][s]), int(node[p][d])
+          if u < 0 or v < 0:
+            continue
+          got.append((u, v))
+    return got
+
+  strict_pairs = negatives(True)
+  assert strict_pairs, 'strict sampler produced no valid negatives'
+  for u, v in strict_pairs:
+    assert (u, v) not in adj, (u, v)
+  # non-strict on this dense graph: slip-through is near-certain
+  loose_pairs = negatives(False)
+  assert any(p in adj for p in loose_pairs), \
+      'expected at least one slipped edge in non-strict mode'
+
+
 def test_dist_link_sampler_triplet():
   from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
   num_parts = 2
