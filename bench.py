@@ -110,9 +110,12 @@ def _run_e2e(ds, train_idx, dtype, jax, trace_dir, variant='tree',
         seed_labels_only=True)
     no, eo = train_lib.merge_hop_offsets(BATCH, FANOUT,
                                          frontier_caps=cal_caps)
+    # merge_dense: per-hop k-run reshape-mean aggregation (exact,
+    # equivalence-tested) — halves the train program vs segment ops
     model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
                       num_layers=len(FANOUT), hop_node_offsets=no,
-                      hop_edge_offsets=eo, dtype=dtype)
+                      hop_edge_offsets=eo, dtype=dtype,
+                      merge_dense=True, fanouts=tuple(FANOUT))
   else:
     loader = glt.loader.NeighborLoader(
         ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,

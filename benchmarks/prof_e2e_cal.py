@@ -72,13 +72,15 @@ def main():
       bench.BATCH, bench.FANOUT, frontier_caps=cal)
   print('node_offs:', node_offs, 'edge_offs:', edge_offs)
 
-  # naive segment model on calibrated map batches
-  run(dict(dedup='map', frontier_caps=cal), {}, 'map_cal_naive',
-      jnp.bfloat16, ds, train_idx)
   # layered segment model (prefix trimming) on calibrated map batches
   run(dict(dedup='map', frontier_caps=cal),
       dict(hop_node_offsets=node_offs, hop_edge_offsets=edge_offs),
       'map_cal_layered', jnp.bfloat16, ds, train_idx)
+  # blocked (merge_dense) aggregation: k-run reshape-mean + small scatter
+  run(dict(dedup='map', frontier_caps=cal),
+      dict(hop_node_offsets=node_offs, hop_edge_offsets=edge_offs,
+           merge_dense=True, fanouts=tuple(bench.FANOUT)),
+      'map_cal_mergedense', jnp.bfloat16, ds, train_idx)
   # reference fast path: tree + block + tree_dense
   no, eo = train_lib.tree_hop_offsets(bench.BATCH, bench.FANOUT)
   run(dict(dedup='tree', strategy='block'),

@@ -193,12 +193,15 @@ def main():
                       fanouts=tuple(args.fanout))
   elif args.dedup in ('auto', 'map', 'sort', 'merge'):
     # exact-dedup batches support the same layered trimming via the
-    # merge layout (prefix-contiguous hop blocks; PERF.md round 3)
+    # merge layout (prefix-contiguous hop blocks), and merge_dense
+    # replaces segment scatter-adds with k-run reshape-means — both
+    # numerically exact (PERF.md round 3)
     no, eo = train_lib.merge_hop_offsets(args.batch_size, args.fanout,
                                          args.node_budget, cal_caps)
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,
                       num_layers=depth, hop_node_offsets=no,
-                      hop_edge_offsets=eo, dtype=mdtype)
+                      hop_edge_offsets=eo, dtype=mdtype,
+                      merge_dense=True, fanouts=tuple(args.fanout))
   else:
     # legacy bisection engines: full (un-layered) forward
     model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls,

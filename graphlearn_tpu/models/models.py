@@ -81,6 +81,15 @@ def _tree_blocks(node_offsets, fanouts, n_rows):
   return blocks, eo
 
 
+def _masked_run_mean(vals, mask):
+  """Masked mean over axis 1 of a [runs, k, F] block ([runs, k] mask) —
+  the shared aggregation kernel of the dense-run convs (TreeSAGEConv /
+  MergeSAGEConv)."""
+  s = jnp.where(mask[..., None], vals, jnp.zeros((), vals.dtype)).sum(1)
+  inv = (1.0 / jnp.maximum(mask.sum(1), 1)).astype(vals.dtype)
+  return s * inv[:, None]
+
+
 class TreeSAGEConv(nn.Module):
   """SAGEConv over tree-positional batches, aggregation as DENSE reshape.
 
@@ -114,12 +123,64 @@ class TreeSAGEConv(nn.Module):
       ch = jax.lax.dynamic_slice_in_dim(x, no[d], blocks[d + 1]
                                         ).reshape(b, k, x.shape[-1])
       m = edge_mask[eo[d]:eo[d + 1]].reshape(b, k)
-      s = jnp.where(m[..., None], ch, jnp.zeros((), ch.dtype)).sum(1)
-      inv = (1.0 / jnp.maximum(m.sum(1), 1)).astype(ch.dtype)
-      aggs.append(s * inv[:, None])
+      aggs.append(_masked_run_mean(ch, m))
     # deepest block has no children in this slice: aggregate = 0
     aggs.append(jnp.zeros((blocks[-1], x.shape[-1]), x.dtype))
     agg = jnp.concatenate(aggs)
+    h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
+                 name='lin_self')(x)
+    return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
+                        name='lin_nbr')(agg)
+
+
+class MergeSAGEConv(nn.Module):
+  """SAGEConv over exact-dedup (merge-layout) batches: per-hop blocked
+  mean aggregation instead of segment scatter-adds.
+
+  The merge engine emits each hop's edges in frontier order — every
+  frontier node's ``k`` draws occupy CONSECUTIVE edge slots — so each
+  hop's target column is k-CONSTANT runs. Mean aggregation becomes: one
+  source-row gather, a ``[frontier, k]`` masked reshape-mean (dense VPU
+  work), and ONE frontier-sized row scatter per hop — replacing the
+  segment scatter-add over the full edge width (scatter transactions
+  drop from E to E/k per layer). Exact for every merge batch, including
+  calibrated frontier caps (targets are unique across hops: dedup
+  expands each node at most once). Parameter names match ``SAGEConv``
+  (``lin_self``/``lin_nbr``) — checkpoint-interchangeable.
+  """
+  out_dim: int
+  edge_offsets: Any   # prefix sums of the hop edge blocks IN USE
+  fanouts: Any        # per-hop fanout k_i (block run length)
+  use_bias: bool = True
+  dtype: Any = None
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
+    n = x.shape[0]
+    row, col = edge_index[0], edge_index[1]
+    acc = jnp.zeros((n + 1, x.shape[-1]), x.dtype)
+    e0 = 0
+    for i, e1 in enumerate(self.edge_offsets):
+      k = self.fanouts[i]
+      width = e1 - e0
+      assert width % k == 0, (
+          f'hop {i} edge block {width} not a multiple of fanout {k}; '
+          'edge_offsets/fanouts must come from the SAME plan as the '
+          'merge-mode loader (models.train.merge_hop_offsets)')
+      f = width // k
+      src = jax.lax.dynamic_slice_in_dim(row, e0, width)
+      tgt_blk = jax.lax.dynamic_slice_in_dim(col, e0, width).reshape(f, k)
+      m = jax.lax.dynamic_slice_in_dim(edge_mask, e0, width).reshape(f, k)
+      msgs = x[jnp.maximum(src, 0)].reshape(f, k, -1)
+      mean = _masked_run_mean(msgs, m)
+      # the k-run's target local idx (masked slots carry -1: take max)
+      tgt = tgt_blk.max(1)
+      ok = m.any(1) & (tgt >= 0)
+      acc = acc.at[jnp.where(ok, tgt, n)].set(mean, mode='drop')
+      e0 = e1
+    agg = acc[:n]
     h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
                  name='lin_self')(x)
     return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
@@ -213,6 +274,11 @@ class GraphSAGE(nn.Module):
   # segment scatters; requires un-truncated tree batches + aggr='mean'
   # + the true `fanouts`, which guard against node_budget truncation)
   tree_dense: bool = False
+  # merge_dense: blocked aggregation over exact-dedup (merge-layout)
+  # batches via MergeSAGEConv — k-constant target runs per hop replace
+  # the segment scatter-add (requires merge_hop_offsets + fanouts +
+  # aggr='mean'; exact incl. calibrated frontier caps)
+  merge_dense: bool = False
   fanouts: Any = None
 
   @nn.compact
@@ -225,6 +291,14 @@ class GraphSAGE(nn.Module):
           'tree_dense requires fanouts=... (the loader fanouts) so a '
           'node_budget-truncated layout cannot slip through the layout '
           'check')
+    if self.merge_dense:
+      assert layered and not self.tree_dense, (
+          'merge_dense requires hop offsets (merge_hop_offsets) and is '
+          'mutually exclusive with tree_dense')
+      assert self.aggr == 'mean', 'merge_dense implements mean aggregation'
+      assert self.fanouts is not None, (
+          'merge_dense requires fanouts=... (the loader fanouts: the '
+          'per-hop k-run lengths of the merge edge layout)')
     if layered:
       assert len(self.hop_node_offsets) >= self.num_layers + 1 and \
           len(self.hop_edge_offsets) >= self.num_layers
@@ -248,6 +322,12 @@ class GraphSAGE(nn.Module):
               fanouts=tuple(self.fanouts[:hops_used]),
               dtype=self.dtype, name=f'conv{i}')(
               x[:n_in], edge_mask[:e_used])
+        elif self.merge_dense:
+          x = MergeSAGEConv(
+              dim, edge_offsets=tuple(self.hop_edge_offsets[:hops_used]),
+              fanouts=tuple(self.fanouts[:hops_used]),
+              dtype=self.dtype, name=f'conv{i}')(
+              x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
         else:
           x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,
                        name=f'conv{i}')(
