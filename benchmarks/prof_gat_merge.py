@@ -1,0 +1,75 @@
+"""Probe: GAT train step on calibrated exact batches — segment softmax
+vs MergeGATConv's per-target k-run softmax (device-trace truth).
+Bench config: 1M nodes, [15,10,5] @ 1024, GAT h=128 2 heads bf16.
+"""
+import os
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def run(model_kw, tag, ds, train_idx, cal):
+  import jax
+  import jax.numpy as jnp
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import GAT
+  from graphlearn_tpu.models import train as train_lib
+  loader = glt.loader.NeighborLoader(
+      ds, bench.FANOUT, train_idx, batch_size=bench.BATCH, shuffle=True,
+      drop_last=True, seed=0, dedup='map', frontier_caps=cal,
+      seed_labels_only=True)
+  no, eo = train_lib.merge_hop_offsets(bench.BATCH, bench.FANOUT,
+                                       frontier_caps=cal)
+  model = GAT(hidden_dim=128, out_dim=bench.E2E_CLASSES, num_layers=3,
+              heads=2, dtype=jnp.bfloat16, hop_node_offsets=no,
+              hop_edge_offsets=eo, **model_kw)
+  it = iter(loader)
+  first = train_lib.batch_to_dict(next(it))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  step, _ = train_lib.make_train_step(model, tx, bench.E2E_CLASSES)
+  state, loss, _ = step(state, first)
+  for _ in range(2):
+    state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
+  jax.block_until_ready(loss)
+  td = f'/tmp/glt_gat_{tag}'
+  shutil.rmtree(td, ignore_errors=True)
+  jax.profiler.start_trace(td)
+  losses = []
+  for _ in range(6):
+    state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
+    losses.append(loss)
+  jax.block_until_ready(losses)
+  jax.profiler.stop_trace()
+  progs = glt.utils.device_program_ms(td)
+  tot = sum(ms for ms, _ in progs.values())
+  tr = max((ms for nm, (ms, _) in progs.items()
+            if nm.startswith('jit_train_step')), default=0)
+  print(f'{tag:16s} total {tot:7.2f} ms/step (train program {tr:6.2f})')
+
+
+def main():
+  import graphlearn_tpu as glt
+  glt.utils.enable_compilation_cache()
+  graph = bench.build_graph()
+  rng = np.random.default_rng(2)
+  ds = glt.data.Dataset(graph=graph)
+  ds.init_node_features(rng.standard_normal(
+      (bench.NUM_NODES, bench.E2E_FEAT_DIM), dtype=np.float32))
+  ds.init_node_labels(rng.integers(0, bench.E2E_CLASSES, bench.NUM_NODES))
+  train_idx = rng.integers(0, bench.NUM_NODES, bench.BATCH * 12)
+  cal = glt.sampler.estimate_frontier_caps(graph, bench.FANOUT,
+                                           bench.BATCH, num_probes=5,
+                                           slack=1.5)
+  run({}, 'gat_segment', ds, train_idx, cal)
+  run(dict(merge_dense=True, fanouts=tuple(bench.FANOUT)),
+      'gat_mergedense', ds, train_idx, cal)
+
+
+if __name__ == '__main__':
+  main()

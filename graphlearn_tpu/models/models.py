@@ -81,6 +81,22 @@ def _tree_blocks(node_offsets, fanouts, n_rows):
   return blocks, eo
 
 
+def _masked_run_softmax(e, mask, out_dtype, negative_slope):
+  """Per-run masked attention softmax over axis 1 of [runs, k, H]
+  logits — the shared kernel of the dense-run GAT convs (TreeGATConv /
+  MergeGATConv): leaky_relu, mask to -inf, TRUE per-run max
+  stabilization (clamping at 0 would underflow exp when every valid
+  logit is very negative — the same stabilization GATConv's segment
+  softmax uses; all-masked runs fall back to 0), exp, denom floor."""
+  e = nn.leaky_relu(e, negative_slope)
+  e = jnp.where(mask[..., None], e, -jnp.inf)
+  mx = e.max(axis=1, keepdims=True)
+  e = e - jnp.where(jnp.isfinite(mx), mx, 0.0)
+  ex = jnp.where(mask[..., None], jnp.exp(e), 0.0)
+  denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-9)
+  return (ex / denom).astype(out_dtype)
+
+
 def _masked_run_mean(vals, mask):
   """Masked mean over axis 1 of a [runs, k, F] block ([runs, k] mask) —
   the shared aggregation kernel of the dense-run convs (TreeSAGEConv /
@@ -228,22 +244,76 @@ class TreeGATConv(nn.Module):
       ch = slice(no[d], no[d] + blocks[d + 1])
       e = (alpha_src[ch].reshape(b, k, heads) +
            alpha_dst[lo:lo + b][:, None, :])      # [b, k, H]
-      e = nn.leaky_relu(e, self.negative_slope)
       m = edge_mask[eo[d]:eo[d + 1]].reshape(b, k)
-      e = jnp.where(m[..., None], e, -jnp.inf)
-      # subtract the TRUE per-parent max (clamping at 0 would underflow
-      # exp when every valid logit is very negative — the same
-      # stabilization GATConv's segment softmax uses); all-masked
-      # parents fall back to 0
-      mx = e.max(axis=1, keepdims=True)
-      e = e - jnp.where(jnp.isfinite(mx), mx, 0.0)
-      ex = jnp.where(m[..., None], jnp.exp(e), 0.0)
-      denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-9)
-      attn = (ex / denom).astype(w.dtype)         # [b, k, H]
+      attn = _masked_run_softmax(e, m, w.dtype, self.negative_slope)
       msgs = w[ch].reshape(b, k, heads, hd)
       outs.append((msgs * attn[..., None]).sum(axis=1))  # [b, H, D]
     outs.append(jnp.zeros((blocks[-1], heads, hd), w.dtype))
     out = jnp.concatenate(outs)
+    if self.concat:
+      return out.reshape(n, heads * hd)
+    return out.mean(axis=1)
+
+
+class MergeGATConv(nn.Module):
+  """GATConv over exact-dedup (merge-layout) batches: per-target DENSE
+  softmax over its k-run.
+
+  Dedup expands every node at most once, so a target's COMPLETE in-edge
+  set is exactly its contiguous k-run in the hop that expanded it —
+  GAT's segment softmax (scatter-max + scatter-sum per layer, the most
+  scatter-bound op in the model zoo, PERF.md) becomes a masked softmax
+  over the ``[frontier, k]`` reshape plus one frontier-sized row
+  scatter per hop. Numerically matches ``GATConv`` on merge batches
+  (same param names: ``lin``/``att_src``/``att_dst``), calibrated caps
+  included.
+  """
+  out_dim: int
+  edge_offsets: Any
+  fanouts: Any
+  heads: int = 1
+  negative_slope: float = 0.2
+  concat: bool = True
+  dtype: Any = None
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask):
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
+    n, heads, hd = x.shape[0], self.heads, self.out_dim
+    w = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
+                 name='lin')(x).reshape(n, heads, hd)
+    a_src = self.param('att_src', nn.initializers.glorot_uniform(),
+                       (heads, hd))
+    a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
+                       (heads, hd))
+    wf = w.astype(jnp.float32)
+    alpha_src = (wf * a_src[None]).sum(-1)        # [n, H]
+    alpha_dst = (wf * a_dst[None]).sum(-1)
+    row, col = edge_index[0], edge_index[1]
+    acc = jnp.zeros((n + 1, heads, hd), w.dtype)
+    e0 = 0
+    for i, e1 in enumerate(self.edge_offsets):
+      k = self.fanouts[i]
+      width = e1 - e0
+      assert width % k == 0, (
+          f'hop {i} edge block {width} not a multiple of fanout {k}; '
+          'build edge_offsets with models.train.merge_hop_offsets')
+      f = width // k
+      src = jnp.maximum(jax.lax.dynamic_slice_in_dim(row, e0, width), 0)
+      tgt = jax.lax.dynamic_slice_in_dim(col, e0, width).reshape(f, k
+                                                                 ).max(1)
+      m = jax.lax.dynamic_slice_in_dim(edge_mask, e0, width
+                                       ).reshape(f, k)
+      e = (alpha_src[src].reshape(f, k, heads) +
+           alpha_dst[jnp.maximum(tgt, 0)][:, None, :])
+      attn = _masked_run_softmax(e, m, w.dtype, self.negative_slope)
+      msgs = w[src].reshape(f, k, heads, hd)
+      outv = (msgs * attn[..., None]).sum(axis=1)  # [f, H, D]
+      ok = m.any(1) & (tgt >= 0)
+      acc = acc.at[jnp.where(ok, tgt, n)].set(outv, mode='drop')
+      e0 = e1
+    out = acc[:n]
     if self.concat:
       return out.reshape(n, heads * hd)
     return out.mean(axis=1)
@@ -307,9 +377,10 @@ class GraphSAGE(nn.Module):
       # blocks SILENTLY — jnp never errors on oversized slices
       assert self.hop_node_offsets[self.num_layers] == x.shape[0], (
           f'layered forward: hop offsets {self.hop_node_offsets} do not '
-          f'match the batch node buffer ({x.shape[0]}); build them with '
-          'models.train.tree_hop_offsets from the SAME batch_size/'
-          'fanouts/node_budget as the tree-mode loader')
+          f'match the batch node buffer ({x.shape[0]}); build them from '
+          'the SAME batch_size/fanouts/node_budget as the loader — '
+          'models.train.tree_hop_offsets for tree batches, '
+          'merge_hop_offsets for exact-dedup batches')
     for i in range(self.num_layers):
       dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
       if layered:
@@ -375,6 +446,9 @@ class GAT(nn.Module):
   hop_node_offsets: Any = None
   hop_edge_offsets: Any = None
   tree_dense: bool = False
+  # merge_dense: per-target k-run softmax on exact-dedup batches
+  # (MergeGATConv; requires merge_hop_offsets + fanouts)
+  merge_dense: bool = False
   fanouts: Any = None
 
   @nn.compact
@@ -383,6 +457,10 @@ class GAT(nn.Module):
     if self.tree_dense:
       assert layered and self.fanouts is not None, (
           'tree_dense GAT requires hop offsets + the true fanouts')
+    if self.merge_dense:
+      assert layered and not self.tree_dense and           self.fanouts is not None, (
+              'merge_dense GAT requires merge hop offsets + fanouts and '
+              'is mutually exclusive with tree_dense')
     if layered:
       # trace-time layout check (see GraphSAGE): jnp never errors on
       # oversized slices, so a mismatched batch would slice garbage
@@ -390,9 +468,10 @@ class GAT(nn.Module):
           len(self.hop_edge_offsets) >= self.num_layers
       assert self.hop_node_offsets[self.num_layers] == x.shape[0], (
           f'layered GAT: hop offsets {self.hop_node_offsets} do not '
-          f'match the batch node buffer ({x.shape[0]}); build them with '
-          'models.train.tree_hop_offsets from the SAME batch_size/'
-          'fanouts as the tree-mode loader')
+          f'match the batch node buffer ({x.shape[0]}); build them from '
+          'the SAME batch_size/fanouts as the loader — '
+          'models.train.tree_hop_offsets for tree batches, '
+          'merge_hop_offsets for exact-dedup batches')
     for i in range(self.num_layers):
       last = i == self.num_layers - 1
       dim = self.out_dim if last else self.hidden_dim
@@ -407,6 +486,12 @@ class GAT(nn.Module):
               fanouts=tuple(self.fanouts[:hops_used]), heads=heads,
               concat=not last, dtype=self.dtype, name=f'conv{i}')(
               x[:n_in], edge_mask[:e_used])
+        elif self.merge_dense:
+          x = MergeGATConv(
+              dim, edge_offsets=tuple(self.hop_edge_offsets[:hops_used]),
+              fanouts=tuple(self.fanouts[:hops_used]), heads=heads,
+              concat=not last, dtype=self.dtype, name=f'conv{i}')(
+              x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
         else:
           x = GATConv(dim, heads=heads, concat=not last,
                       dtype=self.dtype, name=f'conv{i}')(

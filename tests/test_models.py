@@ -198,6 +198,45 @@ def test_merge_dense_matches_segment():
                                  rtol=1e-5, atol=1e-5)
 
 
+def test_merge_dense_gat_matches_segment():
+  """MergeGATConv's per-target k-run softmax == segment-softmax GATConv
+  on merge batches (seed logits identical), incl. calibrated caps."""
+  import jax
+  from graphlearn_tpu.models import train as train_lib
+  rng = np.random.default_rng(17)
+  n = 300
+  rows = rng.integers(0, n, 3000)
+  cols = rng.integers(0, n, 3000)
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), num_nodes=n, graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 12)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, 4, n))
+  for caps in (None, [40, 88]):
+    loader = glt.loader.NeighborLoader(ds, [4, 3], np.arange(48),
+                                       batch_size=16, seed=0, dedup='map',
+                                       frontier_caps=caps)
+    no, eo = train_lib.merge_hop_offsets(16, [4, 3], frontier_caps=caps)
+    seg = glt.models.GAT(hidden_dim=12, out_dim=4, num_layers=2, heads=2,
+                         hop_node_offsets=no, hop_edge_offsets=eo)
+    dense = glt.models.GAT(hidden_dim=12, out_dim=4, num_layers=2,
+                           heads=2, hop_node_offsets=no,
+                           hop_edge_offsets=eo, merge_dense=True,
+                           fanouts=(4, 3))
+    params = None
+    for batch in loader:
+      b = train_lib.batch_to_dict(batch)
+      if params is None:
+        params = seg.init(jax.random.PRNGKey(0), b['x'],
+                          b['edge_index'], b['edge_mask'])
+      out_seg = np.asarray(seg.apply(params, b['x'], b['edge_index'],
+                                     b['edge_mask']))
+      out_dense = np.asarray(dense.apply(params, b['x'], b['edge_index'],
+                                         b['edge_mask']))
+      nseed = int(b['num_seed_nodes'])
+      np.testing.assert_allclose(out_seg[:nseed], out_dense[:nseed],
+                                 rtol=2e-4, atol=2e-4)
+
+
 def test_hgt_param_structure_batch_independent():
   """HGTConv materializes per-node-type params for EVERY metadata type,
   so a type absent at init but present at a later apply (or vice versa)
