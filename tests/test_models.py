@@ -413,15 +413,18 @@ def test_hgt_bf16():
   assert np.isfinite(np.asarray(out, np.float32)).all()
 
 
-def test_hierarchical_rgnn_matches_full():
-  """The hierarchical (trim-per-layer) RGNN forward over hetero
-  tree-mode batches matches the full forward on the seed slots."""
+@pytest.mark.parametrize('dedup', ['tree', 'map'])
+def test_hierarchical_rgnn_matches_full(dedup):
+  """The hierarchical (trim-per-layer) RGNN forward matches the full
+  forward on the seed slots — over hetero TREE batches and hetero
+  exact-dedup (merge) batches alike: merge appends stay inside the same
+  per-type hop-prefix bounds, so the identical offsets trim both."""
   import jax
   ds, (CITES, WRITES), n_p = make_hetero_cluster()
   fanouts = {CITES: [3, 2], WRITES: [2, 2]}
   loader = glt.loader.NeighborLoader(
       ds, fanouts, ('paper', np.arange(32)), batch_size=16, seed=0,
-      dedup='tree')
+      dedup=dedup)
   b = next(iter(loader))
   etypes = [glt.typing.reverse_edge_type(CITES),
             glt.typing.reverse_edge_type(WRITES)]
@@ -531,15 +534,17 @@ def test_tree_dense_gat_matches_segment():
                              rtol=5e-5, atol=5e-5)
 
 
-def test_hierarchical_hgt_matches_full():
-  """HGT with hetero tree hop offsets (trim-per-layer) matches the full
-  forward on the seed slots."""
+@pytest.mark.parametrize('dedup', ['tree', 'map'])
+def test_hierarchical_hgt_matches_full(dedup):
+  """HGT with hetero hop offsets (trim-per-layer) matches the full
+  forward on the seed slots — tree and exact-dedup (merge) hetero
+  batches alike (same per-type prefix bounds)."""
   import jax
   ds, (CITES, WRITES), n_p = make_hetero_cluster()
   fanouts = {CITES: [3, 2], WRITES: [2, 2]}
   loader = glt.loader.NeighborLoader(
       ds, fanouts, ('paper', np.arange(32)), batch_size=16, seed=0,
-      dedup='tree')
+      dedup=dedup)
   b = next(iter(loader))
   etypes = tuple(glt.typing.reverse_edge_type(et)
                  for et in (CITES, WRITES))
