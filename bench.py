@@ -289,6 +289,7 @@ def main():
     result['calibrated_caps'] = cal_caps
   else:
     result['map_calibrated_edges_per_sec_m'] = None
+    result['map_calibrated_vs_baseline'] = None
 
   # ---- end-to-end train step (sample + collate + layered SAGE) ----
   try:

@@ -139,6 +139,11 @@ def main():
       out_specs=(PS(), PS(), PS(), PS()),
       check_vma=False))
 
+  # in-process CPU collectives can deadlock when several multi-device
+  # programs are in flight (docs/get_started/dist_train.md "Testing
+  # without hardware") — serialize steps on the CPU mesh; real TPU
+  # collectives ride ICI and need no barrier
+  serialize = jax.default_backend() == 'cpu'
   losses, accs, epoch_times = [], [], []
   for epoch in range(args.epochs):
     t0 = time.perf_counter()
@@ -149,6 +154,8 @@ def main():
           batch.y, nseed)
       losses.append(loss)
       accs.append(acc)
+      if serialize:
+        jax.block_until_ready(loss)
     jax.block_until_ready(params)
     epoch_times.append(time.perf_counter() - t0)
 

@@ -21,11 +21,13 @@ class LinkNeighborLoader(LinkLoader):
                with_weight: bool = False, strategy: str = 'random',
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
-               node_budget: Optional[int] = None):
+               node_budget: Optional[int] = None, dedup: str = 'auto',
+               frontier_caps=None):
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
-        seed=seed, node_budget=node_budget)
+        seed=seed, node_budget=node_budget, dedup=dedup,
+        frontier_caps=frontier_caps)
     super().__init__(data, sampler, edge_label_index, edge_label,
                      neg_sampling, batch_size, shuffle, drop_last,
                      with_edge, collect_features, to_device, seed)
