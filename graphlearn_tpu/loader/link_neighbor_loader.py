@@ -23,6 +23,11 @@ class LinkNeighborLoader(LinkLoader):
                seed: Optional[int] = None,
                node_budget: Optional[int] = None, dedup: str = 'auto',
                frontier_caps=None):
+    # frontier_caps note: link batches seed src+dst(+negatives) — the
+    # effective seed width is 2*batch_size (binary: +2*num_neg,
+    # triplet: +num_neg), NOT batch_size. Calibrate with
+    # estimate_frontier_caps(graph, fanouts, batch_size=<that width>)
+    # or every batch overflows into (clean, but silent) truncation.
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,

@@ -42,7 +42,10 @@ def estimate_frontier_caps(graph, fanouts: Sequence[int], batch_size: int,
     graph: data.Graph (or any object with numpy-convertible
       ``indptr``/``indices``).
     fanouts: the sampler's fanout list.
-    batch_size: seed batch capacity.
+    batch_size: seed batch capacity. For LINK loaders pass the
+      effective seed width (2*batch_size for positives, plus the
+      negatives: binary adds 2*num_neg, triplet adds num_neg) — link
+      batches seed src+dst(+negatives), not batch_size nodes.
     input_nodes: optional seed pool to draw probe seeds from (defaults
       to all nodes — match the loader's seed distribution when you can).
     num_probes: probe batches to simulate.

@@ -402,8 +402,8 @@ def test_padded_window_auto_and_stats():
   assert set(hub_row1.tolist()) != set(hub_row2.tolist())
 
 
-@pytest.mark.parametrize('dedup', ['map', 'map_table', 'sort_legacy',
-                                   'tree'])
+@pytest.mark.parametrize('dedup', ['map', 'map_capped', 'map_table',
+                                   'sort_legacy', 'tree'])
 @pytest.mark.parametrize('strategy,padded', [('random', None),
                                              ('block', None),
                                              ('random', 8)])
@@ -429,10 +429,14 @@ def test_sampler_invariants_random_graphs(dedup, strategy, padded):
     adj = {(int(r), int(c)) for r, c in zip(rows, cols)}
     graph = glt.data.Graph(
         glt.data.Topology(np.stack([rows, cols]), num_nodes=n), 'CPU')
-    s = glt.sampler.NeighborSampler(graph, fanouts, seed=trial,
-                                    fused=True, dedup=dedup,
-                                    strategy=strategy,
-                                    padded_window=padded)
+    # 'map_capped' = exact dedup under DELIBERATELY tight frontier caps:
+    # truncation may trip (clean by contract), every invariant below
+    # must still hold
+    caps = [16, 24] if dedup == 'map_capped' else None
+    s = glt.sampler.NeighborSampler(
+        graph, fanouts, seed=trial, fused=True,
+        dedup='map' if dedup == 'map_capped' else dedup,
+        strategy=strategy, padded_window=padded, frontier_caps=caps)
     seeds = rng.integers(0, n, b)
     out = s.sample_from_nodes(NodeSamplerInput(seeds), batch_cap=b)
     node = np.asarray(out.node)
