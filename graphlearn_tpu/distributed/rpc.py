@@ -17,14 +17,20 @@ RpcCalleeBase (reference rpc.py:371-473), barrier/all_gather
 TRUST MODEL: frames are deserialized with pickle, so anyone who can
 connect can execute arbitrary code — the reference's torch-RPC posture
 (TensorPipe performs no authentication either). This stack removes the
-sharpest edge with a shared-secret HMAC handshake: set ``GLT_RPC_SECRET``
-in the environment (or pass ``secret=``) and every accepted connection
-must answer an HMAC-SHA256 challenge before any frame is processed.
-The handshake is REQUIRED for non-loopback binds (a routable server
-without a secret refuses to start unless ``insecure=True``); loopback
-binds may omit it for parity with local multiprocess use. The network
-boundary (VPC / firewall) remains the outer wall — the handshake
-authenticates peers, it does not encrypt traffic.
+sharpest edge with a shared-secret MUTUAL HMAC handshake: set
+``GLT_RPC_SECRET`` in the environment (or pass ``secret=``) and every
+accepted connection must answer an HMAC-SHA256 challenge before any
+frame is processed, and the server must in turn answer the CLIENT's
+challenge before the client deserializes a single response frame (a
+spoofed/MITM server that does not know the secret is dropped before
+its first pickle reaches the client). The handshake is REQUIRED for
+non-loopback binds (a routable server without a secret refuses to
+start unless ``insecure=True``); loopback binds may omit it for parity
+with local multiprocess use. Residual risk: the handshake authenticates
+peers but does not encrypt or MAC the frames that follow, so an
+attacker who can rewrite established TCP streams (not just connect) can
+still inject pickles — the network boundary (VPC / firewall / TLS
+tunnel) remains the outer wall against that class.
 """
 import hashlib
 import hmac
@@ -50,8 +56,12 @@ def _env_secret() -> Optional[bytes]:
   return s.encode() if s else None
 
 
-def _hmac_of(secret: bytes, nonce: bytes) -> bytes:
-  return hmac.new(secret, nonce, hashlib.sha256).digest()
+def _hmac_of(secret: bytes, nonce: bytes,
+             role: bytes = b'client') -> bytes:
+  # role domain-separates the two handshake directions: without it a
+  # MITM could replay one client's answer as a 'server proof' to
+  # another client (reflection), never knowing the secret
+  return hmac.new(secret, role + nonce, hashlib.sha256).digest()
 
 
 def _send_frame(sock: socket.socket, obj: Any):
@@ -108,16 +118,25 @@ class RpcServer:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
           if outer._secret is not None:
-            # challenge-response BEFORE any pickle leaves the wire:
-            # an unauthenticated peer never reaches the deserializer
+            # mutual challenge-response BEFORE any pickle leaves the
+            # wire: an unauthenticated peer never reaches the
+            # deserializer, and the client hears our proof before it
+            # deserializes our first response frame
             nonce = _secrets.token_bytes(32)
             sock.sendall(nonce)
+            # verify the 32-byte answer BEFORE reading the client's
+            # nonce: a secret-less client's first (pickle) frame can be
+            # shorter than 64 bytes, and blocking on all 64 would
+            # deadlock both sides instead of rejecting promptly
             answer = _recv_exact(sock, 32)
             if not hmac.compare_digest(
                 answer, _hmac_of(outer._secret, nonce)):
               logger.warning('rejected RPC connection from %s: bad '
                              'HMAC handshake', self.client_address)
               return
+            client_nonce = _recv_exact(sock, 32)
+            sock.sendall(_hmac_of(outer._secret, client_nonce,
+                                  role=b'server'))
           while True:
             req = _recv_frame(sock)
             try:
@@ -179,21 +198,38 @@ class RpcClient:
       s = socket.create_connection(self._addrs[rank], timeout=180)
       s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
       if self._secret is not None:
-        # answer the server's HMAC challenge (see module trust model).
-        # Short timeout on the nonce read: a secret-less server sends
-        # no challenge, and without this the config mismatch would hang
-        # for the full 180 s socket timeout with a generic error.
+        # answer the server's HMAC challenge, then verify the server's
+        # answer to OURS before any response frame is unpickled (see
+        # module trust model). Short timeout on the nonce read: a
+        # secret-less server sends no challenge, and without this the
+        # config mismatch would hang for the full 180 s socket timeout
+        # with a generic error.
         s.settimeout(10)
         try:
           nonce = _recv_exact(s, 32)
+          my_nonce = _secrets.token_bytes(32)
+          s.sendall(_hmac_of(self._secret, nonce) + my_nonce)
+          proof = _recv_exact(s, 32)
         except socket.timeout:
           s.close()
           raise ConnectionError(
-              'server sent no HMAC challenge within 10s — secret '
-              f'configured on this client (via {_SECRET_ENV} or '
-              'secret=) but probably not on the server') from None
+              'server did not complete the mutual HMAC handshake '
+              'within 10s — secret configured on this client (via '
+              f'{_SECRET_ENV} or secret=) but probably not on the '
+              'server') from None
+        except (ConnectionError, OSError):
+          # e.g. the server rejected OUR answer (secret mismatch) and
+          # closed mid-handshake; don't leak the half-open socket
+          s.close()
+          raise
+        if not hmac.compare_digest(
+            proof, _hmac_of(self._secret, my_nonce, role=b'server')):
+          s.close()
+          raise ConnectionError(
+              'server failed the mutual HMAC handshake: it does not '
+              'know the shared secret — refusing to deserialize its '
+              'responses')
         s.settimeout(180)
-        s.sendall(_hmac_of(self._secret, nonce))
       conns[rank] = s
     return conns[rank]
 

@@ -135,15 +135,21 @@ class NodeLoader:
     if 'sampler' in state:
       self.sampler.load_state_dict(state['sampler'])
 
-  def __iter__(self):
-    from ..utils import step_annotation
-    # per-epoch padded-table reseed: rows with deg > window expose a
-    # fresh random window-subset each epoch, de-biasing the truncation
-    # (ops.build_padded_adjacency; no-op for non-padded samplers)
+  def _begin_epoch(self):
+    """Per-epoch padded-table reseed: rows with deg > window expose a
+    fresh random window-subset each epoch, de-biasing the truncation
+    (ops.build_padded_adjacency; no-op for non-padded samplers). The
+    single counter lives here so every epoch driver — __iter__ and
+    OverlappedTrainer.run_epoch — shares one view of how many epochs
+    this loader has run."""
     if getattr(self.sampler, 'padded_window', None) is not None:
       if getattr(self, '_epochs_started', 0) > 0:
         self.sampler.refresh_padded_table()
       self._epochs_started = getattr(self, '_epochs_started', 0) + 1
+
+  def __iter__(self):
+    from ..utils import step_annotation
+    self._begin_epoch()
     for i, idx in enumerate(self._batcher):
       with step_annotation('glt_batch', i):
         seeds = self.input_seeds[idx]

@@ -124,6 +124,10 @@ class OverlappedTrainer:
     ``losses`` a list of device scalars (one per step) — fetch once,
     after the epoch, to keep the hot loop pipelined."""
     import jax.numpy as jnp
+    # _seed_batches walks loader._batcher directly (bypassing
+    # NodeLoader.__iter__), so the per-epoch padded-table reseed must be
+    # driven explicitly — same counter as plain iteration
+    self.loader._begin_epoch()
     losses = []
     batch = None
     truncated = False

@@ -151,6 +151,12 @@ def induce_next_merge(state: MergeInducerState, src_idx: jax.Array,
   # _MARK, and candidate payloads (_MARK + pos, pos < size) must fit int32
   assert cap <= _MARK and _MARK + size < 2 ** 31, \
       'batch capacity exceeds payload encoding'
+  # _seg_fill packs its payload into 3 bytes: every value it carries here
+  # (tentative local idx new_idx < num_nodes + num_new <= cap + size) must
+  # fit 2^24. Asserted directly so a future bump of _MARK or the seg-fill
+  # capacity bound fails at trace time instead of corrupting local indices.
+  assert cap + size < (1 << 24), \
+      'cap + hop size exceeds the seg_fill 3-byte payload bound'
   big = jnp.iinfo(state.nodes.dtype).max
 
   flat = nbrs.reshape(-1).astype(state.nodes.dtype)

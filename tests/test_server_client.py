@@ -84,6 +84,57 @@ def test_rpc_hmac_handshake():
       RpcServer(host='0.0.0.0')
 
 
+def test_rpc_mutual_handshake_rejects_imposter_server():
+  """The handshake is MUTUAL: a spoofed server that does not know the
+  secret is dropped by the client before any response frame is
+  unpickled, and a reflection MITM (replaying a client's own answer as
+  the server 'proof') fails because the two directions are
+  domain-separated."""
+  import socket
+  import threading
+  from graphlearn_tpu.distributed import RpcClient
+  from graphlearn_tpu.distributed.rpc import _hmac_of
+
+  def run_fake_server(make_proof, port_holder, ready):
+    ls = socket.socket()
+    ls.bind(('127.0.0.1', 0))
+    ls.listen(1)
+    port_holder.append(ls.getsockname()[1])
+    ready.set()
+    conn, _ = ls.accept()
+    conn.sendall(b'N' * 32)                  # challenge (nonce unused)
+    answer = b''
+    while len(answer) < 64:
+      answer += conn.recv(64 - len(answer))  # client answer + nonce_c
+    conn.sendall(make_proof(answer))
+    try:
+      conn.recv(1024)
+    except OSError:
+      pass
+    conn.close()
+    ls.close()
+
+  scenarios = {
+      # knows no secret at all
+      'bogus': lambda answer: b'P' * 32,
+      # reflection: client-direction HMAC over the client's own nonce —
+      # exactly what a MITM could extort from another client session
+      'reflect': lambda answer: _hmac_of(b'sesame', answer[32:]),
+  }
+  for name, make_proof in scenarios.items():
+    holder, ready = [], threading.Event()
+    t = threading.Thread(target=run_fake_server,
+                         args=(make_proof, holder, ready), daemon=True)
+    t.start()
+    ready.wait(5)
+    cli = RpcClient(secret=b'sesame')
+    cli.add_target(0, '127.0.0.1', holder[0])
+    with pytest.raises((ConnectionError, TimeoutError)):
+      cli.request_sync(0, 'add', 1, 1, timeout=5)
+    cli.close()
+    t.join(5)
+
+
 def test_mp_dist_neighbor_loader():
   ds = make_dataset()
   loader = glt.distributed.MpDistNeighborLoader(
