@@ -31,6 +31,11 @@ def main():
                   help='use the attached TPU devices instead of the '
                        'virtual CPU mesh (single-chip rigs only reach '
                        'mesh_size=1)')
+  ap.add_argument('--compare-calibrated', action='store_true',
+                  help='per mesh size, run the EXACT-dedup engine at '
+                       'worst-case capacities vs calibrated '
+                       'frontier_caps (estimate_frontier_caps on the '
+                       'host CSR) and report the step-time ratio')
   args = ap.parse_args()
 
   import jax
@@ -46,8 +51,17 @@ def main():
   n = args.num_nodes
   rng = np.random.default_rng(0)
   rows = rng.integers(0, n, n * args.avg_deg)
-  cols = rng.integers(0, n, n * args.avg_deg)
+  # bench.py's products-like degree mix: half uniform, half zipf head —
+  # uniform-only cols have no dedup overlap, which would make the
+  # exact-dedup comparisons vacuous
+  e = n * args.avg_deg
+  cols = np.empty(e, np.int64)
+  cols[:e // 2] = rng.integers(0, n, e // 2)
+  cols[e // 2:] = rng.zipf(1.5, e - e // 2) % n
   eids = np.arange(rows.shape[0])
+  host_topo = None
+  if args.compare_calibrated:
+    host_topo = glt.data.Topology(np.stack([rows, cols]), num_nodes=n)
 
   for p in [int(x) for x in args.mesh_sizes.split(',')]:
     if p > len(jax.devices()):
@@ -61,15 +75,45 @@ def main():
           edge_index=np.stack([rows[m], cols[m]]), eids=eids[m]))
     mesh = Mesh(np.array(jax.devices()[:p]), ('g',))
     dg = glt.distributed.DistGraph(p, 0, parts, node_pb)
+    seeds = rng.integers(0, n, (p, args.batch_size)).astype(np.int32)
+
+    def timed(sampler):
+      outs = [sampler.sample_from_nodes(seeds) for _ in range(3)]
+      jax.block_until_ready([o.edge_mask for o in outs])
+      t0 = time.perf_counter()
+      outs = [sampler.sample_from_nodes(seeds)
+              for _ in range(args.iters)]
+      jax.block_until_ready([o.edge_mask for o in outs])
+      return time.perf_counter() - t0, outs[-1]
+
+    if args.compare_calibrated:
+      from graphlearn_tpu.sampler.calibrate import estimate_frontier_caps
+      caps = estimate_frontier_caps(host_topo, list(args.fanout),
+                                    args.batch_size)
+      full = glt.distributed.DistNeighborSampler(
+          dg, list(args.fanout), mesh, seed=0, dedup='merge')
+      cal = glt.distributed.DistNeighborSampler(
+          dg, list(args.fanout), mesh, seed=0, dedup='merge',
+          frontier_caps=caps)
+      dt_full, _ = timed(full)
+      dt_cal, out = timed(cal)
+      print(json.dumps({
+          'metric': 'dist_exact_calibrated_speedup',
+          'mesh_size': p,
+          'value': round(dt_full / dt_cal, 3),
+          'full_ms_per_step': round(1e3 * dt_full / args.iters, 2),
+          'calibrated_ms_per_step': round(1e3 * dt_cal / args.iters, 2),
+          'frontier_caps': [int(c) for c in caps],
+          'full_plan': full._capacities(args.batch_size),
+          'calibrated_plan': cal.hop_caps(args.batch_size),
+          'overflow': bool(np.any(np.asarray(out.metadata['overflow']))),
+          'backend': jax.default_backend(),
+      }), flush=True)
+      continue
+
     sampler = glt.distributed.DistNeighborSampler(
         dg, list(args.fanout), mesh, seed=0)
-    seeds = rng.integers(0, n, (p, args.batch_size)).astype(np.int32)
-    outs = [sampler.sample_from_nodes(seeds) for _ in range(3)]
-    jax.block_until_ready([o.edge_mask for o in outs])
-    t0 = time.perf_counter()
-    outs = [sampler.sample_from_nodes(seeds) for _ in range(args.iters)]
-    jax.block_until_ready([o.edge_mask for o in outs])
-    dt = time.perf_counter() - t0
+    dt, _ = timed(sampler)
     print(json.dumps({
         'metric': 'dist_loader_seed_batches_per_sec',
         'mesh_size': p,

@@ -16,21 +16,24 @@ from typing import List, Optional
 import numpy as np
 
 from ..loader import Data
+from ..loader.node_loader import OverflowGuardMixin
 from ..sampler import NodeSamplerInput
 from .dist_dataset import DistDataset
 from .dist_neighbor_sampler import DistNeighborSampler
 
 
-class DistLoader:
+class DistLoader(OverflowGuardMixin):
   """Reference: dist_loader.py:128-441 (collocated branch)."""
 
   def __init__(self, data: DistDataset, sampler: DistNeighborSampler,
                input_nodes, batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = True, collect_features: bool = True,
                seed: Optional[int] = None,
-               seed_labels_only: bool = False):
+               seed_labels_only: bool = False,
+               overflow_policy: str = 'raise'):
     self.data = data
     self.sampler = sampler
+    self._init_overflow_policy(overflow_policy)
     # seed_labels_only: gather y for the per-shard seed block only
     # (supervision reads seed slots; skips a full-capacity sharded
     # label gather — the same knob as the local loaders)
@@ -90,12 +93,25 @@ class DistLoader:
 
   def __iter__(self):
     from ..utils import step_annotation
+    guarded, recompute = self._overflow_epoch_start()
     for i, (idx, mask) in enumerate(self._index_blocks()):
       with step_annotation('glt_dist_batch', i):
-        out = self.sampler.sample_from_nodes(
-            NodeSamplerInput(self.input_seeds[idx], self.input_type),
-            seed_mask=mask)
+        inp = NodeSamplerInput(self.input_seeds[idx], self.input_type)
+        if recompute:
+          keys = self.sampler._next_keys()
+          out = self.sampler.sample_from_nodes(inp, seed_mask=mask,
+                                               keys=keys)
+          if self._batch_overflowed(out):
+            self.overflow_recomputes += 1
+            out = self._replay_sampler().sample_from_nodes(
+                inp, seed_mask=mask, keys=keys)
+        else:
+          out = self.sampler.sample_from_nodes(inp, seed_mask=mask)
+          if guarded:
+            self._accumulate_overflow(out)
         yield self._collate_fn(out)
+    if guarded and not recompute:
+      self._finish_epoch_overflow()
 
   def _collate_fn(self, out):
     """SamplerOutput [P, ...] -> stacked Data/HeteroData (reference:
@@ -322,7 +338,8 @@ class DistLinkNeighborLoader(DistLoader):
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
                with_weight: bool = False, dedup: str = 'sort',
-               bucket_frac=2.0, neg_strict: bool = False):
+               bucket_frac=2.0, neg_strict: bool = False,
+               frontier_caps=None, overflow_policy: str = 'raise'):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -338,14 +355,19 @@ class DistLinkNeighborLoader(DistLoader):
     self.edge_label = (np.asarray(edge_label).reshape(-1)
                        if edge_label is not None else None)
     self.neg_sampling = neg_sampling
+    # frontier_caps: calibrate against the effective PER-SHARD seed
+    # width — the engine derives it internally from batch_size and
+    # neg_sampling (calibrate.link_seed_width); pass caps estimated at
+    # that width
     sampler = DistNeighborSampler(
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
         with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac,
-        neg_strict=neg_strict)
+        neg_strict=neg_strict, frontier_caps=frontier_caps)
     super().__init__(data, sampler, np.zeros(0, np.int64), batch_size,
-                     shuffle, drop_last, collect_features, seed)
+                     shuffle, drop_last, collect_features, seed,
+                     overflow_policy=overflow_policy)
     self.input_type = input_type  # EdgeType for hetero link sampling
 
   def _num_seeds(self):
@@ -353,16 +375,29 @@ class DistLinkNeighborLoader(DistLoader):
 
   def __iter__(self):
     from ..sampler import EdgeSamplerInput
+    guarded, recompute = self._overflow_epoch_start()
     for idx, mask in self._index_blocks():
-      out = self.sampler.sample_from_edges(
-          EdgeSamplerInput(
-              self.seed_rows[idx], self.seed_cols[idx],
-              label=(self.edge_label[idx]
-                     if self.edge_label is not None else None),
-              input_type=self.input_type,
-              neg_sampling=self.neg_sampling),
-          seed_mask=mask)
+      inputs = EdgeSamplerInput(
+          self.seed_rows[idx], self.seed_cols[idx],
+          label=(self.edge_label[idx]
+                 if self.edge_label is not None else None),
+          input_type=self.input_type,
+          neg_sampling=self.neg_sampling)
+      if recompute:
+        keys = self.sampler._next_keys()
+        out = self.sampler.sample_from_edges(inputs, seed_mask=mask,
+                                             keys=keys)
+        if self._batch_overflowed(out):
+          self.overflow_recomputes += 1
+          out = self._replay_sampler().sample_from_edges(
+              inputs, seed_mask=mask, keys=keys)
+      else:
+        out = self.sampler.sample_from_edges(inputs, seed_mask=mask)
+        if guarded:
+          self._accumulate_overflow(out)
       yield self._collate_fn(out)
+    if guarded and not recompute:
+      self._finish_epoch_overflow()
 
 
 class DistSubGraphLoader(DistLoader):
@@ -405,7 +440,8 @@ class DistNeighborLoader(DistLoader):
                collect_features: bool = True, seed: Optional[int] = None,
                node_budget: Optional[int] = None, mesh=None,
                with_weight: bool = False, dedup: str = 'sort',
-               seed_labels_only: bool = False, bucket_frac=2.0):
+               seed_labels_only: bool = False, bucket_frac=2.0,
+               frontier_caps=None, overflow_policy: str = 'raise'):
     if mesh is None:
       from .dist_context import get_context
       ctx = get_context()
@@ -414,7 +450,9 @@ class DistNeighborLoader(DistLoader):
         data.graph, num_neighbors, mesh,
         dist_feature=data.node_features, with_edge=with_edge, seed=seed,
         node_budget=node_budget, collect_features=collect_features,
-        with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac)
+        with_weight=with_weight, dedup=dedup, bucket_frac=bucket_frac,
+        frontier_caps=frontier_caps)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, collect_features, seed,
-                     seed_labels_only=seed_labels_only)
+                     seed_labels_only=seed_labels_only,
+                     overflow_policy=overflow_policy)

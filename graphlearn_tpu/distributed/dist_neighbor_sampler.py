@@ -91,9 +91,13 @@ def _exchange_hop_hier(garr, pb, frontier, fmask, k, key, sizes,
   Stage 1 transposes along 'chip' at FULL frontier width — intra-slice
   traffic rides ICI, where the loss-free full-width posture is cheap.
   Stage 2 buckets the aggregated per-chip-column ids by destination
-  slice at ``bucket_frac``-fractional capacity — the DCN hop carries
-  S buckets of C*bf*frac/S instead of (P-C) full-width buckets, so
-  cross-slice bytes shrink ~S/frac x. Overflow (psum over both axes,
+  slice — the DCN hop carries S aggregated buckets instead of (P-C)
+  per-chip-pair ones. Stage-2 capacity is sized on the MEAN VALID load,
+  not the slot count: after stage 1 each chip holds C peers' buckets of
+  ~bf/C valid ids each, i.e. ~bf valid ids spread over C*bf slots, so a
+  per-slice bucket needs ~bf/S slots (x bucket_frac slack). Sizing on
+  slots (the round-3 posture, C*bf*frac/S) shipped C x more DCN bytes
+  than the valid load requires. Overflow (psum over both axes,
   replicated) falls back to the flat full-width exchange — loss-free on
   every input. Responses retrace both transposes.
   """
@@ -113,7 +117,8 @@ def _exchange_hop_hier(garr, pb, frontier, fmask, k, key, sizes,
   mid_mask = mid >= 0
   mdest = jnp.where(mid_mask, pb[jnp.maximum(mid, 0)] // c_sz, s_sz)
   slot2, ok2f = ops.route_slots(mdest, mid_mask, capacity=c_sz * bf)
-  cap2 = exchange_capacity(c_sz * bf, s_sz, bucket_frac)
+  cap2 = (c_sz * bf if bucket_frac is None or s_sz <= 1 else
+          min(c_sz * bf, _round8(int(bucket_frac * bf / s_sz))))
 
   def hier_path(_):
     ok2 = ok2f & (slot2 < cap2)
@@ -271,6 +276,9 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
   rows, cols, edges, emasks = [], [], [], []
   nodes_per_hop = [state.num_nodes]
   edges_per_hop = []
+  # on-device truncation flag for clamped exact plans (calibrated
+  # frontier_caps): psum'd below so every shard reports the SAME verdict
+  overflow = jnp.zeros((), bool)
   from ..sampler.neighbor_sampler import (merge_layout_from_caps,
                                           tree_layout_from_caps)
   if dedup == 'tree':
@@ -293,10 +301,16 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
       edges.append(jnp.where(out['edge_mask'], e.reshape(-1), -1))
     nodes_per_hop.append(out['num_new'])
     edges_per_hop.append(out['edge_mask'].sum())
+    if dedup == 'merge' and caps[i + 1] < caps[i] * k:
+      overflow = overflow | (out['num_new'] > caps[i + 1])
     nxt = caps[i + 1]
     frontier = out['frontier'][:nxt]
     fidx = out['frontier_idx'][:nxt]
     fmask = out['frontier_mask'][:nxt]
+  if any(dedup == 'merge' and caps[i + 1] < caps[i] * k
+         for i, k in enumerate(fanouts)):
+    # replicated verdict: ANY shard's truncation taints the step
+    overflow = jax.lax.psum(overflow.astype(jnp.int32), axes) > 0
   if not fanouts:
     rows = [jnp.zeros((0,), jnp.int32)]
     cols = [jnp.zeros((0,), jnp.int32)]
@@ -311,7 +325,8 @@ def _homo_hop_loop(gdev, pb, seeds, smask, key, fanouts, caps,
       edge_mask=jnp.concatenate(emasks),
       seed_inverse=inv,
       num_sampled_nodes=jnp.stack(nodes_per_hop),
-      num_sampled_edges=jnp.stack(edges_per_hop))
+      num_sampled_edges=jnp.stack(edges_per_hop),
+      overflow=overflow)
   if with_edge:
     res['edge'] = jnp.concatenate(edges)
   return res
@@ -345,7 +360,8 @@ class DistNeighborSampler:
                node_budget: Optional[int] = None,
                collect_features: bool = False,
                with_weight: bool = False, dedup: str = 'sort',
-               bucket_frac=2.0, neg_strict: bool = False):
+               bucket_frac=2.0, neg_strict: bool = False,
+               frontier_caps=None):
     import jax
     self.graph = dist_graph
     self.is_hetero = dist_graph.is_hetero
@@ -383,6 +399,33 @@ class DistNeighborSampler:
                        "engine supports 'sort'/'map'/'merge' (exact) and "
                        "'tree'")
     self.dedup = dedup
+    # frontier_caps: per-hop post-dedup frontier capacity clamps — the
+    # calibrated-capacity mechanism, now on the distributed engine too.
+    # Every per-shard buffer (exchange frontier, inducer append block,
+    # node buffer, collate gather) shrinks from the worst-case
+    # ``caps[i]*k`` to the calibrated bound; overflow is tracked
+    # ON DEVICE per batch (psum'd, replicated) and surfaced through
+    # metadata['overflow'] so DistLoader's overflow_policy can raise or
+    # replay at full capacities (see sampler/calibrate.py; reference
+    # parity target: exact semantics at sub-worst-case cost, the
+    # dynamic-shape posture of dist_neighbor_sampler.py:585-648).
+    if frontier_caps is not None:
+      if isinstance(frontier_caps, str):
+        raise ValueError(
+            f'frontier_caps={frontier_caps!r}: the distributed engine '
+            'takes an explicit per-hop caps list — calibrate on the '
+            'host CSR with sampler.calibrate.estimate_frontier_caps '
+            "(batch_size = the PER-SHARD seed width); 'auto' exists on "
+            'the local loaders only')
+      if self.is_hetero:
+        raise ValueError('frontier_caps is homogeneous-only (the typed '
+                         'engine plans capacities per edge type)')
+      if self.dedup == 'tree':
+        raise ValueError('frontier_caps requires an exact-dedup mode '
+                         "('sort'/'map'/'merge'); tree frontiers are "
+                         'positional, use node_budget there')
+    self.frontier_caps = (tuple(frontier_caps)
+                          if frontier_caps is not None else None)
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     # every-axis collectives: ('g',) on the flat mesh, or
     # ('slice', 'chip') on a 2-axis multi-slice mesh (init_multihost
@@ -444,14 +487,38 @@ class DistNeighborSampler:
           'different sampler type; resuming would diverge')
     self._key = jnp.asarray(np.asarray(state['key'], np.uint32))
 
-  def _capacities(self, b: int):
-    caps = [b]
-    for k in self.num_neighbors:
-      nxt = caps[-1] * k
-      if self.node_budget is not None:
-        nxt = min(nxt, self.node_budget)
-      caps.append(nxt)
-    return caps
+  def _capacities(self, b: int, with_frontier_caps: bool = True):
+    """Per-hop frontier capacity plan (single-chip capacity_plan with the
+    node_budget and calibrated frontier_caps clamps). The subgraph
+    builder passes ``with_frontier_caps=False``: its legacy inducer has
+    no clean-truncation contract, so calibration must not clamp it."""
+    from ..sampler.neighbor_sampler import capacity_plan
+    return capacity_plan(
+        b, list(self.num_neighbors), self.node_budget,
+        self.frontier_caps if with_frontier_caps else None)
+
+  def hop_caps(self, batch_cap: int) -> List[int]:
+    """Resolved per-hop frontier capacities (per shard) — the
+    distributed counterpart of NeighborSampler.hop_caps, consumed by
+    calibrate.check_no_overflow."""
+    return self._capacities(batch_cap)
+
+  @property
+  def clamped_exact(self) -> bool:
+    """True when the engine runs exact dedup under calibrated
+    frontier_caps — results then carry a replicated on-device
+    metadata['overflow'] flag (see DistLoader overflow_policy)."""
+    return self.frontier_caps is not None and self.dedup == 'merge'
+
+  def uncapped_clone(self) -> 'DistNeighborSampler':
+    """Sampler sharing this one's device arrays / mesh / PRNG base but
+    with NO frontier_caps — the full-capacity replay target for
+    overflow recovery."""
+    import copy
+    clone = copy.copy(self)
+    clone.frontier_caps = None
+    clone._fns = {}
+    return clone
 
   def _node_cap(self, caps) -> int:
     if self.dedup == 'tree':
@@ -532,7 +599,8 @@ class DistNeighborSampler:
 
     out_specs = dict(node=P(ax), num_nodes=P(ax), row=P(ax),
                      col=P(ax), edge_mask=P(ax), seed_inverse=P(ax),
-                     num_sampled_nodes=P(ax), num_sampled_edges=P(ax))
+                     num_sampled_nodes=P(ax), num_sampled_edges=P(ax),
+                     overflow=P(ax))
     if with_edge:
       out_specs['edge'] = P(ax)
     fn = shard_map(
@@ -624,7 +692,8 @@ class DistNeighborSampler:
       return _lift(res)
 
     out_keys = ['node', 'num_nodes', 'row', 'col', 'edge_mask',
-                'seed_inverse', 'num_sampled_nodes', 'num_sampled_edges']
+                'seed_inverse', 'num_sampled_nodes', 'num_sampled_edges',
+                'overflow']
     if with_edge:
       out_keys.append('edge')
     if mode in ('none', 'binary'):
@@ -663,7 +732,9 @@ class DistNeighborSampler:
     nparts = self.graph.num_partitions
     fanouts = tuple(self.num_neighbors)
     ax = self._axes
-    caps = self._capacities(b)
+    # legacy inducer: no clean-truncation contract — never clamp it
+    # with calibrated caps
+    caps = self._capacities(b, with_frontier_caps=False)
     node_cap = sum(caps)
     with_edge = self.with_edge
     weighted = self._weighted_for()
@@ -1065,7 +1136,7 @@ class DistNeighborSampler:
 
   # ------------------------------------------------------------ public API
 
-  def sample_from_nodes(self, inputs, seed_mask=None,
+  def sample_from_nodes(self, inputs, seed_mask=None, keys=None,
                         **kwargs) -> SamplerOutput:
     """Sample per-shard batches: seeds [P, B] (or [P*B] flat, split evenly).
 
@@ -1074,6 +1145,10 @@ class DistNeighborSampler:
     a data-parallel train step on the same mesh. ``seed_mask`` (same shape
     as seeds) marks padding seeds False — they produce no nodes/edges and
     are excluded from num_nodes (used by DistLoader's final short batch).
+    ``keys``: explicit per-shard PRNG keys (default: the carried stream)
+    — loaders replay overflowed calibrated batches at full capacities
+    with the SAME keys, yielding the untruncated version of the
+    identical draw.
     """
     import jax.numpy as jnp
     input_ntype = None
@@ -1102,7 +1177,7 @@ class DistNeighborSampler:
     if b not in self._fns:
       self._fns[b] = self._build_fn(b)
     res = self._fns[b](jnp.asarray(seeds, jnp.int32), jnp.asarray(smask),
-                       self._next_keys())
+                       keys if keys is not None else self._next_keys())
     return SamplerOutput(
         node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
         col=res['col'], edge=res.get('edge'), edge_mask=res['edge_mask'],
@@ -1110,10 +1185,11 @@ class DistNeighborSampler:
         num_sampled_nodes=res['num_sampled_nodes'],
         num_sampled_edges=res['num_sampled_edges'],
         metadata={'seed_inverse': res['seed_inverse'],
-                  'seed_mask': jnp.asarray(smask)})
+                  'seed_mask': jnp.asarray(smask),
+                  'overflow': res['overflow']})
 
   def sample_from_edges(self, inputs: EdgeSamplerInput, seed_mask=None,
-                        **kwargs):
+                        keys=None, **kwargs):
     """Distributed link sampling: seed edges [P, B] per shard (reference:
     _sample_from_edges, dist_neighbor_sampler.py:369-496).
 
@@ -1161,7 +1237,8 @@ class DistNeighborSampler:
         self._fns[sig] = self._build_link_fn(b, num_neg, mode)
       res = self._fns[sig](jnp.asarray(rows, jnp.int32),
                            jnp.asarray(cols, jnp.int32),
-                           jnp.asarray(smask), self._next_keys())
+                           jnp.asarray(smask),
+                           keys if keys is not None else self._next_keys())
       out = SamplerOutput(
           node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
           col=res['col'], edge=res.get('edge'),
@@ -1171,7 +1248,8 @@ class DistNeighborSampler:
           num_sampled_nodes=res['num_sampled_nodes'],
           num_sampled_edges=res['num_sampled_edges'],
           metadata={'seed_inverse': res['seed_inverse'],
-                    'seed_mask': jnp.asarray(smask)})
+                    'seed_mask': jnp.asarray(smask),
+                    'overflow': res['overflow']})
 
     if mode in ('none', 'binary'):
       label = (jnp.asarray(np.asarray(inputs.label).reshape(p, b))
@@ -1213,7 +1291,7 @@ class DistNeighborSampler:
              else np.asarray(seed_mask).reshape(seeds.shape))
     if max_degree is None:
       max_degree = self._global_max_degree()
-    node_cap = sum(self._capacities(b))
+    node_cap = sum(self._capacities(b, with_frontier_caps=False))
     buf_elems = self.graph.num_partitions * node_cap * max_degree
     if buf_elems > (1 << 25):
       import warnings

@@ -24,7 +24,8 @@ class LinkLoader(NodeLoader):
                batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, to_device=None,
-               seed: Optional[int] = None):
+               seed: Optional[int] = None,
+               overflow_policy: str = 'raise'):
     if isinstance(edge_label_index, tuple) and len(edge_label_index) == 2 \
         and isinstance(edge_label_index[0], (tuple, list)) \
         and len(edge_label_index[0]) == 3:
@@ -43,15 +44,28 @@ class LinkLoader(NodeLoader):
     self.collect_features = collect_features
     self.to_device = to_device
     self.input_type = self.edge_type
+    self._init_overflow_policy(overflow_policy)
     self._batcher = SeedBatcher(len(self.rows), batch_size, shuffle,
                                 drop_last, seed)
     del with_edge
 
   def __iter__(self):
+    guarded, recompute = self._overflow_epoch_start()
     for idx in self._batcher:
       inputs = EdgeSamplerInput(
           row=self.rows[idx], col=self.cols[idx],
           label=self.edge_label[idx] if self.edge_label is not None else
           None, input_type=self.edge_type, neg_sampling=self.neg_sampling)
-      out = self.sampler.sample_from_edges(inputs)
+      if recompute:
+        key = self.sampler._next_key()
+        out = self.sampler.sample_from_edges(inputs, key=key)
+        if self._batch_overflowed(out):
+          self.overflow_recomputes += 1
+          out = self._replay_sampler().sample_from_edges(inputs, key=key)
+      else:
+        out = self.sampler.sample_from_edges(inputs)
+        if guarded:
+          self._accumulate_overflow(out)
       yield self._collate_fn(out)
+    if guarded and not recompute:
+      self._finish_epoch_overflow()

@@ -22,12 +22,36 @@ class LinkNeighborLoader(LinkLoader):
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
                node_budget: Optional[int] = None, dedup: str = 'auto',
-               frontier_caps=None):
-    # frontier_caps note: link batches seed src+dst(+negatives) — the
-    # effective seed width is 2*batch_size (binary: +2*num_neg,
-    # triplet: +num_neg), NOT batch_size. Calibrate with
-    # estimate_frontier_caps(graph, fanouts, batch_size=<that width>)
-    # or every batch overflows into (clean, but silent) truncation.
+               frontier_caps=None, overflow_policy: str = 'raise'):
+    # Link batches seed src+dst(+negatives), so the calibration width is
+    # NOT batch_size — it is calibrate.link_seed_width(batch_size,
+    # neg_sampling). frontier_caps='auto' computes that width and
+    # calibrates against this loader's own endpoint pool, so callers
+    # never hand-derive it. (Explicit caps lists are taken as-is — they
+    # must have been estimated at the same effective width.)
+    if isinstance(frontier_caps, str):
+      if frontier_caps != 'auto':
+        raise ValueError(f'frontier_caps={frontier_caps!r}: pass a list '
+                         "of per-hop caps or 'auto'")
+      import numpy as np
+      from ..sampler.calibrate import (estimate_frontier_caps,
+                                       link_seed_width)
+      ns = (NegativeSampling.cast(neg_sampling)
+            if neg_sampling is not None else None)
+      eli = (edge_label_index[1]
+             if isinstance(edge_label_index, tuple) and
+             len(edge_label_index) == 2 and
+             isinstance(edge_label_index[0], (tuple, list))
+             else edge_label_index)
+      eli = np.asarray(eli)
+      # probe pool: the positive endpoints. Negative seeds are uniform
+      # nodes — endpoint neighborhoods are at least as dense, so probing
+      # the full width from the endpoint pool stays an upper bound.
+      pool = np.concatenate([eli[0].reshape(-1), eli[1].reshape(-1)])
+      frontier_caps = estimate_frontier_caps(
+          data.graph, list(num_neighbors),
+          link_seed_width(batch_size, ns), input_nodes=pool,
+          seed=seed or 0)
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
@@ -35,4 +59,5 @@ class LinkNeighborLoader(LinkLoader):
         frontier_caps=frontier_caps)
     super().__init__(data, sampler, edge_label_index, edge_label,
                      neg_sampling, batch_size, shuffle, drop_last,
-                     with_edge, collect_features, to_device, seed)
+                     with_edge, collect_features, to_device, seed,
+                     overflow_policy=overflow_policy)

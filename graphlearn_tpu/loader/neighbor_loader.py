@@ -23,7 +23,20 @@ class NeighborLoader(NodeLoader):
                node_budget: Optional[int] = None, dedup: str = 'auto',
                padded_window: Optional[int] = None,
                seed_labels_only: bool = False,
-               frontier_caps=None):
+               frontier_caps=None, overflow_policy: str = 'raise'):
+    # frontier_caps='auto': calibrate in-loader against THIS loader's
+    # seed pool and batch size (sampler.calibrate), so no caller ever
+    # hand-computes calibration widths
+    if isinstance(frontier_caps, str):
+      if frontier_caps != 'auto':
+        raise ValueError(f'frontier_caps={frontier_caps!r}: pass a list '
+                         "of per-hop caps or 'auto'")
+      from ..sampler.calibrate import estimate_frontier_caps
+      pool = (input_nodes[1] if isinstance(input_nodes, tuple)
+              else input_nodes)
+      frontier_caps = estimate_frontier_caps(
+          data.graph, list(num_neighbors), batch_size, input_nodes=pool,
+          seed=seed or 0)
     sampler = NeighborSampler(
         data.graph, num_neighbors, device=to_device, with_edge=with_edge,
         with_weight=with_weight, strategy=strategy, edge_dir=data.edge_dir,
@@ -31,4 +44,5 @@ class NeighborLoader(NodeLoader):
         padded_window=padded_window, frontier_caps=frontier_caps)
     super().__init__(data, sampler, input_nodes, batch_size, shuffle,
                      drop_last, with_edge, collect_features, to_device,
-                     seed, seed_labels_only=seed_labels_only)
+                     seed, seed_labels_only=seed_labels_only,
+                     overflow_policy=overflow_policy)

@@ -88,7 +88,99 @@ class SeedBatcher:
     self._consumed = self._pending_skip
 
 
-class NodeLoader:
+class OverflowGuardMixin:
+  """Calibrated-caps overflow guard shared by the local and distributed
+  loaders.
+
+  Calibrated frontier_caps (sampler.calibrate) keep exact-dedup batches
+  ~5x smaller than worst case, but a batch whose unique frontier exceeds
+  a cap is TRUNCATED — quietly biased if nobody looks. The reference can
+  never truncate (dynamic shapes), so silent truncation must not be
+  reachable here by default either. Every sampled batch carries an
+  on-device metadata['overflow'] flag; the loader applies
+  ``overflow_policy``:
+
+    'raise' (default) — accumulate the flag ON DEVICE (no host sync in
+        the hot loop) and fetch it ONCE at epoch end; raise if any batch
+        truncated. Loud, zero dispatch-pipeline cost.
+    'warn'      — same, warnings.warn instead of raising.
+    'recompute' — check each batch's flag on the host and recompute
+        offenders at FULL capacities with the SAME PRNG key (the
+        untruncated version of the identical draw — exact by
+        construction). Costs one device->host sync per batch: correct
+        unconditionally, so benchmarks opt into 'raise'/'off'
+        explicitly.
+    'off'       — round-3 behavior (truncation only visible via
+        calibrate.check_no_overflow).
+  """
+
+  # defaults for subclasses that skip __init__ (guard inactive)
+  overflow_policy = 'off'
+  overflow_recomputes = 0
+  _ovf_accum = None
+  _full_sampler = None
+
+  _OVERFLOW_POLICIES = ('raise', 'warn', 'recompute', 'off')
+
+  def _init_overflow_policy(self, policy: str):
+    if policy not in self._OVERFLOW_POLICIES:
+      raise ValueError(f'overflow_policy {policy!r} not in '
+                       f'{self._OVERFLOW_POLICIES}')
+    self.overflow_policy = policy
+    self.overflow_recomputes = 0   # total full-caps replays ('recompute')
+    self._ovf_accum = None         # on-device accumulated flag
+    self._full_sampler = None      # lazy uncapped clone
+
+  def _overflow_guarded(self) -> bool:
+    return getattr(self.sampler, 'clamped_exact', False) and \
+        self.overflow_policy != 'off'
+
+  def _overflow_epoch_start(self):
+    """(guarded, recompute) for this epoch. Also DROPS any flag
+    accumulated by a previous, early-exited epoch — a stale flag would
+    otherwise make the next clean epoch raise (an early break already
+    forfeited that epoch's verdict; it must not taint this one)."""
+    self._ovf_accum = None
+    guarded = self._overflow_guarded()
+    return guarded, guarded and self.overflow_policy == 'recompute'
+
+  def _accumulate_overflow(self, out):
+    import jax.numpy as jnp
+    flag = out.metadata.get('overflow')
+    if flag is None:
+      return
+    flag = jnp.any(flag)
+    self._ovf_accum = (flag if self._ovf_accum is None
+                       else jnp.logical_or(self._ovf_accum, flag))
+
+  def _batch_overflowed(self, out) -> bool:
+    flag = out.metadata.get('overflow')
+    return flag is not None and bool(np.any(np.asarray(flag)))
+
+  def _replay_sampler(self):
+    if self._full_sampler is None:
+      self._full_sampler = self.sampler.uncapped_clone()
+    return self._full_sampler
+
+  def _finish_epoch_overflow(self):
+    if self._ovf_accum is None:
+      return
+    flag, self._ovf_accum = self._ovf_accum, None
+    if bool(np.asarray(flag)):
+      msg = (
+          'calibrated frontier_caps overflowed this epoch: at least one '
+          'batch was truncated (quietly biased). Re-calibrate with more '
+          'slack (sampler.calibrate.estimate_frontier_caps), or pass '
+          "overflow_policy='recompute' to replay offending batches at "
+          'full capacities (exact, one host sync per batch).')
+      if self.overflow_policy == 'warn':
+        import warnings
+        warnings.warn(msg, stacklevel=2)
+      else:
+        raise RuntimeError(msg)
+
+
+class NodeLoader(OverflowGuardMixin):
   """Sample-and-collate loader over seed nodes
   (reference: loader/node_loader.py:27-113)."""
 
@@ -99,7 +191,8 @@ class NodeLoader:
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
-               seed_labels_only: bool = False):
+               seed_labels_only: bool = False,
+               overflow_policy: str = 'raise'):
     self.data = data
     self.sampler = node_sampler
     # seed_labels_only: gather y for the seed block only (supervision
@@ -113,6 +206,7 @@ class NodeLoader:
     self.batch_size = batch_size
     self.collect_features = collect_features
     self.to_device = to_device
+    self._init_overflow_policy(overflow_policy)
     self._batcher = SeedBatcher(len(self.input_seeds), batch_size, shuffle,
                                 drop_last, seed)
     del with_edge  # carried by the sampler
@@ -150,13 +244,28 @@ class NodeLoader:
   def __iter__(self):
     from ..utils import step_annotation
     self._begin_epoch()
+    guarded, recompute = self._overflow_epoch_start()
     for i, idx in enumerate(self._batcher):
       with step_annotation('glt_batch', i):
         seeds = self.input_seeds[idx]
-        out = self.sampler.sample_from_nodes(
-            NodeSamplerInput(seeds, self.input_type),
-            batch_cap=self.batch_size)
+        inp = NodeSamplerInput(seeds, self.input_type)
+        if recompute:
+          key = self.sampler._next_key()
+          out = self.sampler.sample_from_nodes(inp,
+                                               batch_cap=self.batch_size,
+                                               key=key)
+          if self._batch_overflowed(out):
+            self.overflow_recomputes += 1
+            out = self._replay_sampler().sample_from_nodes(
+                inp, batch_cap=self.batch_size, key=key)
+        else:
+          out = self.sampler.sample_from_nodes(inp,
+                                               batch_cap=self.batch_size)
+          if guarded:
+            self._accumulate_overflow(out)
         yield self._collate_fn(out)
+    if guarded and not recompute:
+      self._finish_epoch_overflow()
 
   # -- collate (reference: node_loader.py:85-113) --------------------------
   #

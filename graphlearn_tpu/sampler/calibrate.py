@@ -101,6 +101,20 @@ def estimate_frontier_caps(graph, fanouts: Sequence[int], batch_size: int,
   return [_round_up(int(m * slack), multiple) for m in maxima]
 
 
+def link_seed_width(batch_size: int, neg_sampling=None) -> int:
+  """EFFECTIVE seed width of one link-loader batch: src + dst positives
+  (2*batch_size) plus the negatives the sampler seeds alongside them
+  (binary adds both endpoints of each negative, triplet only the dst
+  candidate). This is the ``batch_size`` to calibrate frontier caps
+  against for link loaders — the loaders compute it themselves
+  (``frontier_caps='auto'``), so no caller has to hand-derive it."""
+  if neg_sampling is None:
+    return 2 * batch_size
+  num_neg = neg_sampling.num_negatives(batch_size)
+  return 2 * batch_size + \
+      (2 * num_neg if neg_sampling.is_binary() else num_neg)
+
+
 def check_no_overflow(sampler, out, batch_cap: Optional[int] = None):
   """True iff no hop of ``out`` exceeded the sampler's frontier caps
   (host fetch — call at epoch end, not per batch)."""

@@ -269,6 +269,10 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
     rows, cols, edges, emasks = [], [], [], []
     nodes_per_hop = [state.num_nodes]
     edges_per_hop = []
+    # on-device truncation flag: True iff ANY clamped hop produced more
+    # new uniques than its cap kept (the merge engine reports the RAW
+    # count). Constant False on unclamped plans — XLA folds it away.
+    overflow = jnp.zeros((), bool)
     keys = jax.random.split(key, len(fanouts))
     if mode == 'tree':
       node_offs, _ = tree_layout_from_caps(caps, fanouts)
@@ -310,6 +314,8 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
         edges.append(jnp.where(out['edge_mask'], e, -1))
       nodes_per_hop.append(out['num_new'])
       edges_per_hop.append(out['edge_mask'].sum())
+      if mode == 'merge' and caps[i + 1] < caps[i] * k:
+        overflow = overflow | (out['num_new'] > caps[i + 1])
       nxt = caps[i + 1]
       frontier = out['frontier'][:nxt]
       fidx = out['frontier_idx'][:nxt]
@@ -320,7 +326,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
         edge=jnp.concatenate(edges) if with_edge else None,
         edge_mask=jnp.concatenate(emasks),
         num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
-        seed_inverse=inv)
+        seed_inverse=inv, overflow=overflow)
 
   # distinguishable per-mode trace name (bench.py keys device-trace
   # events by the jitted program name); '_capped' marks a clamped
@@ -391,6 +397,14 @@ class NeighborSampler(BaseSampler):
       raise ValueError('frontier_caps requires an exact-dedup mode '
                        "(map/sort/merge); use node_budget with "
                        "dedup='tree'")
+    if frontier_caps is not None and dedup in ('map_table',
+                                               'sort_legacy'):
+      # the legacy engines have no clean-truncation contract and no
+      # overflow flag — clamping them would reintroduce exactly the
+      # silent bias the merge engine's guard exists to prevent
+      raise ValueError(f'frontier_caps is not supported with the legacy '
+                       f'{dedup!r} engine (no overflow detection); use '
+                       "dedup='merge'")
     self.frontier_caps = (tuple(frontier_caps)
                           if frontier_caps is not None else None)
     # fused=True (default) compiles the whole multi-hop sample into one
@@ -570,6 +584,28 @@ class NeighborSampler(BaseSampler):
                        'plans capacities per edge type)')
     return self._homo_capacities(batch_cap, tuple(self.num_neighbors))
 
+  @property
+  def clamped_exact(self) -> bool:
+    """True when this sampler runs an exact-dedup engine under
+    calibrated frontier_caps — the configuration whose batches can be
+    silently truncated on overflow, and therefore the one the loaders'
+    overflow_policy machinery guards (every result carries an on-device
+    ``metadata['overflow']`` flag)."""
+    return self.frontier_caps is not None and \
+        self._dedup_mode() == 'merge'
+
+  def uncapped_clone(self) -> 'NeighborSampler':
+    """A sampler sharing this one's graph, device arrays and PRNG base
+    but with NO frontier_caps — the full-capacity replay target for
+    overflow recovery. Compiled programs are NOT shared (capacity plans
+    differ) but the module-level program cache dedups the full-caps
+    trace across clones."""
+    import copy
+    clone = copy.copy(self)
+    clone.frontier_caps = None
+    clone._fns = {}
+    return clone
+
   def _node_cap(self, caps, fanouts) -> int:
     if self._dedup_mode() == 'tree':
       return _tree_node_cap(caps, list(fanouts))
@@ -705,6 +741,7 @@ class NeighborSampler(BaseSampler):
     rows, cols, edges, emasks = [], [], [], []
     nodes_per_hop = [state.num_nodes]
     edges_per_hop = []
+    overflow = jnp.zeros((), bool)   # see _fused_homo_fn
     keys = jax.random.split(key, len(fanouts))
     offset = caps[0]
     for i, k in enumerate(fanouts):
@@ -731,6 +768,8 @@ class NeighborSampler(BaseSampler):
         edges.append(jnp.where(out['edge_mask'], e, -1))
       nodes_per_hop.append(out['num_new'])
       edges_per_hop.append(out['edge_mask'].sum())
+      if self._dedup_mode() == 'merge' and caps[i + 1] < caps[i] * k:
+        overflow = overflow | (out['num_new'] > caps[i + 1])
       nxt = caps[i + 1]
       frontier = out['frontier'][:nxt]
       fidx = out['frontier_idx'][:nxt]
@@ -741,12 +780,20 @@ class NeighborSampler(BaseSampler):
         edge=jnp.concatenate(edges) if self.with_edge else None,
         edge_mask=jnp.concatenate(emasks),
         num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
-        seed_inverse=inv)
+        seed_inverse=inv, overflow=overflow)
 
   def sample_from_nodes(self, inputs: NodeSamplerInput,
-                        batch_cap: Optional[int] = None, **kwargs):
+                        batch_cap: Optional[int] = None, key=None,
+                        **kwargs):
     """Multi-hop sample from seed nodes
-    (reference: neighbor_sampler.py:168-299)."""
+    (reference: neighbor_sampler.py:168-299).
+
+    ``key``: explicit per-batch PRNG key (default: the sampler's own
+    fold_in stream). Loaders replay a batch at full capacities with the
+    SAME key on calibration overflow — the recomputed batch is the
+    untruncated version of the identical draw, so exactness needs no
+    distributional argument.
+    """
     if self.is_hetero:
       return self._hetero_sample_from_nodes(inputs, batch_cap)
     import jax.numpy as jnp
@@ -757,13 +804,15 @@ class NeighborSampler(BaseSampler):
     padded[:n] = seeds
     mask = np.arange(cap) < n
     fanouts = tuple(self.num_neighbors)
+    if key is None:
+      key = self._next_key()
     if self.fused:
       fn = self._homo_fn(cap, fanouts)
       res = fn(*self._fused_args(), jnp.asarray(padded), jnp.asarray(mask),
-               self._next_key())
+               key)
     else:
       res = self._run_homo_chain(cap, fanouts, jnp.asarray(padded),
-                                 jnp.asarray(mask), self._next_key())
+                                 jnp.asarray(mask), key)
     return SamplerOutput(
         node=res['node'], num_nodes=res['num_nodes'], row=res['row'],
         col=res['col'], edge=res['edge'], edge_mask=res['edge_mask'],
@@ -771,7 +820,8 @@ class NeighborSampler(BaseSampler):
         num_sampled_nodes=res['num_sampled_nodes'],
         num_sampled_edges=res['num_sampled_edges'],
         input_type=inputs.input_type,
-        metadata={'seed_inverse': res['seed_inverse'], 'seed_mask': mask})
+        metadata={'seed_inverse': res['seed_inverse'], 'seed_mask': mask,
+                  'overflow': res['overflow']})
 
   # ------------------------------------------------------------ hetero path
 
@@ -915,12 +965,25 @@ class NeighborSampler(BaseSampler):
 
   # ------------------------------------------------------------- link path
 
-  def sample_from_edges(self, inputs: EdgeSamplerInput, **kwargs):
+  def sample_from_edges(self, inputs: EdgeSamplerInput, key=None,
+                        **kwargs):
     """Link sampling: negatives + seed union + node sampling + metadata
-    (reference: neighbor_sampler.py:301-428)."""
+    (reference: neighbor_sampler.py:301-428).
+
+    ``key``: explicit per-batch PRNG key (split across the negative draw
+    and the node expansion); loaders replay overflowed batches at full
+    capacities with the same key (see sample_from_nodes).
+    """
+    import jax
     import jax.numpy as jnp
     if self.is_hetero:
       return self._hetero_sample_from_edges(inputs, **kwargs)
+    # ONE key split across the negative draw and the node expansion —
+    # identical whether the key comes from the caller (overflow replay)
+    # or the sampler's own stream, so replayed batches match exactly
+    if key is None:
+      key = self._next_key()
+    kneg, knode = jax.random.split(key)
     rows = np.asarray(inputs.row).reshape(-1)
     cols = np.asarray(inputs.col).reshape(-1)
     b = rows.shape[0]
@@ -933,7 +996,7 @@ class NeighborSampler(BaseSampler):
       sorted_idx, _ = self._neg_sorted()
       nr, nc, nmask = ops.random_negative_sample(
           g.indptr, sorted_idx, g.num_nodes, g.num_nodes, num_neg,
-          self._next_key(), padding=True)
+          kneg, padding=True)
       neg_rows, neg_cols = np.asarray(nr), np.asarray(nc)
       if self.edge_dir == 'in':
         # CSC stores (dst, src); emit user-facing (src, dst) pairs
@@ -948,7 +1011,7 @@ class NeighborSampler(BaseSampler):
     else:  # triplet: negatives are dst candidates only
       seeds = np.concatenate([rows, cols, neg_cols])
 
-    out = self.sample_from_nodes(NodeSamplerInput(seeds))
+    out = self.sample_from_nodes(NodeSamplerInput(seeds), key=knode)
     inv = out.metadata['seed_inverse']  # local idx of each seed position
     inv = jnp.asarray(inv)
 

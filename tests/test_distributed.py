@@ -928,3 +928,157 @@ def test_dist_seed_labels_only():
     np.testing.assert_array_equal(node[p, :4],
                                   np.arange(p * 4, (p + 1) * 4))
     np.testing.assert_array_equal(y[p], node[p, :4] % 4)
+
+
+def test_dist_frontier_caps_sufficient_no_overflow():
+  """Calibrated frontier_caps on the distributed engine: buffers shrink
+  to the clamped plan, the sample stays structurally exact, and the
+  replicated overflow flag is False when the caps suffice."""
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  # ring fanout [2, 2] from 4 seeds: hop1 <= 8 new, hop2 <= 8 new — caps
+  # [8, 8] are sufficient yet clamp the worst-case [8, 16] plan
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, [2, 2], mesh, seed=0, dedup='merge', frontier_caps=[8, 8])
+  assert sampler.clamped_exact
+  assert sampler.hop_caps(4) == [4, 8, 8]
+  seeds = np.array([[0, 8, 16, 24], [1, 9, 17, 25]], np.int32)
+  out = sampler.sample_from_nodes(seeds)
+  node = np.asarray(out.node)
+  assert node.shape == (num_parts, 4 + 8 + 8)   # clamped node buffer
+  assert not np.any(np.asarray(out.metadata['overflow']))
+  row, col = np.asarray(out.row), np.asarray(out.col)
+  em = np.asarray(out.edge_mask)
+  for p in range(num_parts):
+    nn = int(np.asarray(out.num_nodes)[p])
+    valid = node[p][:nn]
+    assert len(set(valid.tolist())) == nn   # exact dedup
+    assert em[p].sum() > 0
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N)
+
+
+def test_dist_frontier_caps_overflow_flag_and_policies():
+  """Too-small caps: the replicated on-device flag trips; the loader's
+  default policy raises at epoch end; 'recompute' replays offenders at
+  full capacities with the SAME keys — byte-identical to an uncapped
+  loader driven by the same seed."""
+  import pytest
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, [2, 2], mesh, seed=0, dedup='merge', frontier_caps=[8, 2])
+  seeds = np.array([[0, 8, 16, 24], [1, 9, 17, 25]], np.int32)
+  out = sampler.sample_from_nodes(seeds)
+  assert np.any(np.asarray(out.metadata['overflow']))
+
+  df = glt.distributed.DistFeature(num_parts, feats, node_pb, mesh)
+  ds = glt.distributed.DistDataset(num_parts, 0, dg, df,
+                                   node_labels=np.arange(N) % 4)
+  # stride-13 seed order keeps every batch's neighborhoods disjoint, so
+  # hop 2 always exceeds cap 2 (consecutive seeds would overlap and fit)
+  spread = (np.arange(N) * 13) % N
+  # default policy: loud failure at epoch end
+  loud = glt.distributed.DistNeighborLoader(
+      ds, [2, 2], spread, batch_size=4, shuffle=False, seed=0,
+      mesh=mesh, dedup='merge', frontier_caps=[8, 2])
+  with pytest.raises(RuntimeError, match='frontier_caps overflowed'):
+    for _ in loud:
+      pass
+
+  # 'recompute': every batch overflows -> every batch is replayed at
+  # full caps with the same keys == the uncapped loader's output
+  fix = glt.distributed.DistNeighborLoader(
+      ds, [2, 2], spread, batch_size=4, shuffle=False, seed=0,
+      mesh=mesh, dedup='merge', frontier_caps=[8, 2],
+      overflow_policy='recompute')
+  ref = glt.distributed.DistNeighborLoader(
+      ds, [2, 2], spread, batch_size=4, shuffle=False, seed=0,
+      mesh=mesh, dedup='merge', overflow_policy='off')
+  steps = 0
+  for got, want in zip(fix, ref):
+    steps += 1
+    np.testing.assert_array_equal(np.asarray(got.node),
+                                  np.asarray(want.node))
+    np.testing.assert_array_equal(np.asarray(got.edge_index),
+                                  np.asarray(want.edge_index))
+    np.testing.assert_array_equal(np.asarray(got.edge_mask),
+                                  np.asarray(want.edge_mask))
+  assert steps == len(ref) > 0
+  assert fix.overflow_recomputes == steps
+
+
+def test_dist_link_frontier_caps_overflow():
+  """Calibrated caps on the distributed LINK engine: the engine derives
+  the effective seed width itself; too-small caps trip the flag through
+  sample_from_edges as well."""
+  num_parts = 2
+  parts, feats, node_pb, edge_pb = ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb, edge_pb)
+  rows = np.arange(8, dtype=np.int64) * 4
+  cols = (rows + 1) % N
+  sampler = glt.distributed.DistNeighborSampler(
+      dg, [2], mesh, seed=0, dedup='merge', frontier_caps=[2])
+  from graphlearn_tpu.sampler import EdgeSamplerInput
+  out = sampler.sample_from_edges(
+      EdgeSamplerInput(rows.reshape(2, 4), cols.reshape(2, 4)))
+  assert np.any(np.asarray(out.metadata['overflow']))
+  ok = glt.distributed.DistNeighborSampler(
+      dg, [2], mesh, seed=0, dedup='merge', frontier_caps=[16])
+  out2 = ok.sample_from_edges(
+      EdgeSamplerInput(rows.reshape(2, 4), cols.reshape(2, 4)))
+  assert not np.any(np.asarray(out2.metadata['overflow']))
+
+
+def test_dist_hier_exchange_skewed_fallback_s4():
+  """(slice=4, chip=2) mesh with a pathologically skewed partition book
+  (every node owned by partition 0): the stage-2 DCN buckets — sized on
+  the MEAN valid load — overflow on every hop, the psum'd replicated
+  fallback takes the flat full-width path, and the sample is still
+  loss-free: ring degree 2, fanout 2 keep-all => exactly 2 edges per
+  seed."""
+  import jax
+  from jax.sharding import Mesh
+  num_parts = 8
+  if len(jax.devices()) < num_parts:
+    pytest.skip('needs 8 devices')
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  eids = np.arange(2 * N)
+  node_pb = np.zeros(N, np.int32)            # ALL nodes on partition 0
+  parts = [GraphPartitionData(edge_index=np.stack([rows, cols]),
+                              eids=eids)]
+  for _ in range(num_parts - 1):
+    parts.append(GraphPartitionData(edge_index=np.zeros((2, 0), np.int64),
+                                    eids=np.zeros((0,), np.int64)))
+  mesh = Mesh(np.array(jax.devices()[:num_parts]).reshape(4, 2),
+              ('slice', 'chip'))
+  dg = glt.distributed.DistGraph(num_parts, 0, parts, node_pb)
+  sampler = glt.distributed.DistNeighborSampler(dg, [2, 2], mesh, seed=0,
+                                                bucket_frac=0.5)
+  b = 4
+  seeds = np.arange(num_parts * b, dtype=np.int32).reshape(num_parts, b)
+  out = sampler.sample_from_nodes(seeds)
+  em = np.asarray(out.edge_mask)
+  node = np.asarray(out.node)
+  row = np.asarray(out.row)
+  col = np.asarray(out.col)
+  for p in range(num_parts):
+    # hop 1 alone must contribute exactly 2 edges per seed (keep-all);
+    # hop 2 adds more — the loss-free bound is >= 2*b
+    assert int(em[p].sum()) >= 2 * b, int(em[p].sum())
+    for r, c, m in zip(row[p], col[p], em[p]):
+      if not m:
+        continue
+      u, v = int(node[p][c]), int(node[p][r])
+      assert v in ((u + 1) % N, (u + 2) % N)
+    nn = int(np.asarray(out.num_nodes)[p])
+    assert len(set(node[p][:nn].tolist())) == nn

@@ -316,3 +316,125 @@ def test_checkpoint_link_loader():
     for (n1, e1), (n2, e2) in zip(cont, resumed):
       np.testing.assert_array_equal(n1, n2)
       np.testing.assert_array_equal(e1, e2)
+
+
+def test_overflow_policies_local():
+  """Calibrated-caps overflow guard on the local loaders: the default
+  policy raises at epoch end, 'warn' warns, 'recompute' replays
+  offenders at full caps with the same key (byte-identical to the
+  uncapped loader), 'off' restores the silent round-3 posture."""
+  import pytest
+  ds, _ = make_dataset()
+  mk = lambda **kw: glt.loader.NeighborLoader(
+      ds, [2, 2], np.arange(16), batch_size=4, shuffle=False, seed=0,
+      dedup='merge', **kw)
+
+  out = mk(frontier_caps=[8, 8], overflow_policy='off')
+  b = next(iter(out))
+  assert not bool(np.any(np.asarray(b.metadata['overflow'])))
+
+  with pytest.raises(RuntimeError, match='frontier_caps overflowed'):
+    for _ in mk(frontier_caps=[1, 1]):
+      pass
+
+  with pytest.warns(UserWarning, match='frontier_caps overflowed'):
+    for _ in mk(frontier_caps=[1, 1], overflow_policy='warn'):
+      pass
+
+  fix = mk(frontier_caps=[1, 1], overflow_policy='recompute')
+  ref = mk(overflow_policy='off')
+  steps = 0
+  for got, want in zip(fix, ref):
+    steps += 1
+    np.testing.assert_array_equal(np.asarray(got.node),
+                                  np.asarray(want.node))
+    np.testing.assert_array_equal(np.asarray(got.edge_index),
+                                  np.asarray(want.edge_index))
+    np.testing.assert_array_equal(np.asarray(got.edge_mask),
+                                  np.asarray(want.edge_mask))
+  assert steps == len(ref) > 0
+  assert fix.overflow_recomputes == steps
+
+  # silent-off parity: tiny caps iterate without raising
+  for _ in mk(frontier_caps=[1, 1], overflow_policy='off'):
+    pass
+
+
+def test_frontier_caps_auto_node_loader():
+  """frontier_caps='auto' calibrates in-loader (no hand-computed
+  widths) and the resulting epoch passes the default raise-guard."""
+  ds, _ = make_dataset()
+  loader = glt.loader.NeighborLoader(
+      ds, [2, 2], np.arange(16), batch_size=4, shuffle=True, seed=0,
+      dedup='merge', frontier_caps='auto')
+  caps = loader.sampler.frontier_caps
+  assert caps is not None and len(caps) == 2
+  steps = sum(1 for _ in loader)   # default policy='raise' stays quiet
+  assert steps == len(loader)
+
+
+def test_frontier_caps_auto_link_loader():
+  """Link loaders compute their own effective seed width (src+dst+negs)
+  for 'auto' calibration — the round-3 footgun is gone."""
+  from graphlearn_tpu.sampler.calibrate import link_seed_width
+  ds, _ = make_dataset()
+  ns = glt.sampler.NegativeSampling('binary', 1.0)
+  assert link_seed_width(4, ns) == 2 * 4 + 2 * 4
+  assert link_seed_width(4, None) == 8
+  rows = np.arange(16) % 16
+  cols = (rows * 3 + 1) % 16
+  loader = glt.loader.LinkNeighborLoader(
+      ds, [2], np.stack([rows, cols]), neg_sampling=ns, batch_size=4,
+      shuffle=False, seed=0, dedup='merge', frontier_caps='auto')
+  caps = loader.sampler.frontier_caps
+  assert caps is not None and len(caps) == 1
+  steps = sum(1 for _ in loader)
+  assert steps == len(loader)
+
+
+def test_link_loader_overflow_recompute():
+  """Too-small caps on the LINK loader: replay at full caps with the
+  same key equals the uncapped loader (negatives included)."""
+  ds, _ = make_dataset()
+  rows = np.arange(16)
+  cols = (rows * 5 + 2) % 16
+  ns = glt.sampler.NegativeSampling('triplet', 1.0)
+  mk = lambda **kw: glt.loader.LinkNeighborLoader(
+      ds, [2], np.stack([rows, cols]), neg_sampling=ns, batch_size=4,
+      shuffle=False, seed=0, dedup='merge', **kw)
+  fix = mk(frontier_caps=[1], overflow_policy='recompute')
+  ref = mk(overflow_policy='off')
+  steps = 0
+  for got, want in zip(fix, ref):
+    steps += 1
+    np.testing.assert_array_equal(np.asarray(got.node),
+                                  np.asarray(want.node))
+    np.testing.assert_array_equal(np.asarray(got.edge_index),
+                                  np.asarray(want.edge_index))
+    md_g, md_w = got.metadata, want.metadata
+    np.testing.assert_array_equal(np.asarray(md_g['dst_neg_index']),
+                                  np.asarray(md_w['dst_neg_index']))
+  assert steps == len(ref) > 0
+  assert fix.overflow_recomputes == steps
+
+
+def test_overflow_guard_edges():
+  """Guard edge cases: legacy exact engines reject frontier_caps (no
+  overflow contract), and an early-exited epoch's stale flag must not
+  taint the next epoch's verdict."""
+  import pytest
+  ds, _ = make_dataset()
+  for mode in ('map_table', 'sort_legacy'):
+    with pytest.raises(ValueError, match='legacy'):
+      glt.loader.NeighborLoader(ds, [2], np.arange(16), batch_size=4,
+                                dedup=mode, frontier_caps=[4])
+  # a stale flag left by an early-exited (broken) epoch must be dropped
+  # when the next epoch starts — a clean epoch must not raise from it
+  import jax.numpy as jnp
+  loader = glt.loader.NeighborLoader(
+      ds, [2, 2], np.arange(16), batch_size=4, shuffle=False, seed=0,
+      dedup='merge', frontier_caps=[16, 16])   # generous: never overflows
+  loader._ovf_accum = jnp.asarray(True)        # poison: simulated stale flag
+  for _ in loader:                             # full clean epoch
+    pass                                       # must not raise
+  assert loader._ovf_accum is None
