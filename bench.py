@@ -47,6 +47,12 @@ E2E_HIDDEN = 256
 E2E_CLASSES = 47
 E2E_FEAT_DIM = 100
 
+# the north-star metric (BASELINE.json) is ogbn-products GraphSAGE EPOCH
+# TIME: the real train split is 196,615 seeds -> 192 full batches at 1024
+# (drop_last, the reference example's posture). epoch_time_s below =
+# steps_per_epoch x the device-trace full-pipeline ms/batch.
+PRODUCTS_TRAIN_SEEDS = 196_615
+
 
 def build_graph():
   import graphlearn_tpu as glt
@@ -291,6 +297,12 @@ def main():
     result['map_calibrated_edges_per_sec_m'] = None
     result['map_calibrated_vs_baseline'] = None
 
+  # north-star per-chip throughput (single-chip rig: per-chip == absolute)
+  result['sampled_edges_per_sec_per_chip_m'] = result['value']
+  if result.get('map_calibrated_edges_per_sec_m') is not None:
+    result['sampled_edges_per_sec_per_chip_exact_m'] = \
+        result['map_calibrated_edges_per_sec_m']
+
   # ---- end-to-end train step (sample + collate + layered SAGE) ----
   try:
     import jax.numpy as jnp
@@ -318,6 +330,28 @@ def main():
                                    variant='exact', cal_caps=cal_caps)
     result['train_step_ms_exact_bf16'] = (round(float(e2e_exact), 3)
                                           if e2e_exact else None)
+
+    # ---- north-star keys (BASELINE.json: epoch time +
+    # sampled-edges/sec/chip). Single-chip rig: per-chip == absolute.
+    steps_per_epoch = PRODUCTS_TRAIN_SEEDS // BATCH
+    result['steps_per_epoch_products'] = steps_per_epoch
+    if e2e_exact:
+      # primary epoch_time_s is the REFERENCE-SEMANTICS path (calibrated
+      # exact dedup) — the like-for-like number against the reference's
+      # example config; the tree figure is the relaxed fast path
+      result['epoch_time_s'] = round(steps_per_epoch * e2e_exact / 1e3, 3)
+      result['epoch_time_s_exact'] = result['epoch_time_s']
+      result['epoch_time_semantics'] = 'calibrated-exact (reference)'
+    if e2e_bf16:
+      result['epoch_time_s_tree'] = round(
+          steps_per_epoch * e2e_bf16 / 1e3, 3)
+    # honesty label: ms/batch is device-trace truth on THIS bench's
+    # synthetic (1M nodes, avg deg 25, zipf mix), scaled by the real
+    # products step count — measured-at-2.45M epoch walls come from the
+    # example / accuracy-matrix runs (PERF.md)
+    result['epoch_time_basis'] = (
+        f'device-trace ms/batch on bench graph (N={NUM_NODES}, '
+        f'avg_deg={AVG_DEG}) x {steps_per_epoch} products steps')
 
     # ---- MFU / FLOP accounting (driver's perf lens; PERF.md roofline)
     from graphlearn_tpu.models import train as train_lib

@@ -26,40 +26,80 @@ MODES = [
 ]
 
 
+def run_one(args, name, extra, budgets, seed):
+  """ONE training run at the largest budget, evaluated at every budget
+  (--eval-epochs): each (mode, seed) trains once instead of once per
+  budget."""
+  emax = max(budgets)
+  cmd = [sys.executable, EXAMPLE, '--num-nodes', str(args.num_nodes),
+         '--epochs', str(emax),
+         '--eval-epochs', ','.join(str(e) for e in budgets if e < emax),
+         '--eval-batches', str(args.eval_batches),
+         '--seed', str(seed), '--bf16-model'] + extra
+  print(f'# running {name} e{emax} s{seed}', flush=True)
+  out = subprocess.run(cmd, capture_output=True, text=True)
+  line = None
+  for ln in out.stdout.splitlines():
+    if ln.startswith('{'):
+      line = json.loads(ln)
+  if line is None:
+    print(f'# {name} s{seed} FAILED:\n'
+          f'{out.stdout[-2000:]}\n{out.stderr[-2000:]}', flush=True)
+  else:
+    print(f'#   test_acc_at={line["test_acc_at"]} '
+          f'epoch_s={line["epoch_time_s"]}', flush=True)
+  return line
+
+
 def main():
+  import numpy as np
   ap = argparse.ArgumentParser()
   ap.add_argument('--num-nodes', type=int, default=2_449_029)
-  ap.add_argument('--epochs', type=int, default=4)
+  ap.add_argument('--epochs-list', default='4,8',
+                  help='comma-separated training budgets (epochs); one '
+                       'run per seed at the max, evaluated at each')
+  ap.add_argument('--seeds', type=int, default=3,
+                  help='training seeds per cell (the reference quotes '
+                       '+-0.0036 over runs; single runs cannot support '
+                       'mode-vs-mode conclusions)')
   ap.add_argument('--eval-batches', type=int, default=100)
+  ap.add_argument('--modes', default=None,
+                  help='comma-separated substrings selecting a subset '
+                       'of MODES (default: all)')
   args = ap.parse_args()
+  budgets = sorted(int(x) for x in args.epochs_list.split(','))
+  modes = MODES
+  if args.modes:
+    keys = args.modes.split(',')
+    modes = [(n, e) for n, e in MODES if any(k in n for k in keys)]
 
-  rows = []
-  for name, extra in MODES:
-    cmd = [sys.executable, EXAMPLE, '--num-nodes', str(args.num_nodes),
-           '--epochs', str(args.epochs), '--eval-batches',
-           str(args.eval_batches), '--bf16-model'] + extra
-    print(f'# running {name}: {" ".join(cmd)}', flush=True)
-    out = subprocess.run(cmd, capture_output=True, text=True)
-    line = None
-    for ln in out.stdout.splitlines():
-      if ln.startswith('{'):
-        line = json.loads(ln)
-    if line is None:
-      print(f'# {name} FAILED:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}')
-      rows.append((name, None))
-      continue
-    rows.append((name, line))
-    print(f'# {name}: test_acc={line["test_acc"]} '
-          f'epoch_s={line["epoch_time_s"]}', flush=True)
+  cells = {}
+  for name, extra in modes:
+    accs = {e: [] for e in budgets}
+    walls = []
+    for seed in range(args.seeds):
+      line = run_one(args, name, extra, budgets, seed)
+      if line is None:
+        continue
+      for e in budgets:
+        a = line['test_acc_at'].get(str(e))
+        if a is not None:
+          accs[e].append(a)
+      walls.append(line['epoch_time_s'])
+    cells[name] = (accs, walls)
 
-  print('\n| mode | test acc | final train acc | epoch wall s |')
-  print('|---|---|---|---|')
-  for name, r in rows:
-    if r is None:
-      print(f'| {name} | FAILED | - | - |')
-    else:
-      print(f'| {name} | {r["test_acc"]:.4f} | {r["final_train_acc"]:.4f}'
-            f' | {r["epoch_time_s"]} |')
+  hdr = ' | '.join(f'{e} epochs (mean+-std, n={args.seeds})'
+                   for e in budgets)
+  print(f'\n| mode | {hdr} | epoch wall s |')
+  print('|---' * (len(budgets) + 2) + '|')
+  for name, _ in modes:
+    accs, walls = cells[name]
+    parts = [(f'{np.mean(accs[e]):.4f} +- {np.std(accs[e]):.4f}'
+              if accs[e] else 'FAILED') for e in budgets]
+    wall = f'{np.mean(walls):.1f}' if walls else '-'
+    print(f'| {name} | ' + ' | '.join(parts) + f' | {wall} |')
+  print(json.dumps({n: {'accs_at': v[0], 'epoch_s': v[1]}
+                    for n, v in cells.items()}))
 
 
 if __name__ == '__main__':

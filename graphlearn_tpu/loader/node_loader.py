@@ -162,6 +162,17 @@ class OverflowGuardMixin:
       self._full_sampler = self.sampler.uncapped_clone()
     return self._full_sampler
 
+  def check_overflow(self) -> bool:
+    """True iff any batch sampled SINCE the current epoch started has
+    tripped the calibrated-caps overflow flag (one device fetch). For
+    consumers that exit an epoch early (eval loops with a batch cap,
+    early stopping): the automatic epoch-end check only runs when the
+    iterator is exhausted, so call this after an early break to keep the
+    no-truncation claim honest."""
+    if self._ovf_accum is None:
+      return False
+    return bool(np.asarray(self._ovf_accum))
+
   def _finish_epoch_overflow(self):
     if self._ovf_accum is None:
       return
