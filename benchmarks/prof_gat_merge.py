@@ -37,11 +37,12 @@ def run(model_kw, tag, ds, train_idx, cal):
   for _ in range(2):
     state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
   jax.block_until_ready(loss)
+  STEPS = 6
   td = f'/tmp/glt_gat_{tag}'
   shutil.rmtree(td, ignore_errors=True)
   jax.profiler.start_trace(td)
   losses = []
-  for _ in range(6):
+  for _ in range(STEPS):
     state, loss, _ = step(state, train_lib.batch_to_dict(next(it)))
     losses.append(loss)
   jax.block_until_ready(losses)
@@ -51,6 +52,10 @@ def run(model_kw, tag, ds, train_idx, cal):
   tr = max((ms for nm, (ms, _) in progs.items()
             if nm.startswith('jit_train_step')), default=0)
   print(f'{tag:16s} total {tot:7.2f} ms/step (train program {tr:6.2f})')
+  if os.environ.get('GLT_GAT_OPS'):
+    for n, (ms, cnt) in glt.utils.device_op_ms(td, top=14,
+                                               steps=STEPS).items():
+      print(f'    {n[:64]:66s} {ms:8.3f} ms/step x{cnt}')
 
 
 def main():

@@ -176,7 +176,10 @@ class MergeSAGEConv(nn.Module):
       x = x.astype(self.dtype)
     n = x.shape[0]
     row, col = edge_index[0], edge_index[1]
-    acc = jnp.zeros((n + 1, x.shape[-1]), x.dtype)
+    # per-hop targets are a contiguous block with valid runs leading
+    # (see MergeGATConv): the row scatter is a dense block write at the
+    # dynamic base — zero HBM scatter transactions in the aggregation
+    acc = jnp.zeros((n, x.shape[-1]), x.dtype)
     e0 = 0
     for i, e1 in enumerate(self.edge_offsets):
       k = self.fanouts[i]
@@ -194,9 +197,14 @@ class MergeSAGEConv(nn.Module):
       # the k-run's target local idx (masked slots carry -1: take max)
       tgt = tgt_blk.max(1)
       ok = m.any(1) & (tgt >= 0)
-      acc = acc.at[jnp.where(ok, tgt, n)].set(mean, mode='drop')
+      # base from tgt[j] - j: immune to leading all-masked runs
+      # (zero-degree frontier nodes read tgt = -1) — see MergeGATConv
+      base = jnp.min(jnp.where(
+          ok, tgt - jnp.arange(f, dtype=tgt.dtype), n)).astype(jnp.int32)
+      acc = jax.lax.dynamic_update_slice(
+          acc, jnp.where(ok[:, None], mean, 0), (base, 0))
       e0 = e1
-    agg = acc[:n]
+    agg = acc
     h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
                  name='lin_self')(x)
     return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
@@ -281,17 +289,34 @@ class MergeGATConv(nn.Module):
     if self.dtype is not None:
       x = x.astype(self.dtype)
     n, heads, hd = x.shape[0], self.heads, self.out_dim
+    # w stays FLAT [n, heads*hd]: gathering (and the backward's
+    # scatter-add) on 2D rows keeps XLA's standard T(8,128) layout —
+    # gathering the [n, H, D] reshape instead puts the whole
+    # grad-accumulation on a T(2,128)-tiled 3D layout that costs ~4x
+    # (device-trace: 29 of a 42 ms backward, round 4)
     w = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
-                 name='lin')(x).reshape(n, heads, hd)
+                 name='lin')(x)
     a_src = self.param('att_src', nn.initializers.glorot_uniform(),
                        (heads, hd))
     a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
                        (heads, hd))
-    wf = w.astype(jnp.float32)
-    alpha_src = (wf * a_src[None]).sum(-1)        # [n, H]
-    alpha_dst = (wf * a_dst[None]).sum(-1)
+    # dst-alphas over the node buffer (f32 accumulation on the MXU);
+    # src-alphas are computed from the GATHERED messages below — random
+    # HBM gathers are transaction-bound (~150M rows/s, PERF.md), so one
+    # [width]-row gather per hop is the whole random-access budget
+    alpha_dst = jnp.einsum('nhd,hd->nh', w.reshape(n, heads, hd), a_dst,
+                           preferred_element_type=jnp.float32)
     row, col = edge_index[0], edge_index[1]
-    acc = jnp.zeros((n + 1, heads, hd), w.dtype)
+    # merge-layout structure: hop i's valid runs target the CONTIGUOUS
+    # block the inducer appended for them (frontier_idx = count +
+    # arange), with valid runs leading — so the per-hop "scatter" is a
+    # dense block write at the dynamic base (min valid target). Zero
+    # rows past a hop's valid range land in the NEXT hop's block
+    # (overwritten: bases ascend and writes apply in hop order) or in
+    # the never-targeted tail, which must be zero anyway; an empty hop
+    # writes zeros clamped into the padding tail (provably past every
+    # targeted row).
+    acc = jnp.zeros((n, heads * hd), w.dtype)
     e0 = 0
     for i, e1 in enumerate(self.edge_offsets):
       k = self.fanouts[i]
@@ -305,18 +330,25 @@ class MergeGATConv(nn.Module):
                                                                  ).max(1)
       m = jax.lax.dynamic_slice_in_dim(edge_mask, e0, width
                                        ).reshape(f, k)
-      e = (alpha_src[src].reshape(f, k, heads) +
+      msgs = w[src]                                # the one gather, 2D
+      msgs4 = msgs.reshape(f, k, heads, hd)
+      e = (jnp.einsum('fkhd,hd->fkh', msgs4.astype(jnp.float32), a_src) +
            alpha_dst[jnp.maximum(tgt, 0)][:, None, :])
       attn = _masked_run_softmax(e, m, w.dtype, self.negative_slope)
-      msgs = w[src].reshape(f, k, heads, hd)
-      outv = (msgs * attn[..., None]).sum(axis=1)  # [f, H, D]
+      outv = (msgs4 * attn[..., None]).sum(axis=1)  # [f, H, D]
       ok = m.any(1) & (tgt >= 0)
-      acc = acc.at[jnp.where(ok, tgt, n)].set(outv, mode='drop')
+      # block base from tgt[j] - j (invariant across valid runs): a
+      # zero-degree frontier node's run has ALL edges masked, so its
+      # tgt reads -1 — min(valid tgt) alone would mis-base the write
+      # when such runs lead the block
+      base = jnp.min(jnp.where(
+          ok, tgt - jnp.arange(f, dtype=tgt.dtype), n)).astype(jnp.int32)
+      vals = jnp.where(ok[:, None], outv.reshape(f, heads * hd), 0)
+      acc = jax.lax.dynamic_update_slice(acc, vals, (base, 0))
       e0 = e1
-    out = acc[:n]
     if self.concat:
-      return out.reshape(n, heads * hd)
-    return out.mean(axis=1)
+      return acc
+    return acc.reshape(n, heads, hd).mean(axis=1)
 
 
 class GraphSAGE(nn.Module):

@@ -41,6 +41,28 @@ def step_annotation(name: str, step: int):
   return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
+def _tpu_trace_events(trace_dir: str):
+  """Duration ('X') events on TPU lanes from the NEWEST trace under
+  ``trace_dir`` — the shared loader behind device_program_ms /
+  device_op_ms (one place owns trace discovery + pid mapping)."""
+  import glob
+  import gzip
+  import json
+  paths = sorted(glob.glob(trace_dir + '/**/*.trace.json.gz',
+                           recursive=True))
+  if not paths:
+    return []
+  with gzip.open(paths[-1]) as f:
+    t = json.load(f)
+  pids = {}
+  for e in t.get('traceEvents', []):
+    if e.get('ph') == 'M' and e.get('name') == 'process_name':
+      pids[e['pid']] = e['args'].get('name', '')
+  return [e for e in t.get('traceEvents', [])
+          if e.get('ph') == 'X' and 'dur' in e and
+          'TPU' in pids.get(e.get('pid'), '')]
+
+
 def device_program_ms(trace_dir: str):
   """Per-program average device ms from the newest trace under
   ``trace_dir``, keyed by jitted program name, TPU lane only — the
@@ -50,29 +72,47 @@ def device_program_ms(trace_dir: str):
   Returns {name: (avg_ms, call_count)}.
   """
   import collections
-  import glob
-  import gzip
-  import json
-  paths = sorted(glob.glob(trace_dir + '/**/*.trace.json.gz',
-                           recursive=True))
-  if not paths:
-    return {}
-  with gzip.open(paths[-1]) as f:
-    t = json.load(f)
-  pids = {}
-  for e in t.get('traceEvents', []):
-    if e.get('ph') == 'M' and e.get('name') == 'process_name':
-      pids[e['pid']] = e['args'].get('name', '')
   durs = collections.defaultdict(lambda: [0.0, 0])
-  for e in t.get('traceEvents', []):
-    if e.get('ph') == 'X' and 'dur' in e and \
-        'TPU' in pids.get(e.get('pid'), ''):
-      n = e.get('name', '')
-      if n.startswith('jit_'):
-        d = durs[n]
-        d[0] += e['dur']
-        d[1] += 1
+  for e in _tpu_trace_events(trace_dir):
+    n = e.get('name', '')
+    if n.startswith('jit_'):
+      d = durs[n]
+      d[0] += e['dur']
+      d[1] += 1
   return {n: (tot / cnt / 1000.0, cnt) for n, (tot, cnt) in durs.items()}
+
+
+def device_op_ms(trace_dir: str, top: int = 0, steps: int = 1,
+                 strip_ids: bool = True):
+  """Per-OP device ms from the newest trace under ``trace_dir`` (TPU
+  lanes, non-program events) — the op-level companion of
+  device_program_ms for kernel-attribution work (PERF.md byte audits).
+
+  ``steps`` divides totals so units match device_program_ms's per-call
+  averages (pass the traced step count). ``strip_ids`` groups op
+  instances by XLA name with the trailing ``.NNN`` suffix removed
+  (``fusion.123`` -> ``fusion``) for op-class totals; pass False to
+  keep instance names (for HLO correlation). Returns
+  {name: (ms, count)}, sorted desc and truncated when ``top`` > 0.
+  """
+  import collections
+  import re
+  durs = collections.defaultdict(lambda: [0.0, 0])
+  suffix = re.compile(r'[.\-]?\d+$')
+  for e in _tpu_trace_events(trace_dir):
+    n = e.get('name', '')
+    if n.startswith('jit_'):
+      continue
+    if strip_ids:
+      n = suffix.sub('', n)
+    d = durs[n]
+    d[0] += e['dur']
+    d[1] += 1
+  out = {n: (tot / 1000.0 / steps, cnt)
+         for n, (tot, cnt) in durs.items()}
+  if top:
+    out = dict(sorted(out.items(), key=lambda kv: -kv[1][0])[:top])
+  return out
 
 
 _active = False

@@ -140,7 +140,8 @@ def test_layered_forward_matches_full_merge_batches():
   for caps in (None, [40, 72]):
     loader = glt.loader.NeighborLoader(ds, [3, 2], np.arange(48),
                                        batch_size=16, seed=0, dedup='map',
-                                       frontier_caps=caps)
+                                       frontier_caps=caps,
+                                       overflow_policy='off')
     no, eo = train_lib.merge_hop_offsets(16, [3, 2], frontier_caps=caps)
     full = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2)
     layered = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2,
@@ -176,7 +177,8 @@ def test_merge_dense_matches_segment():
   for caps in (None, [48, 104]):
     loader = glt.loader.NeighborLoader(ds, [4, 3], np.arange(64),
                                        batch_size=16, seed=0, dedup='map',
-                                       frontier_caps=caps)
+                                       frontier_caps=caps,
+                                       overflow_policy='off')
     no, eo = train_lib.merge_hop_offsets(16, [4, 3], frontier_caps=caps)
     seg = glt.models.GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2,
                                hop_node_offsets=no, hop_edge_offsets=eo)
@@ -214,7 +216,8 @@ def test_merge_dense_gat_matches_segment():
   for caps in (None, [40, 88]):
     loader = glt.loader.NeighborLoader(ds, [4, 3], np.arange(48),
                                        batch_size=16, seed=0, dedup='map',
-                                       frontier_caps=caps)
+                                       frontier_caps=caps,
+                                       overflow_policy='off')
     no, eo = train_lib.merge_hop_offsets(16, [4, 3], frontier_caps=caps)
     seg = glt.models.GAT(hidden_dim=12, out_dim=4, num_layers=2, heads=2,
                          hop_node_offsets=no, hop_edge_offsets=eo)
@@ -563,3 +566,47 @@ def test_hierarchical_hgt_matches_full(dedup):
   nseed = int(b.num_sampled_nodes['paper'][0])
   np.testing.assert_allclose(o_full[:nseed], o_hier[:nseed],
                              rtol=5e-5, atol=5e-5)
+
+
+def test_merge_dense_zero_degree_leading_seed():
+  """Dense block writes must stay aligned when the FIRST run of a hop
+  block has every edge masked (a zero-out-degree seed): its target
+  reads -1, so a base derived from min(valid tgt) alone would shift the
+  whole block (round-4 regression). Seed 0 is isolated here."""
+  import jax
+  from graphlearn_tpu.models import train as train_lib
+  rng = np.random.default_rng(3)
+  n = 200
+  rows = rng.integers(1, n, 2000)      # node 0 has NO out-edges
+  cols = rng.integers(1, n, 2000)
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), num_nodes=n, graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 8)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, 3, n))
+  # seed block LEADS with the isolated node (seeds dedup ascending, so
+  # node 0 is run 0 of hop 0)
+  seeds = np.array([0, 5, 9, 13, 21, 34, 55, 89])
+  loader = glt.loader.NeighborLoader(ds, [3, 2], seeds, batch_size=8,
+                                     seed=0, dedup='map')
+  b = train_lib.batch_to_dict(next(iter(loader)))
+  no, eo = train_lib.merge_hop_offsets(8, [3, 2])
+  for seg, dense in (
+      (glt.models.GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2,
+                            hop_node_offsets=no, hop_edge_offsets=eo),
+       glt.models.GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2,
+                            hop_node_offsets=no, hop_edge_offsets=eo,
+                            merge_dense=True, fanouts=(3, 2))),
+      (glt.models.GAT(hidden_dim=8, out_dim=3, num_layers=2, heads=2,
+                      hop_node_offsets=no, hop_edge_offsets=eo),
+       glt.models.GAT(hidden_dim=8, out_dim=3, num_layers=2, heads=2,
+                      hop_node_offsets=no, hop_edge_offsets=eo,
+                      merge_dense=True, fanouts=(3, 2)))):
+    params = seg.init(jax.random.PRNGKey(0), b['x'], b['edge_index'],
+                      b['edge_mask'])
+    out_seg = np.asarray(seg.apply(params, b['x'], b['edge_index'],
+                                   b['edge_mask']))
+    out_dense = np.asarray(dense.apply(params, b['x'], b['edge_index'],
+                                       b['edge_mask']))
+    nseed = int(b['num_seed_nodes'])
+    np.testing.assert_allclose(out_seg[:nseed], out_dense[:nseed],
+                               rtol=1e-4, atol=1e-5)
