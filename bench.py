@@ -358,11 +358,15 @@ def main():
     no_t, _ = train_lib.tree_hop_offsets(BATCH, FANOUT)
     no_e, _ = train_lib.merge_hop_offsets(BATCH, FANOUT,
                                           frontier_caps=cal_caps)
-    # layer l transforms the prefix of sources it aggregates from:
-    # widest prefix first (PERF.md 'layered forward')
-    g_tree = _sage_matmul_gflops([no_t[-1], no_t[-2], no_t[-3]],
+    # EXECUTED matmul rows (round 4, out_rows): layer l produces only
+    # the next layer's prefix — [o_{L-1}, o_{L-2}, o_{L-2}] for 3
+    # layers (the last layer keeps its full input width). The numerator
+    # is useful work actually performed; the pre-round-4 accounting
+    # counted the full input prefixes, ~5x more (those rows existed
+    # then, but were wasted — see PERF.md 'MFU and the roofline').
+    g_tree = _sage_matmul_gflops([no_t[-2], no_t[-3], no_t[-3]],
                                  E2E_FEAT_DIM, E2E_HIDDEN, E2E_CLASSES)
-    g_exact = _sage_matmul_gflops([no_e[-1], no_e[-2], no_e[-3]],
+    g_exact = _sage_matmul_gflops([no_e[-2], no_e[-3], no_e[-3]],
                                   E2E_FEAT_DIM, E2E_HIDDEN, E2E_CLASSES)
     result['model_gflops_per_step_tree'] = round(g_tree, 1)
     result['model_gflops_per_step_exact'] = round(g_exact, 1)

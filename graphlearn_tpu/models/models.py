@@ -126,6 +126,12 @@ class TreeSAGEConv(nn.Module):
   fanouts: Any = None  # true per-depth fanouts; guards against truncation
   use_bias: bool = True
   dtype: Any = None
+  # out_rows: produce only the leading ``out_rows`` output rows (the
+  # consumer's prefix). The DEEPEST block is pure child input — its conv
+  # output is never read — so the layered forward passes the
+  # parents-prefix width here and layer 0 skips ~80% of its matmul rows
+  # (938k -> 170k at products scale). None = full input width.
+  out_rows: Any = None
 
   @nn.compact
   def __call__(self, x, edge_mask):
@@ -133,18 +139,27 @@ class TreeSAGEConv(nn.Module):
       x = x.astype(self.dtype)
     blocks, eo = _tree_blocks(self.node_offsets, self.fanouts, x.shape[0])
     no = tuple(self.node_offsets)
+    r = x.shape[0] if self.out_rows is None else int(self.out_rows)
     aggs = []
+    covered = 0
     for d in range(len(blocks) - 1):   # target block d <- child block d+1
+      if covered >= r:
+        break
       b, k = blocks[d], self.fanouts[d]
       ch = jax.lax.dynamic_slice_in_dim(x, no[d], blocks[d + 1]
                                         ).reshape(b, k, x.shape[-1])
       m = edge_mask[eo[d]:eo[d + 1]].reshape(b, k)
       aggs.append(_masked_run_mean(ch, m))
-    # deepest block has no children in this slice: aggregate = 0
-    aggs.append(jnp.zeros((blocks[-1], x.shape[-1]), x.dtype))
-    agg = jnp.concatenate(aggs)
+      covered += b
+    if covered < r:
+      # remaining rows are childless in this slice: aggregate = 0
+      aggs.append(jnp.zeros((r - covered, x.shape[-1]), x.dtype))
+    agg = jnp.concatenate(aggs) if len(aggs) > 1 else aggs[0]
+    assert agg.shape[0] == r, (
+        f'out_rows={r} must align with the tree block structure '
+        f'{no} (got coverage {agg.shape[0]})')
     h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
-                 name='lin_self')(x)
+                 name='lin_self')(x[:r])
     return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
                         name='lin_nbr')(agg)
 
@@ -157,24 +172,31 @@ class MergeSAGEConv(nn.Module):
   frontier node's ``k`` draws occupy CONSECUTIVE edge slots — so each
   hop's target column is k-CONSTANT runs. Mean aggregation becomes: one
   source-row gather, a ``[frontier, k]`` masked reshape-mean (dense VPU
-  work), and ONE frontier-sized row scatter per hop — replacing the
-  segment scatter-add over the full edge width (scatter transactions
-  drop from E to E/k per layer). Exact for every merge batch, including
-  calibrated frontier caps (targets are unique across hops: dedup
-  expands each node at most once). Parameter names match ``SAGEConv``
-  (``lin_self``/``lin_nbr``) — checkpoint-interchangeable.
+  work), and a dense block write per hop (``dynamic_update_slice`` at
+  the hop's contiguous target base — ZERO scatter transactions,
+  replacing the segment scatter-add over the full edge width). Exact
+  for every merge batch, including calibrated frontier caps (targets
+  are unique across hops: dedup expands each node at most once).
+  Parameter names match ``SAGEConv`` (``lin_self``/``lin_nbr``) —
+  checkpoint-interchangeable.
   """
   out_dim: int
   edge_offsets: Any   # prefix sums of the hop edge blocks IN USE
   fanouts: Any        # per-hop fanout k_i (block run length)
   use_bias: bool = True
   dtype: Any = None
+  # out_rows: produce only the leading prefix (see TreeSAGEConv) — the
+  # last hop's appended nodes are childless, so their conv output is
+  # never read. Every targeted row provably lies below the clamped
+  # occupancy bound before the last hop (merge_layout_from_caps
+  # prefix), which is what the layered forward passes here.
+  out_rows: Any = None
 
   @nn.compact
   def __call__(self, x, edge_index, edge_mask):
     if self.dtype is not None:
       x = x.astype(self.dtype)
-    n = x.shape[0]
+    n = x.shape[0] if self.out_rows is None else int(self.out_rows)
     row, col = edge_index[0], edge_index[1]
     # per-hop targets are a contiguous block with valid runs leading
     # (see MergeGATConv): the row scatter is a dense block write at the
@@ -206,7 +228,7 @@ class MergeSAGEConv(nn.Module):
       e0 = e1
     agg = acc
     h = nn.Dense(self.out_dim, use_bias=self.use_bias, dtype=self.dtype,
-                 name='lin_self')(x)
+                 name='lin_self')(x[:n])
     return h + nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
                         name='lin_nbr')(agg)
 
@@ -419,17 +441,24 @@ class GraphSAGE(nn.Module):
         hops_used = self.num_layers - i
         n_in = self.hop_node_offsets[hops_used]
         e_used = self.hop_edge_offsets[hops_used - 1]
+        # deepest-block rows are pure child input — no consumer reads
+        # their conv output, so the dense convs only produce the next
+        # layer's prefix (layer 0 skips ~80% of its matmul rows at
+        # products scale). The LAST layer keeps full width: its output
+        # is the public logits buffer (consumers slice by label cap).
+        out_rows = (self.hop_node_offsets[hops_used - 1]
+                    if i < self.num_layers - 1 else None)
         if self.tree_dense:
           x = TreeSAGEConv(
               dim, node_offsets=tuple(self.hop_node_offsets[:hops_used + 1]),
               fanouts=tuple(self.fanouts[:hops_used]),
-              dtype=self.dtype, name=f'conv{i}')(
+              dtype=self.dtype, out_rows=out_rows, name=f'conv{i}')(
               x[:n_in], edge_mask[:e_used])
         elif self.merge_dense:
           x = MergeSAGEConv(
               dim, edge_offsets=tuple(self.hop_edge_offsets[:hops_used]),
               fanouts=tuple(self.fanouts[:hops_used]),
-              dtype=self.dtype, name=f'conv{i}')(
+              dtype=self.dtype, out_rows=out_rows, name=f'conv{i}')(
               x[:n_in], edge_index[:, :e_used], edge_mask[:e_used])
         else:
           x = SAGEConv(dim, aggr=self.aggr, dtype=self.dtype,

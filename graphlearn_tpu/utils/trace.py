@@ -41,10 +41,15 @@ def step_annotation(name: str, step: int):
   return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
+_trace_cache = {}   # (path, mtime) -> parsed events (newest entry only)
+
+
 def _tpu_trace_events(trace_dir: str):
   """Duration ('X') events on TPU lanes from the NEWEST trace under
   ``trace_dir`` — the shared loader behind device_program_ms /
-  device_op_ms (one place owns trace discovery + pid mapping)."""
+  device_op_ms (one place owns trace discovery + pid mapping). The
+  parsed result is memoized on (path, mtime) so program- and op-level
+  views of the same trace parse it once."""
   import glob
   import gzip
   import json
@@ -52,15 +57,21 @@ def _tpu_trace_events(trace_dir: str):
                            recursive=True))
   if not paths:
     return []
+  key = (paths[-1], os.path.getmtime(paths[-1]))
+  if key in _trace_cache:
+    return _trace_cache[key]
   with gzip.open(paths[-1]) as f:
     t = json.load(f)
   pids = {}
   for e in t.get('traceEvents', []):
     if e.get('ph') == 'M' and e.get('name') == 'process_name':
       pids[e['pid']] = e['args'].get('name', '')
-  return [e for e in t.get('traceEvents', [])
-          if e.get('ph') == 'X' and 'dur' in e and
-          'TPU' in pids.get(e.get('pid'), '')]
+  events = [e for e in t.get('traceEvents', [])
+            if e.get('ph') == 'X' and 'dur' in e and
+            'TPU' in pids.get(e.get('pid'), '')]
+  _trace_cache.clear()            # keep only the newest trace in memory
+  _trace_cache[key] = events
+  return events
 
 
 def device_program_ms(trace_dir: str):
@@ -91,14 +102,15 @@ def device_op_ms(trace_dir: str, top: int = 0, steps: int = 1,
   ``steps`` divides totals so units match device_program_ms's per-call
   averages (pass the traced step count). ``strip_ids`` groups op
   instances by XLA name with the trailing ``.NNN`` suffix removed
-  (``fusion.123`` -> ``fusion``) for op-class totals; pass False to
-  keep instance names (for HLO correlation). Returns
-  {name: (ms, count)}, sorted desc and truncated when ``top`` > 0.
+  (``fusion.123`` -> ``fusion``; bare-digit names like ``layer1`` are
+  left intact) for op-class totals; pass False to keep instance names
+  (for HLO correlation). Returns {name: (ms, count)}, sorted desc and
+  truncated when ``top`` > 0.
   """
   import collections
   import re
   durs = collections.defaultdict(lambda: [0.0, 0])
-  suffix = re.compile(r'[.\-]?\d+$')
+  suffix = re.compile(r'\.\d+$')
   for e in _tpu_trace_events(trace_dir):
     n = e.get('name', '')
     if n.startswith('jit_'):
