@@ -64,6 +64,10 @@ def main():
   ap.add_argument('--batch-size', type=int, default=512)
   ap.add_argument('--hidden', type=int, default=128)
   ap.add_argument('--lr', type=float, default=3e-3)
+  ap.add_argument('--model', default='rsage',
+                  choices=['rsage', 'rgat'],
+                  help="conv family (reference default is 'rgat' with "
+                       '4 heads; rsage is the faster gate)')
   args = ap.parse_args()
 
   import jax
@@ -91,13 +95,25 @@ def main():
   args.batch_size = min(args.batch_size, n_tr)
   loader = glt.loader.NeighborLoader(
       ds, fanouts, ('paper', np.arange(n_tr)),
-      batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0)
+      batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0,
+      dedup='tree')
 
+  # typed dense k-run aggregation over the hierarchical tree layout —
+  # the fast hetero path (PERF.md round 4); --model rgat matches the
+  # reference default (4 heads, per-head dim = hidden // heads)
+  no, eo = glt.sampler.hetero_tree_layout(
+      {'paper': args.batch_size}, tuple(fanouts), fanouts)
+  recs, _ = glt.sampler.hetero_tree_blocks(
+      {'paper': args.batch_size}, tuple(fanouts), fanouts)
   etypes = [glt.typing.reverse_edge_type(CITES),
             glt.typing.reverse_edge_type(WRITES),
             glt.typing.reverse_edge_type(REV_WRITES)]
   model = RGNN(etypes=tuple(etypes), hidden_dim=args.hidden,
-               out_dim=ncls, num_layers=2, out_ntype='paper')
+               out_dim=ncls, num_layers=2, out_ntype='paper',
+               conv=('gat' if args.model == 'rgat' else 'sage'),
+               heads=(4 if args.model == 'rgat' else 1),
+               hop_node_offsets=no, hop_edge_offsets=eo,
+               tree_dense=True, tree_records=recs)
 
   def batch_dict(batch):
     return dict(x=batch.x, ei=batch.edge_index, em=batch.edge_mask,
@@ -113,13 +129,13 @@ def main():
 
   def loss_fn(params, b):
     logits = model.apply(params, b['x'], b['ei'], b['em'])
-    n = logits.shape[0]
+    n = logits.shape[0]          # hierarchical emits the seed prefix
+    y = b['y'][:n]
     seed_mask = jnp.arange(n) < b['num_seed']
-    ce = optax.softmax_cross_entropy(
-        logits, jax.nn.one_hot(b['y'], ncls))
+    ce = optax.softmax_cross_entropy(logits, jax.nn.one_hot(y, ncls))
     loss = jnp.where(seed_mask, ce, 0.0).sum() / jnp.maximum(
         seed_mask.sum(), 1)
-    acc = (((logits.argmax(-1) == b['y']) & seed_mask).sum() /
+    acc = (((logits.argmax(-1) == y) & seed_mask).sum() /
            jnp.maximum(seed_mask.sum(), 1))
     return loss, acc
 

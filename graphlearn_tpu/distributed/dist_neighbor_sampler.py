@@ -537,7 +537,9 @@ class DistNeighborSampler:
     sampler's plan, sampler/neighbor_sampler.py hetero path), generalized
     to multi-type seed sets (link sampling seeds both endpoint types)."""
     g = self.graph
-    etypes = g.etypes
+    # canonical intra-hop order (see hetero_capacity_plan): the layout
+    # helpers sort, so the engine's plan must sort identically
+    etypes = sorted(tuple(et) for et in g.etypes)
     edge_dir = g.edge_dir
     num_hops = max(len(self._etype_fanouts(et)) for et in etypes)
     ntypes = g.ntypes

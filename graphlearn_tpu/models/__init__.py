@@ -2,6 +2,7 @@ from .conv import (GATConv, GCNConv, SAGEConv, segment_max_agg,
                    segment_mean_agg, segment_sum_agg)
 from .hgt import HGT, HGTConv
 from .models import (GAT, GCN, GraphSAGE, HeteroConv, MergeGATConv,
-                     MergeSAGEConv, RGNN, TreeGATConv, TreeSAGEConv)
+                     MergeSAGEConv, RGNN, TreeGATConv, TreeHeteroConv,
+                     TreeSAGEConv)
 from .train import (TrainState, batch_to_dict, create_train_state,
                     make_train_step, merge_hop_offsets, tree_hop_offsets)

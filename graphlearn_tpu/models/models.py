@@ -596,6 +596,162 @@ class HeteroConv(nn.Module):
     return out
 
 
+class TreeHeteroConv(nn.Module):
+  """One hetero layer over TYPED tree batches with dense k-run
+  aggregation — the typed counterpart of TreeSAGEConv/TreeGATConv.
+
+  The hetero tree layout (sampler.hetero_tree_blocks) puts each
+  (hop, edge-type)'s children in a CONTIGUOUS block of the result
+  type's buffer, their targets in the key type's contiguous frontier
+  block, and the edges in the out-etype's hop segment — so per-etype
+  aggregation is slice + reshape + masked mean (or masked run softmax),
+  with NO per-edge gathers, no segment scatters, and no src/dst buffer
+  concatenation (HeteroConv materializes [n_dst+n_src, F] per etype per
+  layer). Semantics match HeteroConv over per-etype SAGEConv/GATConv
+  (per-etype lin_self/lin_nbr or lin/att params, summed per target
+  type) — equivalence-tested on tree batches.
+
+  ``records``: hop records from sampler.hetero_tree_blocks, restricted
+  by the caller to the hops this layer consumes. ``out_rows``: per-type
+  output widths (the NEXT layer's typed prefix; deepest blocks are pure
+  child input — the homo out_rows argument, per type).
+  """
+  out_dim: int
+  records: Any                    # tuple of per-hop record tuples
+  conv: str = 'sage'              # 'sage' | 'gat'
+  heads: int = 1
+  negative_slope: float = 0.2
+  concat: bool = True             # gat: concat heads
+  dtype: Any = None
+  out_rows: Any = None            # {ntype: rows} or None = input widths
+
+  @nn.compact
+  def __call__(self, x_dict, edge_mask_dict):
+    if self.dtype is not None:
+      x_dict = {t: x.astype(self.dtype) for t, x in x_dict.items()}
+    rows = {t: (x.shape[0] if self.out_rows is None
+                else min(int(self.out_rows[t]), x.shape[0]))
+            for t, x in x_dict.items()}
+    etypes = sorted({r['et'] for recs in self.records for r in recs})
+    out = {}
+    for et in etypes:
+      fn = self._gat_et if self.conv == 'gat' else self._sage_et
+      h = fn(et, x_dict, edge_mask_dict, rows)
+      if h is None:
+        continue
+      t, val = h
+      out[t] = out.get(t, 0) + val
+    return out
+
+  def _et_recs(self, et, x_dict):
+    """Records for ``et`` whose types exist in this layer's input —
+    leaf-only types (never message targets) drop out of x_dict after
+    layer 0, and the segment HeteroConv skips such relations too."""
+    return [r for recs in self.records for r in recs if r['et'] == et
+            and r['res_t'] in x_dict and r['key_t'] in x_dict]
+
+  def _walk(self, recs, edge_mask_dict, rows, per_record):
+    """Shared parent-coverage walk: for each hop record, slice the
+    edge-mask segment, emit ``per_record(r, m)`` ([f, D] values), and
+    track coverage of the key type's parent axis — etypes inactive at
+    an earlier hop leave ('gap', n) placeholders the caller resolves
+    with zeros of its feature dim. Returns (parts, key_t)."""
+    key_t = recs[0]['key_t']
+    r_out = rows[key_t]
+    parts, covered = [], 0
+    for r in recs:
+      if r['parent_base'] >= r_out:
+        break
+      f, k = r['fcap'], r['k']
+      m = jax.lax.slice_in_dim(edge_mask_dict[r['out_et']],
+                               r['edge_base'], r['edge_base'] + f * k
+                               ).reshape(f, k)
+      if r['parent_base'] > covered:
+        parts.append(('gap', r['parent_base'] - covered))
+        covered = r['parent_base']
+      assert r['parent_base'] == covered, (
+          f'hetero tree records for {recs[0]["et"]} overlap parents '
+          f'({r["parent_base"]} vs {covered}); build them with '
+          'sampler.hetero_tree_blocks from the SAME seed caps/fanouts '
+          'as the loader')
+      parts.append(per_record(r, m))
+      covered += f
+    if covered < r_out:
+      parts.append(('gap', r_out - covered))
+    return parts, key_t
+
+  @staticmethod
+  def _resolve(parts, fdim, dtype):
+    parts = [jnp.zeros((p[1], fdim), dtype) if isinstance(p, tuple)
+             else p for p in parts]
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+  def _sage_et(self, et, x_dict, edge_mask_dict, rows):
+    ename = '__'.join(et)
+    recs = self._et_recs(et, x_dict)
+    if not recs:
+      return None
+
+    def per_record(r, m):
+      ch = jax.lax.slice_in_dim(x_dict[r['res_t']], r['child_base'],
+                                r['child_base'] + r['fcap'] * r['k'])
+      return _masked_run_mean(
+          ch.reshape(r['fcap'], r['k'], ch.shape[-1]), m)
+
+    parts, key_t = self._walk(recs, edge_mask_dict, rows, per_record)
+    x_key = x_dict[key_t]
+    agg_all = self._resolve(parts, x_key.shape[-1], x_key.dtype)
+    h = nn.Dense(self.out_dim, dtype=self.dtype,
+                 name=f'lin_self_{ename}')(x_key[:rows[key_t]])
+    return key_t, h + nn.Dense(self.out_dim, use_bias=False,
+                               dtype=self.dtype,
+                               name=f'lin_nbr_{ename}')(agg_all)
+
+  def _gat_et(self, et, x_dict, edge_mask_dict, rows):
+    ename = '__'.join(et)
+    recs = self._et_recs(et, x_dict)
+    if not recs:
+      return None
+    key_t, res_ts = recs[0]['key_t'], {r['res_t'] for r in recs}
+    heads, hd = self.heads, self.out_dim
+    a_src = self.param(f'att_src_{ename}',
+                       nn.initializers.glorot_uniform(), (heads, hd))
+    a_dst = self.param(f'att_dst_{ename}',
+                       nn.initializers.glorot_uniform(), (heads, hd))
+    lin = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
+                   name=f'lin_{ename}')
+    # one projection per participating type (flat rows: PERF.md layout
+    # rule); SEPARATE src-/dst-alpha maps — a self-relation (e.g.
+    # paper-cites-paper) needs BOTH for the same type: children read
+    # a_src, parents read a_dst
+    w = {t: lin(x_dict[t]) for t in res_ts | {key_t}}
+    alpha_src = {t: jnp.einsum('nhd,hd->nh',
+                               w[t].reshape(-1, heads, hd), a_src,
+                               preferred_element_type=jnp.float32)
+                 for t in res_ts}
+    alpha_dst_key = jnp.einsum('nhd,hd->nh',
+                               w[key_t].reshape(-1, heads, hd), a_dst,
+                               preferred_element_type=jnp.float32)
+
+    def per_record(r, m):
+      f, k = r['fcap'], r['k']
+      wch = jax.lax.slice_in_dim(w[r['res_t']], r['child_base'],
+                                 r['child_base'] + f * k)
+      e = (jax.lax.slice_in_dim(alpha_src[r['res_t']], r['child_base'],
+                                r['child_base'] + f * k
+                                ).reshape(f, k, heads) +
+           jax.lax.slice_in_dim(alpha_dst_key, r['parent_base'],
+                                r['parent_base'] + f)[:, None, :])
+      attn = _masked_run_softmax(e, m, wch.dtype, self.negative_slope)
+      msgs = wch.reshape(f, k, heads, hd)
+      return (msgs * attn[..., None]).sum(axis=1).reshape(f, heads * hd)
+
+    parts, key_t = self._walk(recs, edge_mask_dict, rows, per_record)
+    outv = self._resolve(parts, heads * hd, w[key_t].dtype)
+    if not self.concat:
+      outv = outv.reshape(rows[key_t], heads, hd).mean(axis=1)
+    return key_t, outv
+
 class RGNN(nn.Module):
   """Hetero GNN: embeds each node type, stacks HeteroConv layers
   (reference examples/igbh/rgnn.py RGNN with sage/gat convs).
@@ -614,15 +770,28 @@ class RGNN(nn.Module):
   out_dim: int
   num_layers: int = 2
   conv: str = 'sage'
+  heads: int = 1     # conv='gat': attention heads (reference igbh: 4)
   out_ntype: NodeType = None
   dtype: Any = None
   hop_node_offsets: Any = None
   hop_edge_offsets: Any = None
+  # tree_dense: typed dense k-run aggregation over the hetero tree
+  # layout (TreeHeteroConv) — no per-edge gathers, segment scatters, or
+  # src/dst buffer concatenations. Requires ``tree_records`` from
+  # sampler.hetero_tree_blocks built with the SAME seed caps/fanouts as
+  # the loader. NOTE: records name STORED etypes; ``etypes`` here stays
+  # the message-direction (reversed) types for param parity.
+  tree_dense: bool = False
+  tree_records: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
     hier = self.hop_node_offsets is not None
+    if self.tree_dense:
+      assert hier and self.tree_records is not None, (
+          'RGNN(tree_dense=True) requires hop offsets + tree_records '
+          '(sampler.hetero_tree_blocks)')
     if hier:
       check_hetero_offsets(x_dict, edge_index_dict,
                            self.hop_node_offsets, self.hop_edge_offsets,
@@ -630,20 +799,48 @@ class RGNN(nn.Module):
     x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
                           name=f'embed_{t}')(x)
               for t, x in x_dict.items()}
+    # reference structure (examples/igbh/rgnn.py:37-56): with a predict
+    # type, every conv layer keeps hidden_dim and a final Linear maps
+    # to out_dim; GAT uses dim // heads per head with concat on EVERY
+    # layer, so the width stays dim
+    lin_out = self.out_ntype is not None
     for i in range(self.num_layers):
       last = i == self.num_layers - 1
-      dim = self.out_dim if last else self.hidden_dim
-      convs = {tuple(et): SAGEConv(dim, dtype=self.dtype)
-               if self.conv == 'sage' else GATConv(dim, dtype=self.dtype)
-               for et in self.etypes}
+      dim = self.hidden_dim if (lin_out or not last) else self.out_dim
+      if self.conv == 'gat':
+        assert dim % self.heads == 0, (
+            f'GAT layer width {dim} must divide heads={self.heads} '
+            '(reference parity: per-head dim = width // heads)')
+        conv_dim = dim // self.heads
+      else:
+        conv_dim = dim
       if hier:
+        hops_used = self.num_layers - i
         x_in, ei, em = hetero_trim(
             x_dict, edge_index_dict, edge_mask_dict,
-            self.hop_node_offsets, self.hop_edge_offsets,
-            self.num_layers - i)
+            self.hop_node_offsets, self.hop_edge_offsets, hops_used)
       else:
         x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
-      x_dict = HeteroConv(convs, name=f'hetero{i}')(x_in, ei, em)
+      if self.tree_dense:
+        # output widths: the next layer's typed prefixes (the deepest
+        # typed blocks are pure child input — homo out_rows, per type)
+        out_rows = {t: self.hop_node_offsets[t][hops_used - 1]
+                    for t in x_in}
+        x_dict = TreeHeteroConv(
+            conv_dim, records=self.tree_records[:hops_used],
+            conv=self.conv, heads=self.heads, concat=True,
+            dtype=self.dtype, out_rows=out_rows,
+            name=f'hetero{i}')(x_in, em)
+      else:
+        convs = {tuple(et): SAGEConv(conv_dim, dtype=self.dtype)
+                 if self.conv == 'sage'
+                 else GATConv(conv_dim, heads=self.heads, concat=True,
+                              dtype=self.dtype)
+                 for et in self.etypes}
+        x_dict = HeteroConv(convs, name=f'hetero{i}')(x_in, ei, em)
       if not last:
         x_dict = {t: nn.relu(v) for t, v in x_dict.items()}
-    return x_dict if self.out_ntype is None else x_dict[self.out_ntype]
+    if lin_out:
+      return nn.Dense(self.out_dim, dtype=self.dtype,
+                      name='lin_out')(x_dict[self.out_ntype])
+    return x_dict

@@ -2,7 +2,8 @@ from .base import (BaseSampler, EdgeSamplerInput, HeteroSamplerOutput,
                    NegativeSampling, NeighborOutput, NodeSamplerInput,
                    RemoteNodePathSamplerInput, RemoteSamplerInput,
                    SamplerOutput, SamplingConfig, SamplingType)
-from .calibrate import check_no_overflow, estimate_frontier_caps
+from .calibrate import (check_no_overflow, estimate_frontier_caps,
+                        link_seed_width)
 from .negative_sampler import RandomNegativeSampler
-from .neighbor_sampler import (NeighborSampler, hetero_tree_layout,
-                               tree_layout)
+from .neighbor_sampler import (NeighborSampler, hetero_tree_blocks,
+                               hetero_tree_layout, tree_layout)

@@ -170,6 +170,14 @@ def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir):
   edge type active at hop ``h`` to ``(source-frontier capacity, fanout)``;
   ``node_caps[t]`` is node type ``t``'s total buffer size.
   """
+  # CANONICAL intra-hop order: every consumer of this plan — the typed
+  # engines' per-hop expansion loops, hetero_tree_layout, and
+  # hetero_tree_blocks — derives its (hop, etype) ordering from
+  # hop_caps's dict order, so building it SORTED makes the positional
+  # layout independent of the caller's etypes ordering (a mismatch
+  # between a graph-dict order and a layout call would otherwise
+  # silently mis-base intra-hop child blocks)
+  etypes = sorted(tuple(et) for et in etypes)
   num_hops = max(len(fanouts_of(et)) for et in etypes)
   ntypes = set()
   for (u, _, v) in etypes:
@@ -241,6 +249,47 @@ def hetero_tree_layout(seed_caps: Dict[NodeType, int], etypes,
       edge_offs[et].append(edge_tot[et])
   return ({t: tuple(v) for t, v in node_offs.items()},
           {et: tuple(v) for et, v in edge_offs.items()})
+
+
+def hetero_tree_blocks(seed_caps: Dict[NodeType, int], etypes,
+                       num_neighbors, edge_dir: str = 'out'):
+  """Per-(hop, edge-type) dense-aggregation records for typed tree
+  batches — the typed counterpart of the homo dense-run layout
+  (models.TreeSAGEConv): within hop ``h``, each edge type's children
+  occupy a CONTIGUOUS ``fcap*k`` block of the result type's buffer (the
+  engine appends per (hop, etype) in ``hop_caps`` order), their
+  targets are the key type's contiguous frontier block, and the edge
+  block is the out-etype's hop-``h`` segment. Consumed by
+  ``models.TreeHeteroConv``.
+
+  Returns ``(records, node_offs)`` with ``records[h]`` a tuple of dicts
+  ``{et, out_et, key_t, res_t, fcap, k, child_base, parent_base,
+  edge_base}`` and ``node_offs`` the hetero_tree_layout node offsets.
+  """
+  etypes = [tuple(et) for et in etypes]
+  fanouts_of = ((lambda et: list(num_neighbors[et]))
+                if isinstance(num_neighbors, dict)
+                else (lambda et: list(num_neighbors)))
+  ntypes, hop_caps, _ = hetero_capacity_plan(etypes, fanouts_of,
+                                             seed_caps, edge_dir)
+  node_offs, edge_offs = hetero_tree_layout(seed_caps, etypes,
+                                            num_neighbors, edge_dir)
+  records = []
+  for h, per_et in enumerate(hop_caps):
+    recs = []
+    child_off = {t: node_offs[t][h] for t in ntypes}   # hop-h block start
+    for et, (fcap, k) in per_et.items():
+      key_t = et[0] if edge_dir == 'out' else et[2]
+      res_t = et[2] if edge_dir == 'out' else et[0]
+      out_et = reverse_edge_type(et) if edge_dir == 'out' else et
+      recs.append(dict(
+          et=et, out_et=out_et, key_t=key_t, res_t=res_t, fcap=fcap,
+          k=k, child_base=child_off[res_t],
+          parent_base=0 if h == 0 else node_offs[key_t][h - 1],
+          edge_base=(0 if h == 0 else edge_offs[out_et][h - 1])))
+      child_off[res_t] += fcap * k
+    records.append(tuple(recs))
+  return tuple(records), node_offs
 
 
 @functools.lru_cache(maxsize=None)

@@ -610,3 +610,102 @@ def test_merge_dense_zero_degree_leading_seed():
     nseed = int(b['num_seed_nodes'])
     np.testing.assert_allclose(out_seg[:nseed], out_dense[:nseed],
                                rtol=1e-4, atol=1e-5)
+
+
+def test_tree_dense_hetero_matches_segment():
+  """TreeHeteroConv's typed dense k-run aggregation == HeteroConv over
+  per-etype segment convs on hetero tree batches (seed logits), for
+  both SAGE and GAT convs, with the segment model's params remapped
+  into the dense layout. The config exercises the hard layout cases:
+  TWO etypes appending to the same type's buffer within one hop
+  (cites + writes -> paper) and a LEAF-ONLY node type that vanishes
+  from x_dict after layer 0 (topic)."""
+  import jax
+  CITES = ('paper', 'cites', 'paper')
+  WRITES = ('author', 'writes', 'paper')
+  REV = ('paper', 'rev_writes', 'author')
+  TAG = ('paper', 'tags', 'topic')
+  rng = np.random.default_rng(2)
+  n_p, n_a, n_t = 100, 60, 20
+  edges = {
+      CITES: np.stack([rng.integers(0, n_p, 600),
+                       rng.integers(0, n_p, 600)]),
+      WRITES: np.stack([rng.integers(0, n_a, 300),
+                        rng.integers(0, n_p, 300)]),
+      REV: np.stack([rng.integers(0, n_p, 300),
+                     rng.integers(0, n_a, 300)]),
+      TAG: np.stack([rng.integers(0, n_p, 200),
+                     rng.integers(0, n_t, 200)]),
+  }
+  nn_of = {'paper': n_p, 'author': n_a, 'topic': n_t}
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph(edges, graph_mode='CPU',
+                num_nodes={et: nn_of[et[0]] for et in edges})
+  ds.init_node_features(
+      {t: rng.standard_normal((n, 6)).astype(np.float32)
+       for t, n in nn_of.items()})
+  ds.init_node_labels({'paper': rng.integers(0, 3, n_p)})
+  fan = {CITES: [2, 2], WRITES: [2, 1], REV: [2, 1], TAG: [1, 0]}
+  loader = glt.loader.NeighborLoader(ds, fan, ('paper', np.arange(n_p)),
+                                     batch_size=4, seed=0, dedup='tree')
+  b = next(iter(loader))
+  x = {t: np.asarray(v) for t, v in b.x.items()}
+  ei = {et: np.asarray(v) for et, v in b.edge_index.items()}
+  em = {et: np.asarray(v) for et, v in b.edge_mask.items()}
+  no, eo = glt.sampler.hetero_tree_layout({'paper': 4}, tuple(fan), fan)
+  recs, no2 = glt.sampler.hetero_tree_blocks({'paper': 4}, tuple(fan),
+                                             fan)
+  assert {t: tuple(v) for t, v in no.items()} == dict(no2)
+  # the canonical plan must be caller-order-independent
+  recs_shuffled, _ = glt.sampler.hetero_tree_blocks(
+      {'paper': 4}, tuple(reversed(list(fan))), fan)
+  assert recs == recs_shuffled
+  rev_et = tuple(glt.typing.reverse_edge_type(et) for et in fan)
+
+  def remap(ps, conv, num_layers=2):
+    src = ps['params']
+    cls = 'SAGEConv' if conv == 'sage' else 'GATConv'
+    newp = {k: v for k, v in src.items()
+            if not k.startswith(cls + '_')}
+    idx = 0
+    # types alive after layer 0 = message targets (leaf-only types drop)
+    alive = {r['key_t'] for rr in recs for r in rr}
+    for i in range(num_layers):
+      present = {r['et'] for rr in recs[:num_layers - i] for r in rr}
+      het = {}
+      for et_msg in rev_et:
+        stored = glt.typing.reverse_edge_type(et_msg)
+        # flax numbers modules by USE: HeteroConv skips a conv whose
+        # src/dst type is absent from this layer's input, and skipped
+        # convs consume no name index
+        called = i == 0 or (et_msg[0] in alive and et_msg[2] in alive)
+        if not called:
+          continue
+        sub = src[f'{cls}_{idx}']
+        idx += 1
+        if stored not in present:
+          continue
+        ename = '__'.join(stored)
+        if conv == 'sage':
+          het[f'lin_self_{ename}'] = sub['lin_self']
+          het[f'lin_nbr_{ename}'] = sub['lin_nbr']
+        else:
+          het[f'lin_{ename}'] = sub['lin']
+          het[f'att_src_{ename}'] = sub['att_src']
+          het[f'att_dst_{ename}'] = sub['att_dst']
+      newp[f'hetero{i}'] = het
+    return {'params': newp}
+
+  for conv in ('sage', 'gat'):
+    kw = dict(etypes=rev_et, hidden_dim=8, out_dim=3, conv=conv,
+              heads=2, num_layers=2, out_ntype='paper',
+              hop_node_offsets=no, hop_edge_offsets=eo)
+    seg = glt.models.RGNN(**kw)
+    dense = glt.models.RGNN(**kw, tree_dense=True, tree_records=recs)
+    ps = jax.jit(seg.init)(jax.random.PRNGKey(0), x, ei, em)
+    pd = remap(ps, conv)
+    o_seg = np.asarray(jax.jit(seg.apply)(ps, x, ei, em))
+    o_dense = np.asarray(jax.jit(dense.apply)(pd, x, ei, em))
+    nseed = int(np.asarray(b.num_sampled_nodes['paper'])[0])
+    np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
+                               rtol=2e-4, atol=2e-4)
