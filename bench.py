@@ -219,9 +219,8 @@ def _run_hetero_e2e(jax, trace_dir, conv='sage'):
   loader = glt.loader.NeighborLoader(
       ds, fan, ('paper', hrng.integers(0, n_paper, hb * (E2E_ITERS + 5))),
       batch_size=hb, shuffle=True, drop_last=True, seed=0, dedup='tree')
-  no, eo = glt.sampler.hetero_tree_layout({'paper': hb}, tuple(fan), fan)
-  recs, _ = glt.sampler.hetero_tree_blocks({'paper': hb}, tuple(fan),
-                                           fan)
+  recs, no, eo = glt.sampler.hetero_tree_blocks({'paper': hb},
+                                                tuple(fan), fan)
   etypes = tuple(glt.typing.reverse_edge_type(et) for et in fan)
   # tree_dense typed aggregation (round 4) is the flagship hetero path;
   # heads=4 matches the reference igbh rgat default

@@ -101,9 +101,7 @@ def main():
   # typed dense k-run aggregation over the hierarchical tree layout —
   # the fast hetero path (PERF.md round 4); --model rgat matches the
   # reference default (4 heads, per-head dim = hidden // heads)
-  no, eo = glt.sampler.hetero_tree_layout(
-      {'paper': args.batch_size}, tuple(fanouts), fanouts)
-  recs, _ = glt.sampler.hetero_tree_blocks(
+  recs, no, eo = glt.sampler.hetero_tree_blocks(
       {'paper': args.batch_size}, tuple(fanouts), fanouts)
   etypes = [glt.typing.reverse_edge_type(CITES),
             glt.typing.reverse_edge_type(WRITES),

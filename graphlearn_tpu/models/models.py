@@ -809,8 +809,9 @@ class RGNN(nn.Module):
       dim = self.hidden_dim if (lin_out or not last) else self.out_dim
       if self.conv == 'gat':
         assert dim % self.heads == 0, (
-            f'GAT layer width {dim} must divide heads={self.heads} '
-            '(reference parity: per-head dim = width // heads)')
+            f'GAT layer width {dim} must be divisible by '
+            f'heads={self.heads} (reference parity: per-head dim = '
+            'width // heads)')
         conv_dim = dim // self.heads
       else:
         conv_dim = dim

@@ -262,9 +262,12 @@ def hetero_tree_blocks(seed_caps: Dict[NodeType, int], etypes,
   block is the out-etype's hop-``h`` segment. Consumed by
   ``models.TreeHeteroConv``.
 
-  Returns ``(records, node_offs)`` with ``records[h]`` a tuple of dicts
-  ``{et, out_et, key_t, res_t, fcap, k, child_base, parent_base,
-  edge_base}`` and ``node_offs`` the hetero_tree_layout node offsets.
+  Returns ``(records, node_offs, edge_offs)`` with ``records[h]`` a
+  tuple of dicts ``{et, out_et, key_t, res_t, fcap, k, child_base,
+  parent_base, edge_base}`` and node_offs/edge_offs the
+  hetero_tree_layout offsets (returned so one call serves both the
+  records and the hierarchical model layout — paired calls with
+  diverging arguments would silently mis-base the layout).
   """
   etypes = [tuple(et) for et in etypes]
   fanouts_of = ((lambda et: list(num_neighbors[et]))
@@ -289,7 +292,7 @@ def hetero_tree_blocks(seed_caps: Dict[NodeType, int], etypes,
           edge_base=(0 if h == 0 else edge_offs[out_et][h - 1])))
       child_off[res_t] += fcap * k
     records.append(tuple(recs))
-  return tuple(records), node_offs
+  return tuple(records), node_offs, edge_offs
 
 
 @functools.lru_cache(maxsize=None)

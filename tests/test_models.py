@@ -652,12 +652,14 @@ def test_tree_dense_hetero_matches_segment():
   x = {t: np.asarray(v) for t, v in b.x.items()}
   ei = {et: np.asarray(v) for et, v in b.edge_index.items()}
   em = {et: np.asarray(v) for et, v in b.edge_mask.items()}
-  no, eo = glt.sampler.hetero_tree_layout({'paper': 4}, tuple(fan), fan)
-  recs, no2 = glt.sampler.hetero_tree_blocks({'paper': 4}, tuple(fan),
-                                             fan)
-  assert {t: tuple(v) for t, v in no.items()} == dict(no2)
+  no_l, eo_l = glt.sampler.hetero_tree_layout({'paper': 4}, tuple(fan),
+                                              fan)
+  recs, no, eo = glt.sampler.hetero_tree_blocks({'paper': 4},
+                                                tuple(fan), fan)
+  assert {t: tuple(v) for t, v in no_l.items()} == dict(no)
+  assert eo_l == eo
   # the canonical plan must be caller-order-independent
-  recs_shuffled, _ = glt.sampler.hetero_tree_blocks(
+  recs_shuffled, _, _ = glt.sampler.hetero_tree_blocks(
       {'paper': 4}, tuple(reversed(list(fan))), fan)
   assert recs == recs_shuffled
   rev_et = tuple(glt.typing.reverse_edge_type(et) for et in fan)
