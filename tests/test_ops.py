@@ -434,3 +434,41 @@ def test_stitch_rows():
   assert out[0, 0].tolist() == 20
   assert om[0].tolist() == [True, False]
   assert out[1].tolist() == [30, 31]
+
+
+def test_trace_parsers_shared_loader(tmp_path):
+  """device_program_ms / device_op_ms parse the same trace through the
+  shared memoized loader: program averages, op totals with '.NNN'
+  stripping (bare-digit names intact), steps normalization."""
+  import gzip
+  import json
+  from graphlearn_tpu.utils import device_op_ms, device_program_ms
+  events = [
+      {'ph': 'M', 'name': 'process_name', 'pid': 1,
+       'args': {'name': 'TPU:0'}},
+      {'ph': 'M', 'name': 'process_name', 'pid': 2,
+       'args': {'name': 'CPU'}},
+      # programs: two calls of the same jit program
+      {'ph': 'X', 'pid': 1, 'name': 'jit_train_step(123)', 'dur': 2000,
+       'ts': 0},
+      {'ph': 'X', 'pid': 1, 'name': 'jit_train_step(123)', 'dur': 4000,
+       'ts': 10},
+      # ops: suffix-stripped grouping; bare-digit name kept whole
+      {'ph': 'X', 'pid': 1, 'name': 'fusion.7', 'dur': 1000, 'ts': 1},
+      {'ph': 'X', 'pid': 1, 'name': 'fusion.8', 'dur': 3000, 'ts': 2},
+      {'ph': 'X', 'pid': 1, 'name': 'layer1', 'dur': 500, 'ts': 3},
+      # non-TPU lane must be ignored
+      {'ph': 'X', 'pid': 2, 'name': 'fusion.9', 'dur': 9000, 'ts': 4},
+  ]
+  d = tmp_path / 'plugins' / 'profile' / 'run'
+  d.mkdir(parents=True)
+  with gzip.open(d / 'host.trace.json.gz', 'wt') as f:
+    json.dump({'traceEvents': events}, f)
+  progs = device_program_ms(str(tmp_path))
+  assert progs == {'jit_train_step(123)': (3.0, 2)}   # avg of 2, 4 ms
+  ops = device_op_ms(str(tmp_path), steps=2)
+  assert ops['fusion'] == (2.0, 2)     # (1+3) ms total / 2 steps
+  assert ops['layer1'] == (0.25, 1)    # bare digits NOT stripped
+  assert 'fusion.9' not in ops and 'jit_train_step(123)' not in ops
+  top = device_op_ms(str(tmp_path), top=1, steps=2)
+  assert list(top) == ['fusion']
