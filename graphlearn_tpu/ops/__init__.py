@@ -9,7 +9,8 @@ from .induce_tree import (TreeInducerState, induce_next_tree,
                           init_empty_tree, init_node_tree)
 from .negative import (random_negative_sample, random_negative_sample_local,
                        sort_csr_segments)
-from .neighbor import (BLOCK, build_padded_adjacency, build_row_cumsum,
+from .neighbor import (BLOCK, build_padded_adjacency,
+                       build_padded_adjacency_device, build_row_cumsum,
                        choose_padded_window, edge_in_csr,
                        padded_table_stats, uniform_sample,
                        uniform_sample_block, uniform_sample_local,

@@ -472,3 +472,50 @@ def test_trace_parsers_shared_loader(tmp_path):
   assert 'fusion.9' not in ops and 'jit_train_step(123)' not in ops
   top = device_op_ms(str(tmp_path), top=1, steps=2)
   assert list(top) == ['fusion']
+
+
+def test_build_padded_adjacency_device_contract():
+  """Device padded-table builder == host builder's contract: every
+  entry is a real neighbor, rows are duplicate-free uniform subsets of
+  size min(deg, W), epos maps back to CSR positions, and a new key
+  yields a different subset for truncated rows (the per-epoch
+  de-bias)."""
+  import jax
+  import jax.numpy as jnp
+  from graphlearn_tpu import ops
+  rng = np.random.default_rng(0)
+  n, W = 50, 4
+  # heavy row 0 (degree 20), plus random rows incl. some zero-degree
+  rows = np.concatenate([np.zeros(20, np.int64),
+                         rng.integers(1, n // 2, 150)])
+  cols = rng.integers(0, n, rows.shape[0])
+  # dedup (v, w) pairs so subsets are over distinct neighbors
+  pairs = np.unique(np.stack([rows, cols], 1), axis=0)
+  rows, cols = pairs[:, 0], pairs[:, 1]
+  order = np.argsort(rows, kind='stable')
+  rows, cols = rows[order], cols[order]
+  indptr = np.concatenate([[0], np.cumsum(np.bincount(rows,
+                                                      minlength=n))])
+  tab, deg, epos = ops.build_padded_adjacency_device(
+      jnp.asarray(indptr), jnp.asarray(cols), W, jax.random.PRNGKey(0),
+      edge_pos=True)
+  tab, deg, epos = np.asarray(tab), np.asarray(deg), np.asarray(epos)
+  true_deg = np.diff(indptr)
+  np.testing.assert_array_equal(deg, np.minimum(true_deg, W))
+  for v in range(n):
+    got = tab[v][tab[v] != ops.FILL]
+    nbrs = set(cols[indptr[v]:indptr[v + 1]].tolist())
+    assert len(got) == min(true_deg[v], W)
+    assert len(set(got.tolist())) == len(got)        # no duplicates
+    assert set(got.tolist()) <= nbrs                 # real neighbors
+    for j in range(len(got)):                        # epos round-trips
+      assert cols[epos[v, j]] == tab[v, j]
+  # reseed changes the heavy row's subset (21 choose 4 collisions are
+  # vanishingly unlikely across 5 keys)
+  subsets = set()
+  for s in range(5):
+    t2, _, _ = ops.build_padded_adjacency_device(
+        jnp.asarray(indptr), jnp.asarray(cols), W,
+        jax.random.PRNGKey(s), edge_pos=False)
+    subsets.add(tuple(sorted(np.asarray(t2)[0].tolist())))
+  assert len(subsets) > 1
