@@ -134,16 +134,23 @@ def main():
   n_tr = int(args.n_paper * 0.2)
   loader = glt.loader.NeighborLoader(
       ds, fan, ('paper', np.arange(n_tr)), batch_size=args.batch_size,
-      shuffle=True, drop_last=True, seed=0)
+      shuffle=True, drop_last=True, seed=0, dedup='tree')
   test_loader = glt.loader.NeighborLoader(
       ds, fan, ('paper', np.arange(n_tr, int(args.n_paper * 0.25))),
-      batch_size=args.batch_size, shuffle=False, drop_last=False, seed=1)
+      batch_size=args.batch_size, shuffle=False, drop_last=False, seed=1,
+      dedup='tree')
 
-  # model consumes message-flow orientation = reversed loader etypes
+  # model consumes message-flow orientation = reversed loader etypes;
+  # dense k-run typed attention over the hierarchical tree layout
+  # (PERF.md round 4) — drop tree_records/offsets for the segment path
+  recs, no, eo = glt.sampler.hetero_tree_blocks(
+      {'paper': args.batch_size}, tuple(edges), fan)
   model_etypes = tuple(rev(et) for et in edges)
   model = HGT(ntypes=tuple(nnodes), etypes=model_etypes,
               hidden_dim=args.hidden, out_dim=ncls, heads=args.heads,
-              num_layers=2, out_ntype='paper')
+              num_layers=2, out_ntype='paper',
+              hop_node_offsets=no, hop_edge_offsets=eo,
+              tree_records=recs)
 
   def bdict(batch):
     return dict(x=batch.x, ei=batch.edge_index, em=batch.edge_mask,
@@ -158,11 +165,13 @@ def main():
 
   def loss_fn(params, b):
     logits = model.apply(params, b['x'], b['ei'], b['em'])
-    seed_mask = jnp.arange(logits.shape[0]) < b['num_seed']
-    ce = optax.softmax_cross_entropy(logits, jax.nn.one_hot(b['y'], ncls))
+    n = logits.shape[0]          # hierarchical emits the seed prefix
+    y = b['y'][:n]
+    seed_mask = jnp.arange(n) < b['num_seed']
+    ce = optax.softmax_cross_entropy(logits, jax.nn.one_hot(y, ncls))
     loss = jnp.where(seed_mask, ce, 0.0).sum() / jnp.maximum(
         seed_mask.sum(), 1)
-    correct = ((logits.argmax(-1) == b['y']) & seed_mask).sum()
+    correct = ((logits.argmax(-1) == y) & seed_mask).sum()
     return loss, (correct, seed_mask.sum())
 
   @jax.jit

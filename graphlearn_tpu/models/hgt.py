@@ -46,6 +46,14 @@ class HGTConv(nn.Module):
   # in-dims differ from out_dim. Inside the HGT stack every conv input
   # is hidden_dim == out_dim, so the default suffices there.
   in_dims: Any = None
+  # tree_records (sampler.hetero_tree_blocks, restricted to this
+  # layer's hops): dense k-run attention over typed tree batches — a
+  # parent's in-edges per etype ARE its contiguous k-run, so the
+  # segment softmax becomes a masked run softmax with dense slices
+  # (same params either way; equivalence-tested). out_rows: per-type
+  # output prefix widths (the consumer's typed prefixes).
+  tree_records: Any = None
+  out_rows: Any = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict):
@@ -86,7 +94,12 @@ class HGTConv(nn.Module):
 
     cdtype = self.dtype or jnp.result_type(*[x.dtype
                                              for x in x_dict.values()])
-    agg = {t: jnp.zeros(k[t].shape, cdtype) for t in k}
+    dense = self.tree_records is not None
+    rows_out = {t: (k[t].shape[0] if self.out_rows is None
+                    else min(int(self.out_rows[t]), k[t].shape[0]))
+                for t in k}
+    agg = {t: jnp.zeros((rows_out[t] if dense else k[t].shape[0],
+                         heads, d), cdtype) for t in k}
     for et in etypes:
       et = tuple(et)
       src_t, _, dst_t = et
@@ -100,16 +113,21 @@ class HGTConv(nn.Module):
       p_rel = self.param(f'pri_{name}', nn.initializers.ones, (heads,))
       if et not in edge_index_dict or src_t not in k or dst_t not in k:
         continue
+      k_rel = jnp.einsum('nhd,hde->nhe', k[src_t],
+                         a_rel.astype(k[src_t].dtype))
+      v_rel = jnp.einsum('nhd,hde->nhe', v[src_t],
+                         m_rel.astype(v[src_t].dtype))
+      if dense:
+        agg[dst_t] = agg[dst_t] + self._dense_et(
+            et, k_rel, v_rel, q[dst_t], p_rel, edge_mask_dict,
+            rows_out[dst_t], heads, d, cdtype)
+        continue
       ei = edge_index_dict[et]
       em = edge_mask_dict[et]
       row = jnp.maximum(ei[0], 0)
       col = jnp.maximum(ei[1], 0)
       valid = em & (ei[0] >= 0) & (ei[1] >= 0)
       n_dst = k[dst_t].shape[0]
-      k_rel = jnp.einsum('nhd,hde->nhe', k[src_t],
-                         a_rel.astype(k[src_t].dtype))
-      v_rel = jnp.einsum('nhd,hde->nhe', v[src_t],
-                         m_rel.astype(v[src_t].dtype))
       # attention logits + softmax in f32
       logits = (q[dst_t][col].astype(jnp.float32) *
                 k_rel[row].astype(jnp.float32)).sum(-1)
@@ -140,10 +158,44 @@ class HGTConv(nn.Module):
       skip = self.param(f'skip_{t}', nn.initializers.ones, ())
       if x_dict[t].shape[-1] == self.out_dim:
         gate = jax.nn.sigmoid(skip).astype(a.dtype)
-        out[t] = gate * a + (1.0 - gate) * x_dict[t].astype(a.dtype)
+        out[t] = gate * a + (1.0 - gate) * x_dict[t][:n].astype(a.dtype)
       else:
         out[t] = a
     return out
+
+  def _dense_et(self, et, k_rel, v_rel, q_dst, p_rel, edge_mask_dict,
+                r_out, heads, d, cdtype):
+    """Dense k-run attention for one etype over tree records: a
+    parent's in-edges per etype are its contiguous k-run, so the
+    per-destination softmax is a masked run softmax (f32, same
+    stabilization as the segment path)."""
+    from .models import resolve_hetero_parts, walk_hetero_records
+    recs = [r for hop in self.tree_records for r in hop
+            if r['out_et'] == tuple(et)]
+
+    def per_record(r, m):
+      f, kk = r['fcap'], r['k']
+      kc = jax.lax.slice_in_dim(k_rel, r['child_base'],
+                                r['child_base'] + f * kk
+                                ).reshape(f, kk, heads, d)
+      qp = jax.lax.slice_in_dim(q_dst, r['parent_base'],
+                                r['parent_base'] + f)
+      logits = (qp[:, None].astype(jnp.float32) *
+                kc.astype(jnp.float32)).sum(-1)
+      logits = logits * p_rel[None, None, :] / math.sqrt(d)  # [f, k, H]
+      logits = jnp.where(m[..., None], logits, -jnp.inf)
+      mx = logits.max(axis=1, keepdims=True)
+      mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+      ex = jnp.where(m[..., None], jnp.exp(logits - mx), 0.0)
+      denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-9)
+      attn = (ex / denom).astype(cdtype)
+      vc = jax.lax.slice_in_dim(v_rel, r['child_base'],
+                                r['child_base'] + f * kk
+                                ).reshape(f, kk, heads, d)
+      return (vc * attn[..., None]).sum(axis=1)           # [f, H, D]
+
+    parts = walk_hetero_records(recs, edge_mask_dict, r_out, per_record)
+    return resolve_hetero_parts(parts, (heads, d), cdtype)
 
 
 class HGT(nn.Module):
@@ -167,6 +219,10 @@ class HGT(nn.Module):
   dtype: Any = None
   hop_node_offsets: Any = None
   hop_edge_offsets: Any = None
+  # tree_records (sampler.hetero_tree_blocks): dense k-run typed
+  # attention per layer (see HGTConv.tree_records) with per-type
+  # out_rows prefix outputs — requires the hierarchical offsets.
+  tree_records: Any = None
   # per-type RAW feature widths: when given, the input Dense lin_{t} is
   # materialized for every ntype even if absent from the init batch, so
   # the param tree never depends on batch content (see HGTConv.in_dims)
@@ -193,17 +249,26 @@ class HGT(nn.Module):
           nn.Dense(self.hidden_dim, dtype=self.dtype, name=f'lin_{t}')(
               jnp.zeros((1, self.in_dims[t]),
                         self.dtype or jnp.float32))
+    if self.tree_records is not None:
+      assert hier, ('HGT(tree_records=...) requires the hierarchical '
+                    'hop offsets built from the same plan')
     meta = (tuple(self.ntypes), tuple(tuple(e) for e in self.etypes))
     for i in range(self.num_layers):
+      hops_used = self.num_layers - i
       if hier:
         x_in, ei, em = hetero_trim(
             x_dict, edge_index_dict, edge_mask_dict,
-            self.hop_node_offsets, self.hop_edge_offsets,
-            self.num_layers - i)
+            self.hop_node_offsets, self.hop_edge_offsets, hops_used)
       else:
         x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
+      recs = out_rows = None
+      if self.tree_records is not None:
+        recs = self.tree_records[:hops_used]
+        out_rows = {t: self.hop_node_offsets[t][hops_used - 1]
+                    for t in x_in}
       x_dict = HGTConv(self.hidden_dim, meta, heads=self.heads,
-                       dtype=self.dtype, name=f'conv{i}')(x_in, ei, em)
+                       dtype=self.dtype, tree_records=recs,
+                       out_rows=out_rows, name=f'conv{i}')(x_in, ei, em)
     head = nn.Dense(self.out_dim, dtype=self.dtype, name='head')
     if self.out_ntype is None:
       return {t: head(x) for t, x in x_dict.items()}

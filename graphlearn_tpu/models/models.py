@@ -596,6 +596,48 @@ class HeteroConv(nn.Module):
     return out
 
 
+def walk_hetero_records(recs, edge_mask_dict, r_out, per_record):
+  """Shared parent-coverage walk over hetero tree records (consumed by
+  TreeHeteroConv and the dense HGTConv path): for each hop record,
+  slice the edge-mask segment, emit ``per_record(r, m)`` ([f, ...]
+  values), and track coverage of the key type's parent axis — etypes
+  inactive at an earlier hop leave ('gap', n) placeholders
+  ``resolve_hetero_parts`` fills with zeros."""
+  parts, covered = [], 0
+  for r in recs:
+    if r['parent_base'] >= r_out:
+      break
+    f, k = r['fcap'], r['k']
+    m = jax.lax.slice_in_dim(edge_mask_dict[r['out_et']],
+                             r['edge_base'], r['edge_base'] + f * k
+                             ).reshape(f, k)
+    if r['parent_base'] > covered:
+      parts.append(('gap', r['parent_base'] - covered))
+      covered = r['parent_base']
+    assert r['parent_base'] == covered, (
+        f'hetero tree records for {recs[0]["et"]} overlap parents '
+        f'({r["parent_base"]} vs {covered}); build them with '
+        'sampler.hetero_tree_blocks from the SAME seed caps/fanouts '
+        'as the loader')
+    parts.append(per_record(r, m))
+    covered += f
+  if covered < r_out:
+    parts.append(('gap', r_out - covered))
+  return parts
+
+
+def resolve_hetero_parts(parts, feat_shape, dtype):
+  """Replace ('gap', n) placeholders with zeros of [n, *feat_shape] and
+  concatenate along the parent axis. Empty walks (a target type with a
+  zero-width output prefix, e.g. a non-seed type at the last layer)
+  resolve to a [0, ...] array."""
+  if not parts:
+    return jnp.zeros((0,) + tuple(feat_shape), dtype)
+  parts = [jnp.zeros((p[1],) + tuple(feat_shape), dtype)
+           if isinstance(p, tuple) else p for p in parts]
+  return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
 class TreeHeteroConv(nn.Module):
   """One hetero layer over TYPED tree batches with dense k-run
   aggregation — the typed counterpart of TreeSAGEConv/TreeGATConv.
@@ -651,40 +693,13 @@ class TreeHeteroConv(nn.Module):
             and r['res_t'] in x_dict and r['key_t'] in x_dict]
 
   def _walk(self, recs, edge_mask_dict, rows, per_record):
-    """Shared parent-coverage walk: for each hop record, slice the
-    edge-mask segment, emit ``per_record(r, m)`` ([f, D] values), and
-    track coverage of the key type's parent axis — etypes inactive at
-    an earlier hop leave ('gap', n) placeholders the caller resolves
-    with zeros of its feature dim. Returns (parts, key_t)."""
     key_t = recs[0]['key_t']
-    r_out = rows[key_t]
-    parts, covered = [], 0
-    for r in recs:
-      if r['parent_base'] >= r_out:
-        break
-      f, k = r['fcap'], r['k']
-      m = jax.lax.slice_in_dim(edge_mask_dict[r['out_et']],
-                               r['edge_base'], r['edge_base'] + f * k
-                               ).reshape(f, k)
-      if r['parent_base'] > covered:
-        parts.append(('gap', r['parent_base'] - covered))
-        covered = r['parent_base']
-      assert r['parent_base'] == covered, (
-          f'hetero tree records for {recs[0]["et"]} overlap parents '
-          f'({r["parent_base"]} vs {covered}); build them with '
-          'sampler.hetero_tree_blocks from the SAME seed caps/fanouts '
-          'as the loader')
-      parts.append(per_record(r, m))
-      covered += f
-    if covered < r_out:
-      parts.append(('gap', r_out - covered))
-    return parts, key_t
+    return walk_hetero_records(recs, edge_mask_dict, rows[key_t],
+                               per_record), key_t
 
   @staticmethod
   def _resolve(parts, fdim, dtype):
-    parts = [jnp.zeros((p[1], fdim), dtype) if isinstance(p, tuple)
-             else p for p in parts]
-    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    return resolve_hetero_parts(parts, (fdim,), dtype)
 
   def _sage_et(self, et, x_dict, edge_mask_dict, rows):
     ename = '__'.join(et)

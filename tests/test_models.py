@@ -711,3 +711,45 @@ def test_tree_dense_hetero_matches_segment():
     nseed = int(np.asarray(b.num_sampled_nodes['paper'])[0])
     np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
                                rtol=2e-4, atol=2e-4)
+
+
+def test_hgt_tree_dense_matches_segment():
+  """HGTConv's dense k-run typed attention (tree_records) == the
+  segment-softmax path on hetero tree batches — SAME params (the dense
+  path is a mode of the same conv), seed logits compared."""
+  import jax
+  ET1, ET2 = ('u', 'to', 'v'), ('v', 'back', 'u')
+  rng = np.random.default_rng(5)
+  nu, nv = 90, 70
+  e1 = np.stack([rng.integers(0, nu, 500), rng.integers(0, nv, 500)])
+  e2 = np.stack([rng.integers(0, nv, 400), rng.integers(0, nu, 400)])
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph({ET1: e1, ET2: e2}, graph_mode='CPU',
+                num_nodes={ET1: nu, ET2: nv})
+  ds.init_node_features(
+      {'u': rng.standard_normal((nu, 8)).astype(np.float32),
+       'v': rng.standard_normal((nv, 8)).astype(np.float32)})
+  ds.init_node_labels({'u': rng.integers(0, 3, nu)})
+  fan = {ET1: [3, 2], ET2: [2, 2]}
+  loader = glt.loader.NeighborLoader(ds, fan, ('u', np.arange(nu)),
+                                     batch_size=8, seed=0, dedup='tree')
+  b = next(iter(loader))
+  x = {t: np.asarray(v) for t, v in b.x.items()}
+  ei = {et: np.asarray(v) for et, v in b.edge_index.items()}
+  em = {et: np.asarray(v) for et, v in b.edge_mask.items()}
+  recs, no, eo = glt.sampler.hetero_tree_blocks({'u': 8}, tuple(fan),
+                                                fan)
+  ntypes = ('u', 'v')
+  etypes = tuple(sorted(ei))          # message-flow types, batch keys
+  from graphlearn_tpu.models import HGT
+  kw = dict(ntypes=ntypes, etypes=etypes, hidden_dim=8, out_dim=3,
+            heads=2, num_layers=2, out_ntype='u',
+            hop_node_offsets=no, hop_edge_offsets=eo)
+  seg = HGT(**kw)
+  dense = HGT(**kw, tree_records=recs)
+  params = jax.jit(seg.init)(jax.random.PRNGKey(0), x, ei, em)
+  o_seg = np.asarray(jax.jit(seg.apply)(params, x, ei, em))
+  o_dense = np.asarray(jax.jit(dense.apply)(params, x, ei, em))
+  nseed = int(np.asarray(b.num_sampled_nodes['u'])[0])
+  np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
+                             rtol=2e-4, atol=2e-4)
