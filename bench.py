@@ -176,7 +176,8 @@ def _traced_step_ms(jax, run_step, trace_dir, prog_prefix):
   return sum(ms for ms, _ in progs.values()), train_ms
 
 
-def _run_hetero_e2e(jax, trace_dir, conv='sage'):
+def _run_hetero_e2e(jax, trace_dir, conv='sage', n_paper=100_000,
+                    n_author=357_041, feat_dim=1024, hb=1024):
   """IGBH-shaped hetero RGNN train step, device-traced (the reference's
   flagship hetero workload: examples/igbh/train_rgnn.py, IGB-tiny node
   counts 100k papers / 357k authors, 1024-dim features, hidden 128).
@@ -194,7 +195,8 @@ def _run_hetero_e2e(jax, trace_dir, conv='sage'):
   CITES = ('paper', 'cites', 'paper')
   WRITES = ('author', 'writes', 'paper')
   REV = ('paper', 'rev_writes', 'author')
-  n_paper, n_author, feat_dim, ncls = 100_000, 357_041, 1024, 16
+  n_paper, n_author, feat_dim, ncls = (n_paper, n_author, feat_dim,
+                                       16)
   hrng = np.random.default_rng(7)
   cites = np.stack([hrng.integers(0, n_paper, n_paper * 12),
                     hrng.integers(0, n_paper, n_paper * 12)])
@@ -214,7 +216,6 @@ def _run_hetero_e2e(jax, trace_dir, conv='sage'):
                                      dtype=np.float32)})
   ds.init_node_labels(
       {'paper': hrng.integers(0, ncls, n_paper)})
-  hb = 1024
   fan = {CITES: [15, 10], WRITES: [15, 10], REV: [15, 10]}
   loader = glt.loader.NeighborLoader(
       ds, fan, ('paper', hrng.integers(0, n_paper, hb * (E2E_ITERS + 5))),

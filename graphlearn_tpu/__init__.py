@@ -6,6 +6,21 @@ accelerator-resident graph sampling, a sharded HBM feature store with
 hot-vertex caching, graph partitioning, distributed sampling + feature
 collection over ICI/DCN collectives, and PyG-compatible dataset/loader APIs.
 """
+import os as _os
+
+# Honor JAX_PLATFORMS even on runtimes whose PJRT plugin registration
+# ignores the env var (the axon-tunnel rig): only the
+# jax.config.update path reliably selects the backend there, and it
+# must run BEFORE first backend use. Without this, subprocesses
+# launched with JAX_PLATFORMS=cpu (tests, example smokes) silently
+# attach to the accelerator — or hang when it is unreachable.
+if _os.environ.get('JAX_PLATFORMS'):
+  try:
+    import jax as _jax
+    _jax.config.update('jax_platforms', _os.environ['JAX_PLATFORMS'])
+  except (ImportError, RuntimeError):
+    pass   # backend already initialized (config then already applied)
+
 from . import (channel, data, distributed, loader, models, ops, partition,
                sampler, typing, utils)
 
