@@ -33,6 +33,18 @@ class LinkNeighborLoader(LinkLoader):
       if frontier_caps != 'auto':
         raise ValueError(f'frontier_caps={frontier_caps!r}: pass a list '
                          "of per-hop caps or 'auto'")
+      if isinstance(data.graph, dict) or (
+          isinstance(edge_label_index, tuple) and
+          len(edge_label_index) == 2 and
+          isinstance(edge_label_index[0], (tuple, list)) and
+          len(edge_label_index[0]) == 3):
+        # hetero dataset, or an (etype, index) pair on LinkLoader's own
+        # tuple convention — fail with the sampler's clear contract, not
+        # an AttributeError inside estimate_frontier_caps
+        raise ValueError('frontier_caps is homogeneous-only (the typed '
+                         'engine plans capacities per edge type; clamp '
+                         'seeds via batch_size / hops via node_budget '
+                         'instead)')
       import numpy as np
       from ..sampler.calibrate import (estimate_frontier_caps,
                                        link_seed_width)

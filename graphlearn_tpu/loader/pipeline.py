@@ -33,6 +33,12 @@ import numpy as np
 from .. import ops
 from .node_loader import NodeLoader
 
+_RECOMPUTE_MSG = (
+    "overflow_policy='recompute' needs a device->host sync per batch, "
+    'which defeats the overlapped pipeline. Use the plain loader loop '
+    "for recompute, or overflow_policy='raise'/'warn' here (the flag "
+    'accumulates on device and is checked once at epoch end).')
+
 
 class OverlappedTrainer:
   """Fuses batch n's train step with batch n+1's sample+collate.
@@ -53,6 +59,9 @@ class OverlappedTrainer:
     if sampler.with_edge:
       raise ValueError('with_edge batches are not supported in the '
                        'overlapped program')
+    if getattr(sampler, 'clamped_exact', False) and \
+        loader.overflow_policy == 'recompute':
+      raise ValueError(_RECOMPUTE_MSG)
     self.loader = loader
     self.model = model
     self.num_classes = num_classes
@@ -85,17 +94,24 @@ class OverlappedTrainer:
       col = ops.collate_batch(res['node'], res['num_nodes'], res['row'],
                               res['col'], feats, id2i, labels, None, None,
                               label_cap=label_cap)
-      return dict(x=col['x'], edge_index=col['edge_index'],
-                  edge_mask=res['edge_mask'], y=col['y'],
-                  num_seed_nodes=res['num_sampled_nodes'][0])
+      batch = dict(x=col['x'], edge_index=col['edge_index'],
+                   edge_mask=res['edge_mask'], y=col['y'],
+                   num_seed_nodes=res['num_sampled_nodes'][0])
+      # the calibrated-caps truncation flag rides OUTSIDE the batch dict
+      # (train_step must not see it; the batch buffers are donated)
+      return batch, res['overflow']
 
-    def _fused(state, batch, fargs, feats, id2i, labels, seeds, smask,
-               key):
+    def _fused(state, batch, ovf, pending, fargs, feats, id2i, labels,
+               seeds, smask, key):
       # two independent subgraphs in one program: XLA may interleave
       new_state, loss, acc = train_step(state, batch)
-      next_batch = _sample_collate(fargs, feats, id2i, labels, seeds,
-                                   smask, key)
-      return new_state, loss, acc, next_batch
+      next_batch, next_pending = _sample_collate(fargs, feats, id2i,
+                                                 labels, seeds, smask, key)
+      # overflow accumulates on device — zero host syncs in the hot
+      # loop. ``pending`` is the flag of the batch being trained NOW;
+      # next_pending stays out of the accumulator until its batch is
+      # actually consumed (a dropped prefetch must not taint the epoch)
+      return new_state, loss, acc, next_batch, ovf | pending, next_pending
 
     # donate the consumed batch buffers (state update buffers are small
     # relative to the 938k-slot batch; donation keeps HBM flat at two
@@ -128,16 +144,23 @@ class OverlappedTrainer:
     # NodeLoader.__iter__), so the per-epoch padded-table reseed must be
     # driven explicitly — same counter as plain iteration
     self.loader._begin_epoch()
+    # re-evaluate the guard each epoch (a post-construction policy
+    # change must take effect, like the plain loader's epoch start)
+    guarded, recompute = self.loader._overflow_epoch_start()
+    if recompute:
+      raise ValueError(_RECOMPUTE_MSG)
     losses = []
     batch = None
+    ovf = jnp.zeros((), bool)   # flags of batches actually trained
+    pending = None              # flag of the in-flight (sampled) batch
     truncated = False
     for padded, mask in self._seed_batches():
       if batch is None:
-        batch = self._dispatch_prime(padded, mask)
+        batch, pending = self._dispatch_prime(padded, mask)
         continue
-      state, loss, _, batch = self._fused_fn(
-          state, batch, self._sampler._fused_args(), self._feats,
-          self._id2i, self._labels, jnp.asarray(padded),
+      state, loss, _, batch, ovf, pending = self._fused_fn(
+          state, batch, ovf, pending, self._sampler._fused_args(),
+          self._feats, self._id2i, self._labels, jnp.asarray(padded),
           jnp.asarray(mask), self._sampler._next_key())
       losses.append(loss)
       if max_steps is not None and len(losses) >= max_steps:
@@ -150,4 +173,13 @@ class OverlappedTrainer:
       # and LR schedules.
       state, loss, _ = self._train_step(state, batch)
       losses.append(loss)
+      ovf = jnp.logical_or(ovf, pending)
+    if guarded:
+      # hand the device-accumulated flag to the loader's guard: natural
+      # epoch end applies overflow_policy ('raise'/'warn'); a max_steps
+      # break leaves it for loader.check_overflow(). Only trained
+      # batches count — a dropped prefetch's flag is discarded with it.
+      self.loader._ovf_accum = ovf
+      if not truncated:
+        self.loader._finish_epoch_overflow()
     return state, losses

@@ -863,6 +863,13 @@ class NeighborSampler(BaseSampler):
     distributional argument.
     """
     if self.is_hetero:
+      if key is not None:
+        # hetero paths draw from the sampler's internal stream; silently
+        # ignoring an explicit key would let the exact-replay contract
+        # degrade unnoticed if hetero calibration lands later
+        raise NotImplementedError(
+            'explicit key is homogeneous-only; hetero sampling uses the '
+            "sampler's internal PRNG stream")
       return self._hetero_sample_from_nodes(inputs, batch_cap)
     import jax.numpy as jnp
     seeds = np.asarray(inputs.node).reshape(-1)
@@ -1045,6 +1052,10 @@ class NeighborSampler(BaseSampler):
     import jax
     import jax.numpy as jnp
     if self.is_hetero:
+      if key is not None:
+        raise NotImplementedError(
+            'explicit key is homogeneous-only; hetero sampling uses the '
+            "sampler's internal PRNG stream")
       return self._hetero_sample_from_edges(inputs, **kwargs)
     # ONE key split across the negative draw and the node expansion —
     # identical whether the key comes from the caller (overflow replay)

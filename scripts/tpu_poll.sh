@@ -1,0 +1,19 @@
+#!/bin/bash
+# Poll the axon relay ports (8082 session / 8083 devices) with bare TCP
+# connects — never via jax init, which hangs forever when the relay is
+# down (see PERF.md "TPU-host failure mode").  Appends a line to
+# /root/repo/.tpu_poll.log whenever the state changes.
+LOG=/root/repo/.tpu_poll.log
+prev=""
+while true; do
+  state="down"
+  if timeout 2 bash -c 'cat < /dev/null > /dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+    state="up"
+  fi
+  if [ "$state" != "$prev" ]; then
+    echo "$(date -u +%FT%TZ) relay8083=$state" >> "$LOG"
+    prev="$state"
+  fi
+  [ "$state" = "up" ] && exit 0
+  sleep 60
+done
