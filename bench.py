@@ -290,10 +290,87 @@ def _sage_matmul_gflops(layer_rows, feat_dim, hidden, classes):
   return 3 * fwd / 1e9
 
 
+def _error_record(stage: str, err: str) -> dict:
+  """Structured failure record: the driver must always get ONE parseable
+  JSON line, never a bare traceback (BENCH_r04 died at backend init with
+  rc=1 and no numbers — this makes the failure self-describing)."""
+  return {
+      'metric': 'sampled_edges_per_sec', 'value': None, 'unit': 'M edges/s',
+      'vs_baseline': None, 'error': f'{stage}: {err}'[:400],
+      'config': {'num_nodes': NUM_NODES, 'avg_deg': AVG_DEG,
+                 'fanout': FANOUT, 'batch': BATCH},
+      'last_good_numbers': 'PERF.md (round-4 builder-measured)',
+  }
+
+
+def _relay_ports() -> tuple:
+  """Probed relay ports; GLT_BENCH_RELAY_PORTS overrides (tests force
+  the down path with it). Malformed tokens are ignored — a bad override
+  must degrade to the defaults, never crash the failure path itself."""
+  import os
+  ports = tuple(
+      int(tok) for tok in
+      os.environ.get('GLT_BENCH_RELAY_PORTS', '8083,8082').split(',')
+      if tok.strip().isdigit())
+  return ports or (8083, 8082)
+
+
+def _axon_relay_up(timeout: float = 2.0) -> bool:
+  """Bare TCP probe of the axon loopback relay. When the TPU host driver
+  dies, EVERY jax init that dials the axon plugin hangs forever (PERF.md
+  'TPU-host failure mode') — so probe the socket first, never jax."""
+  import socket
+  for port in _relay_ports():
+    try:
+      with socket.create_connection(('127.0.0.1', port), timeout=timeout):
+        return True
+    except OSError:
+      continue
+  return False
+
+
+def _watchdog(seconds: float, stage: str, detail: str):
+  """Hard deadline: if the returned Event isn't set within ``seconds``,
+  emit the structured error record and exit 0. Used twice — a tight
+  init deadline (the TCP probe can pass while the tunnel is still
+  wedged) and a whole-run deadline (a wedge can also manifest at the
+  first transfer/compile/fetch, long after init succeeded)."""
+  import os
+  import threading
+  done = threading.Event()
+
+  def fire():
+    if not done.wait(seconds):
+      print(json.dumps(_error_record(stage, detail)), flush=True)
+      os._exit(0)
+
+  threading.Thread(target=fire, daemon=True).start()
+  return done
+
+
 def main():
   import jax
   import graphlearn_tpu as glt
   glt.utils.enable_compilation_cache()
+
+  import os
+  init_s = float(os.environ.get('GLT_BENCH_INIT_TIMEOUT', '180'))
+  total_s = float(os.environ.get('GLT_BENCH_TOTAL_TIMEOUT', '3600'))
+  init_done = _watchdog(
+      init_s, 'backend-init-timeout',
+      f'jax backend init did not return within {init_s:.0f}s — axon '
+      'tunnel wedged (host-side TPU driver down?); recovery is '
+      "host-side, see PERF.md 'TPU-host failure mode'")
+  # whole-run deadline, never disarmed before the result prints: a
+  # wedge at the first device put / compile / trace fetch must also
+  # end as ONE parseable record, not a hung process
+  _watchdog(
+      total_s, 'run-timeout',
+      f'bench did not complete within {total_s:.0f}s — device work '
+      'wedged after successful backend init (axon tunnel / host driver '
+      'failure mid-run)')
+  backend = jax.devices()[0].platform
+  init_done.set()
 
   graph = build_graph()
   s_tree = glt.sampler.NeighborSampler(graph, FANOUT, seed=0, fused=True,
@@ -347,7 +424,7 @@ def main():
         return ms
     return None
 
-  result = {}
+  result = {'backend': backend}
   # dedup='map' resolves to the merge-sort exact engine (the program is
   # named sample_merge); the semantics are unchanged exact dedup
   tree_ms, map_ms = mode_ms('tree'), mode_ms('merge')
@@ -505,4 +582,20 @@ def main():
 
 
 if __name__ == '__main__':
-  main()
+  import os
+  try:
+    if os.environ.get('PALLAS_AXON_POOL_IPS') and not _axon_relay_up():
+      # clearly down: fail fast with a parseable record instead of
+      # letting the axon dial hang this process forever
+      ports = ','.join(str(p) for p in _relay_ports())
+      print(json.dumps(_error_record(
+          'backend-probe',
+          f'axon relay (127.0.0.1 port {ports}) refused connection — '
+          'host-side TPU driver/relay is down; jax init would hang. '
+          "Recovery is host-side (PERF.md 'TPU-host failure mode').")),
+            flush=True)
+    else:
+      main()
+  except Exception as e:                         # noqa: BLE001
+    print(json.dumps(_error_record('main', f'{type(e).__name__}: {e}')),
+          flush=True)

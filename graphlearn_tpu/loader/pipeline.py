@@ -143,12 +143,14 @@ class OverlappedTrainer:
     # _seed_batches walks loader._batcher directly (bypassing
     # NodeLoader.__iter__), so the per-epoch padded-table reseed must be
     # driven explicitly — same counter as plain iteration
-    self.loader._begin_epoch()
     # re-evaluate the guard each epoch (a post-construction policy
-    # change must take effect, like the plain loader's epoch start)
+    # change must take effect, like the plain loader's epoch start) —
+    # BEFORE _begin_epoch, so a refused epoch doesn't consume a
+    # padded-table reseed and drift later epochs' windows
     guarded, recompute = self.loader._overflow_epoch_start()
     if recompute:
       raise ValueError(_RECOMPUTE_MSG)
+    self.loader._begin_epoch()
     losses = []
     batch = None
     ovf = jnp.zeros((), bool)   # flags of batches actually trained

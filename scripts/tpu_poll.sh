@@ -2,14 +2,18 @@
 # Poll the axon relay ports (8082 session / 8083 devices) with bare TCP
 # connects — never via jax init, which hangs forever when the relay is
 # down (see PERF.md "TPU-host failure mode").  Appends a line to
-# /root/repo/.tpu_poll.log whenever the state changes.
+# /root/repo/.tpu_poll.log on each state change and EXITS once the
+# relay is up (one-shot recovery watch, not a persistent monitor).
 LOG=/root/repo/.tpu_poll.log
 prev=""
 while true; do
   state="down"
-  if timeout 2 bash -c 'cat < /dev/null > /dev/tcp/127.0.0.1/8083' 2>/dev/null; then
-    state="up"
-  fi
+  for port in 8083 8082; do
+    if timeout 2 bash -c "cat < /dev/null > /dev/tcp/127.0.0.1/$port" 2>/dev/null; then
+      state="up"
+      break
+    fi
+  done
   if [ "$state" != "$prev" ]; then
     echo "$(date -u +%FT%TZ) relay8083=$state" >> "$LOG"
     prev="$state"

@@ -18,6 +18,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLE = os.path.join(REPO, 'examples', 'train_sage_ogbn_products.py')
 
 
+def test_bench_backend_failure_is_structured_json():
+  """A dead axon relay must yield ONE parseable JSON record (rc=0) with
+  an ``error`` field — never a bare traceback (the BENCH_r04 failure).
+  Drives the real ``python bench.py`` __main__ path, forced down
+  deterministically: PALLAS_AXON_POOL_IPS set + GLT_BENCH_RELAY_PORTS
+  pointed at a loopback port that was just bound and closed (nothing
+  listens there even when a real relay is healthy)."""
+  import socket
+  with socket.socket() as s:
+    # bound but NOT listening: connects get ECONNREFUSED for as long as
+    # the socket is held, and no other process can rebind the port — a
+    # race-free 'relay down' for the subprocess's whole lifetime
+    s.bind(('127.0.0.1', 0))
+    dead_port = s.getsockname()[1]
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS='127.0.0.1',
+               GLT_BENCH_RELAY_PORTS=str(dead_port),
+               JAX_PLATFORMS='cpu')
+    out = subprocess.run([sys.executable,
+                          os.path.join(REPO, 'bench.py')],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+  assert out.returncode == 0, out.stderr[-2000:]
+  lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+  assert len(lines) == 1, out.stdout
+  parsed = json.loads(lines[0])
+  assert parsed['metric'] == 'sampled_edges_per_sec'
+  assert parsed['value'] is None and parsed['vs_baseline'] is None
+  assert 'relay' in parsed['error']
+  assert parsed['config']['batch'] == 1024
+
+
 def test_products_staged_npz_path(tmp_path):
   rng = np.random.default_rng(0)
   n, e, ncls, f = 400, 4000, 5, 16
