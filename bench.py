@@ -177,17 +177,24 @@ def _traced_step_ms(jax, run_step, trace_dir, prog_prefix):
 
 
 def _run_hetero_e2e(jax, trace_dir, conv='sage', n_paper=100_000,
-                    n_author=357_041, feat_dim=1024, hb=1024):
+                    n_author=357_041, feat_dim=1024, hb=1024, hops=2,
+                    variant='tree'):
   """IGBH-shaped hetero RGNN train step, device-traced (the reference's
   flagship hetero workload: examples/igbh/train_rgnn.py, IGB-tiny node
   counts 100k papers / 357k authors, 1024-dim features, hidden 128).
-  Config deltas from the reference defaults, stated for honesty: batch
-  1024 x 2 typed hops (the reference runs batch 5120 x 3 hops on
-  DYNAMIC buffers bounded by the 100k-node graph; a static worst-case
-  3-hop plan would exceed the graph itself). Hierarchical (typed
-  trim_to_layer) forward over tree batches.
 
-  Returns (full pipeline ms/step, train-program ms/step).
+  variant='tree' (hb=1024, 2 typed hops): tree_dense typed aggregation
+  over worst-case tree layouts (a static worst-case 3-hop plan would
+  exceed the graph itself — kept for round-over-round continuity).
+  variant='calibrated': per-(hop, etype) calibrated caps
+  (estimate_hetero_frontier_caps) make the REFERENCE shape feasible —
+  batch 5120 x 3 typed hops, the examples/igbh/train_rgnn.py defaults —
+  on exact-dedup merge batches with the dense k-run aggregation
+  (RGNN merge_dense) and the overflow guard active ('warn'; the caller
+  reads loader.check_overflow() at the very end of the bench: one
+  device fetch AFTER every trace is captured, per PERF.md fetch rules).
+
+  Returns (full pipeline ms/step, train-program ms/step, loader).
   """
   import graphlearn_tpu as glt
   import jax.numpy as jnp
@@ -216,20 +223,34 @@ def _run_hetero_e2e(jax, trace_dir, conv='sage', n_paper=100_000,
                                      dtype=np.float32)})
   ds.init_node_labels(
       {'paper': hrng.integers(0, ncls, n_paper)})
-  fan = {CITES: [15, 10], WRITES: [15, 10], REV: [15, 10]}
-  loader = glt.loader.NeighborLoader(
-      ds, fan, ('paper', hrng.integers(0, n_paper, hb * (E2E_ITERS + 5))),
-      batch_size=hb, shuffle=True, drop_last=True, seed=0, dedup='tree')
-  recs, no, eo = glt.sampler.hetero_tree_blocks({'paper': hb},
-                                                tuple(fan), fan)
+  hopfan = [15, 10, 5][:hops]
+  fan = {CITES: hopfan, WRITES: hopfan, REV: hopfan}
+  seeds = ('paper', hrng.integers(0, n_paper, hb * (E2E_ITERS + 5)))
+  if variant == 'calibrated':
+    caps = glt.sampler.estimate_hetero_frontier_caps(
+        ds.graph, fan, {'paper': hb}, num_probes=3, slack=1.5)
+    loader = glt.loader.NeighborLoader(
+        ds, fan, seeds, batch_size=hb, shuffle=True, drop_last=True,
+        seed=0, dedup='merge', frontier_caps=caps,
+        overflow_policy='warn')
+    recs, no, eo = glt.sampler.hetero_tree_blocks(
+        {'paper': hb}, tuple(fan), fan, etype_caps=caps)
+    dense_kw = dict(merge_dense=True, tree_records=recs)
+  else:
+    loader = glt.loader.NeighborLoader(
+        ds, fan, seeds, batch_size=hb, shuffle=True, drop_last=True,
+        seed=0, dedup='tree')
+    recs, no, eo = glt.sampler.hetero_tree_blocks({'paper': hb},
+                                                  tuple(fan), fan)
+    dense_kw = dict(tree_dense=True, tree_records=recs)
   etypes = tuple(glt.typing.reverse_edge_type(et) for et in fan)
-  # tree_dense typed aggregation (round 4) is the flagship hetero path;
+  # dense typed k-run aggregation is the flagship hetero path;
   # heads=4 matches the reference igbh rgat default
   model = RGNN(etypes=etypes, hidden_dim=128, out_dim=ncls, conv=conv,
                heads=(4 if conv == 'gat' else 1),
-               num_layers=2, out_ntype='paper', dtype=jnp.bfloat16,
-               hop_node_offsets=no, hop_edge_offsets=eo,
-               tree_dense=True, tree_records=recs)
+               num_layers=len(hopfan), out_ntype='paper',
+               dtype=jnp.bfloat16, hop_node_offsets=no,
+               hop_edge_offsets=eo, **dense_kw)
   import optax
 
   def bdict(batch):
@@ -265,8 +286,9 @@ def _run_hetero_e2e(jax, trace_dir, conv='sage', n_paper=100_000,
     return loss
 
   params, opt_state, loss = hetero_train_step(params, opt_state, first)
-  return _traced_step_ms(jax, run_step, trace_dir,
-                         'jit_hetero_train_step')
+  tot, tr = _traced_step_ms(jax, run_step, trace_dir,
+                            'jit_hetero_train_step')
+  return tot, tr, loader
 
 
 # v5e peak dense matmul throughput (bf16); MFU below is matmul-FLOPs /
@@ -570,14 +592,44 @@ def main():
   # ---- hetero (IGBH-shaped RGNN/RGAT) train step --------------------
   try:
     for conv, key in (('sage', 'hetero_rgnn'), ('gat', 'hetero_rgat')):
-      tot, tr = _run_hetero_e2e(jax, f'/tmp/glt_bench_hetero_{conv}',
-                                conv=conv)
+      tot, tr, _ = _run_hetero_e2e(jax, f'/tmp/glt_bench_hetero_{conv}',
+                                   conv=conv)
       result[f'{key}_step_ms_bf16'] = (round(float(tot), 3) if tot
                                        else None)
       result[f'{key}_train_program_ms'] = (round(float(tr), 3) if tr
                                            else None)
   except Exception as e:
     result['hetero_step_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- hetero at the REFERENCE shape: batch 5120 x 3 typed hops
+  # (examples/igbh/train_rgnn.py defaults) under calibrated
+  # per-(hop, etype) caps — statically infeasible without them
+  ref_loaders = []
+  try:
+    for conv, key in (('sage', 'hetero_rgnn_ref'),
+                      ('gat', 'hetero_rgat_ref')):
+      tot, tr, ldr = _run_hetero_e2e(
+          jax, f'/tmp/glt_bench_hetero_ref_{conv}', conv=conv, hb=5120,
+          hops=3, variant='calibrated')
+      result[f'{key}_step_ms_bf16'] = (round(float(tot), 3) if tot
+                                       else None)
+      result[f'{key}_train_program_ms'] = (round(float(tr), 3) if tr
+                                           else None)
+      ref_loaders.append(ldr)
+    result['hetero_ref_config'] = ('batch 5120 x 3 hops [15,10,5], '
+                                   'calibrated merge_dense, exact dedup')
+  except Exception as e:
+    result['hetero_ref_error'] = f'{type(e).__name__}: {e}'[:200]
+  # the ONLY device->host fetch in the bench, after every trace is
+  # captured (PERF.md: the first fetch degrades later dispatches).
+  # null (not false) when the ref runs never produced a loader — a
+  # failed run must not read as 'ran clean, no truncation'
+  try:
+    result['hetero_ref_overflow'] = (
+        bool(any(ldr.check_overflow() for ldr in ref_loaders))
+        if ref_loaders else None)
+  except Exception as e:
+    result['hetero_ref_overflow'] = f'{type(e).__name__}'
   print(json.dumps(result))
 
 

@@ -39,12 +39,12 @@ class LinkNeighborLoader(LinkLoader):
           isinstance(edge_label_index[0], (tuple, list)) and
           len(edge_label_index[0]) == 3):
         # hetero dataset, or an (etype, index) pair on LinkLoader's own
-        # tuple convention — fail with the sampler's clear contract, not
-        # an AttributeError inside estimate_frontier_caps
-        raise ValueError('frontier_caps is homogeneous-only (the typed '
-                         'engine plans capacities per edge type; clamp '
-                         'seeds via batch_size / hops via node_budget '
-                         'instead)')
+        # tuple convention — fail clearly, not with an AttributeError
+        # inside estimate_frontier_caps
+        raise ValueError(
+            "frontier_caps='auto' is homogeneous-only; on hetero "
+            'datasets pass the {edge_type: [per-hop caps]} dict from '
+            'calibrate.estimate_hetero_frontier_caps')
       import numpy as np
       from ..sampler.calibrate import (estimate_frontier_caps,
                                        link_seed_width)

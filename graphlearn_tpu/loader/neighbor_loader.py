@@ -32,13 +32,12 @@ class NeighborLoader(NodeLoader):
         raise ValueError(f'frontier_caps={frontier_caps!r}: pass a list '
                          "of per-hop caps or 'auto'")
       if isinstance(data.graph, dict):
-        # same contract the sampler enforces (neighbor_sampler.py) —
         # raised here so 'auto' on a hetero dataset fails clearly, not
         # with an AttributeError inside estimate_frontier_caps
-        raise ValueError('frontier_caps is homogeneous-only (the typed '
-                         'engine plans capacities per edge type; clamp '
-                         'seeds via batch_size / hops via node_budget '
-                         'instead)')
+        raise ValueError(
+            "frontier_caps='auto' is homogeneous-only; on hetero "
+            'datasets pass the {edge_type: [per-hop caps]} dict from '
+            'calibrate.estimate_hetero_frontier_caps')
       from ..sampler.calibrate import estimate_frontier_caps
       pool = (input_nodes[1] if isinstance(input_nodes, tuple)
               else input_nodes)

@@ -126,6 +126,15 @@ class OverflowGuardMixin:
     if policy not in self._OVERFLOW_POLICIES:
       raise ValueError(f'overflow_policy {policy!r} not in '
                        f'{self._OVERFLOW_POLICIES}')
+    if policy == 'recompute' and \
+        getattr(getattr(self, 'sampler', None), 'is_hetero', False):
+      # hetero sampling draws (hop, etype) keys from the sampler's
+      # internal stream — no replayable per-batch key exists, so a
+      # full-caps recompute could not reproduce the truncated draw
+      raise ValueError(
+          "overflow_policy='recompute' is homogeneous-only (hetero "
+          'batches have no replayable per-batch key); use '
+          "'raise'/'warn', or recalibrate with more slack")
     self.overflow_policy = policy
     self.overflow_recomputes = 0   # total full-caps replays ('recompute')
     self._ovf_accum = None         # on-device accumulated flag

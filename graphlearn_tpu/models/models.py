@@ -657,6 +657,17 @@ class TreeHeteroConv(nn.Module):
   by the caller to the hops this layer consumes. ``out_rows``: per-type
   output widths (the NEXT layer's typed prefix; deepest blocks are pure
   child input — the homo out_rows argument, per type).
+
+  ``mode='merge'``: the same dense k-run aggregation over CALIBRATED
+  exact-dedup (merge) hetero batches — records from
+  ``hetero_tree_blocks(etype_caps=...)``. Clamped merge states pack
+  nodes by DYNAMIC valid counts, so nothing is positional: children
+  are gathered through the edge rows and each record's parent run
+  block lands at a dynamically computed base (``min(tgt - j)``, the
+  MergeSAGEConv pattern) via a read-modify-write slice on the
+  accumulator; requires ``edge_index_dict``. Valid runs stay
+  arithmetic because the clamped engine re-compacts per-type frontiers
+  across etype parts each hop.
   """
   out_dim: int
   records: Any                    # tuple of per-hop record tuples
@@ -666,9 +677,15 @@ class TreeHeteroConv(nn.Module):
   concat: bool = True             # gat: concat heads
   dtype: Any = None
   out_rows: Any = None            # {ntype: rows} or None = input widths
+  mode: str = 'tree'              # 'tree' | 'merge'
 
   @nn.compact
-  def __call__(self, x_dict, edge_mask_dict):
+  def __call__(self, x_dict, edge_mask_dict, edge_index_dict=None):
+    assert self.mode in ('tree', 'merge')
+    if self.mode == 'merge':
+      assert edge_index_dict is not None, (
+          "TreeHeteroConv(mode='merge') gathers children through the "
+          'edge rows — pass edge_index_dict')
     if self.dtype is not None:
       x_dict = {t: x.astype(self.dtype) for t, x in x_dict.items()}
     rows = {t: (x.shape[0] if self.out_rows is None
@@ -677,13 +694,116 @@ class TreeHeteroConv(nn.Module):
     etypes = sorted({r['et'] for recs in self.records for r in recs})
     out = {}
     for et in etypes:
-      fn = self._gat_et if self.conv == 'gat' else self._sage_et
-      h = fn(et, x_dict, edge_mask_dict, rows)
+      if self.mode == 'merge':
+        fn = (self._gat_et_merge if self.conv == 'gat'
+              else self._sage_et_merge)
+        h = fn(et, x_dict, edge_mask_dict, rows, edge_index_dict)
+      else:
+        fn = self._gat_et if self.conv == 'gat' else self._sage_et
+        h = fn(et, x_dict, edge_mask_dict, rows)
       if h is None:
         continue
       t, val = h
       out[t] = out.get(t, 0) + val
     return out
+
+  # ------------------------------------------------------- merge mode
+  @staticmethod
+  def _run_layout(r, edge_mask_dict, edge_index_dict, n_out):
+    """(mask [f,k], child rows [f*k], run-target base scalar, run-ok
+    [f]) of record ``r``'s edge segment. The base is dynamic (clamped
+    states pack by valid counts): ``min(tgt - j)`` over valid runs —
+    immune to leading all-masked runs (MergeSAGEConv pattern)."""
+    f, k = r['fcap'], r['k']
+    ei = edge_index_dict[r['out_et']]
+    m = jax.lax.slice_in_dim(edge_mask_dict[r['out_et']], r['edge_base'],
+                             r['edge_base'] + f * k).reshape(f, k)
+    src = jnp.maximum(jax.lax.slice_in_dim(ei[0], r['edge_base'],
+                                           r['edge_base'] + f * k), 0)
+    tgt = jax.lax.slice_in_dim(ei[1], r['edge_base'],
+                               r['edge_base'] + f * k
+                               ).reshape(f, k).max(1)
+    ok = m.any(1) & (tgt >= 0)
+    base = jnp.min(jnp.where(
+        ok, tgt - jnp.arange(f, dtype=tgt.dtype), n_out)).astype(
+            jnp.int32)
+    return m, src, base, ok
+
+  @staticmethod
+  def _acc_add(acc, vals, base):
+    """acc[base:base+f] += vals via read-modify-write slice (records
+    targeting the same type within a hop overlap, so no overwrite)."""
+    f = vals.shape[0]
+    cur = jax.lax.dynamic_slice_in_dim(acc, base, f)
+    return jax.lax.dynamic_update_slice(acc, cur + vals, (base, 0))
+
+  def _sage_et_merge(self, et, x_dict, edge_mask_dict, rows,
+                     edge_index_dict):
+    ename = '__'.join(et)
+    recs = self._et_recs(et, x_dict)
+    if not recs:
+      return None
+    key_t = recs[0]['key_t']
+    n_out = rows[key_t]
+    x_key = x_dict[key_t]
+    agg = jnp.zeros((n_out, x_key.shape[-1]), x_key.dtype)
+    for r in recs:
+      if r['parent_base'] >= n_out:
+        break
+      m, src, base, ok = self._run_layout(r, edge_mask_dict,
+                                          edge_index_dict, n_out)
+      ch = x_dict[r['res_t']][src].reshape(r['fcap'], r['k'], -1)
+      mean = _masked_run_mean(ch, m)
+      agg = self._acc_add(agg, jnp.where(ok[:, None], mean, 0), base)
+    h = nn.Dense(self.out_dim, dtype=self.dtype,
+                 name=f'lin_self_{ename}')(x_key[:n_out])
+    return key_t, h + nn.Dense(self.out_dim, use_bias=False,
+                               dtype=self.dtype,
+                               name=f'lin_nbr_{ename}')(agg)
+
+  def _gat_et_merge(self, et, x_dict, edge_mask_dict, rows,
+                    edge_index_dict):
+    ename = '__'.join(et)
+    recs = self._et_recs(et, x_dict)
+    if not recs:
+      return None
+    key_t, res_ts = recs[0]['key_t'], {r['res_t'] for r in recs}
+    heads, hd = self.heads, self.out_dim
+    a_src = self.param(f'att_src_{ename}',
+                       nn.initializers.glorot_uniform(), (heads, hd))
+    a_dst = self.param(f'att_dst_{ename}',
+                       nn.initializers.glorot_uniform(), (heads, hd))
+    lin = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
+                   name=f'lin_{ename}')
+    w = {t: lin(x_dict[t]) for t in res_ts | {key_t}}
+    alpha_src = {t: jnp.einsum('nhd,hd->nh',
+                               w[t].reshape(-1, heads, hd), a_src,
+                               preferred_element_type=jnp.float32)
+                 for t in res_ts}
+    alpha_dst_key = jnp.einsum('nhd,hd->nh',
+                               w[key_t].reshape(-1, heads, hd), a_dst,
+                               preferred_element_type=jnp.float32)
+    n_out = rows[key_t]
+    acc = jnp.zeros((n_out, heads * hd), w[key_t].dtype)
+    for r in recs:
+      if r['parent_base'] >= n_out:
+        break
+      f, k = r['fcap'], r['k']
+      m, src, base, ok = self._run_layout(r, edge_mask_dict,
+                                          edge_index_dict, n_out)
+      wch = w[r['res_t']][src]
+      a_ch = alpha_src[r['res_t']][src]
+      # parents are arithmetic from the dynamic base (compacted
+      # frontier), so one dynamic slice reads the run alphas
+      a_par = jax.lax.dynamic_slice_in_dim(alpha_dst_key, base, f)
+      e = a_ch.reshape(f, k, heads) + a_par[:, None, :]
+      attn = _masked_run_softmax(e, m, wch.dtype, self.negative_slope)
+      msgs = wch.reshape(f, k, heads, hd)
+      vals = (msgs * attn[..., None]).sum(axis=1).reshape(f, heads * hd)
+      acc = self._acc_add(acc, jnp.where(ok[:, None], vals, 0), base)
+    if not self.concat:
+      acc = acc.reshape(n_out, heads, hd).mean(axis=1)
+    return key_t, acc
 
   def _et_recs(self, et, x_dict):
     """Records for ``et`` whose types exist in this layer's input —
@@ -798,14 +918,20 @@ class RGNN(nn.Module):
   # the message-direction (reversed) types for param parity.
   tree_dense: bool = False
   tree_records: Any = None
+  # merge_dense: the dense k-run aggregation over CALIBRATED exact-dedup
+  # hetero batches (TreeHeteroConv mode='merge') — records AND offsets
+  # must come from hetero_tree_blocks(etype_caps=caps) with the SAME
+  # caps as the loader's frontier_caps dict. Requires dedup='merge'.
+  merge_dense: bool = False
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
     hier = self.hop_node_offsets is not None
-    if self.tree_dense:
+    assert not (self.tree_dense and self.merge_dense)
+    if self.tree_dense or self.merge_dense:
       assert hier and self.tree_records is not None, (
-          'RGNN(tree_dense=True) requires hop offsets + tree_records '
+          'RGNN dense paths require hop offsets + tree_records '
           '(sampler.hetero_tree_blocks)')
     if hier:
       check_hetero_offsets(x_dict, edge_index_dict,
@@ -837,16 +963,18 @@ class RGNN(nn.Module):
             self.hop_node_offsets, self.hop_edge_offsets, hops_used)
       else:
         x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
-      if self.tree_dense:
+      if self.tree_dense or self.merge_dense:
         # output widths: the next layer's typed prefixes (the deepest
         # typed blocks are pure child input — homo out_rows, per type)
         out_rows = {t: self.hop_node_offsets[t][hops_used - 1]
                     for t in x_in}
+        mode = 'merge' if self.merge_dense else 'tree'
         x_dict = TreeHeteroConv(
             conv_dim, records=self.tree_records[:hops_used],
             conv=self.conv, heads=self.heads, concat=True,
-            dtype=self.dtype, out_rows=out_rows,
-            name=f'hetero{i}')(x_in, em)
+            dtype=self.dtype, out_rows=out_rows, mode=mode,
+            name=f'hetero{i}')(x_in, em,
+                               ei if mode == 'merge' else None)
       else:
         convs = {tuple(et): SAGEConv(conv_dim, dtype=self.dtype)
                  if self.conv == 'sage'

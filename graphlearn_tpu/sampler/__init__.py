@@ -3,7 +3,7 @@ from .base import (BaseSampler, EdgeSamplerInput, HeteroSamplerOutput,
                    RemoteNodePathSamplerInput, RemoteSamplerInput,
                    SamplerOutput, SamplingConfig, SamplingType)
 from .calibrate import (check_no_overflow, estimate_frontier_caps,
-                        link_seed_width)
+                        estimate_hetero_frontier_caps, link_seed_width)
 from .negative_sampler import RandomNegativeSampler
 from .neighbor_sampler import (NeighborSampler, hetero_tree_blocks,
                                hetero_tree_layout, tree_layout)

@@ -32,6 +32,31 @@ def _round_up(n: int, m: int) -> int:
   return max(m, ((n + m - 1) // m) * m)
 
 
+def _sim_expand(indptr, indices, frontier, k, rng):
+  """Numpy mirror of ops.uniform_sample over ``frontier``: k draws with
+  replacement for rows with degree > k, keep-all below (keep-all yields
+  MORE distinct neighbors, so simulating it matters for an upper
+  bound). Returns the (non-unique) candidate array."""
+  deg = indptr[frontier + 1] - indptr[frontier]
+  cand = []
+  hi = frontier[deg > k]
+  if hi.size:
+    off = (rng.random((hi.size, k))
+           * (indptr[hi + 1] - indptr[hi])[:, None]).astype(np.int64)
+    cand.append(indices[indptr[hi][:, None] + off].ravel())
+  lo = frontier[(deg > 0) & (deg <= k)]
+  if lo.size:
+    dlo = indptr[lo + 1] - indptr[lo]
+    j = np.arange(k)[None, :]
+    take = j < dlo[:, None]
+    idx = indptr[lo][:, None] + np.minimum(j, np.maximum(
+        dlo[:, None] - 1, 0))
+    cand.append(indices[idx][take])
+  if not cand:
+    return np.empty((0,), np.int64)
+  return np.concatenate(cand)
+
+
 def estimate_frontier_caps(graph, fanouts: Sequence[int], batch_size: int,
                            input_nodes=None, num_probes: int = 8,
                            slack: float = 1.5, seed: int = 0,
@@ -72,26 +97,10 @@ def estimate_frontier_caps(graph, fanouts: Sequence[int], batch_size: int,
     frontier = np.unique(seeds)
     seen = frontier
     for i, k in enumerate(fanouts):
-      deg = indptr[frontier + 1] - indptr[frontier]
-      cand = []
-      hi = frontier[deg > k]
-      if hi.size:
-        # k draws with replacement per high-degree row
-        off = (rng.random((hi.size, k))
-               * (indptr[hi + 1] - indptr[hi])[:, None]).astype(np.int64)
-        cand.append(indices[indptr[hi][:, None] + off].ravel())
-      lo = frontier[(deg > 0) & (deg <= k)]
-      if lo.size:
-        # keep-all rows: every neighbor, via a [rows, k] grid mask
-        dlo = indptr[lo + 1] - indptr[lo]
-        j = np.arange(k)[None, :]
-        take = j < dlo[:, None]
-        idx = indptr[lo][:, None] + np.minimum(j, np.maximum(
-            dlo[:, None] - 1, 0))
-        cand.append(indices[idx][take])
-      if not cand:
+      cand = _sim_expand(indptr, indices, frontier, k, rng)
+      if cand.size == 0:
         break
-      uniq = np.unique(np.concatenate(cand))
+      uniq = np.unique(cand)
       new = uniq[~np.isin(uniq, seen, assume_unique=True)]
       maxima[i] = max(maxima[i], new.size)
       seen = np.union1d(seen, new)
@@ -99,6 +108,98 @@ def estimate_frontier_caps(graph, fanouts: Sequence[int], batch_size: int,
       if frontier.size == 0:
         break
   return [_round_up(int(m * slack), multiple) for m in maxima]
+
+
+def estimate_hetero_frontier_caps(graph, num_neighbors, seed_caps,
+                                  edge_dir: str = 'out', input_nodes=None,
+                                  num_probes: int = 8, slack: float = 1.5,
+                                  seed: int = 0,
+                                  multiple: int = 128) -> dict:
+  """Per-(hop, edge-type) post-dedup calibration for the typed engine.
+
+  The hetero worst-case plan compounds per hop ACROSS edge types
+  (``hetero_capacity_plan``: each hop's frontier is the sum of every
+  contributing etype's full ``fcap * k``), so a reference-shaped config
+  (batch 5120 x 3 typed hops, examples/igbh/train_rgnn.py defaults)
+  statically exceeds the graph itself. Real typed frontiers saturate at
+  the type's population long before that — this probe measures them.
+
+  The simulation mirrors ``_hetero_sample_from_nodes`` exactly:
+  canonical (sorted) intra-hop edge-type order, sequential per-type
+  dedup within a hop (a later etype's candidates dedup against an
+  earlier etype's additions), per-type ``seen`` sets across hops.
+
+  Args:
+    graph: ``{edge_type: data.Graph}`` (the sampler's hetero dict).
+    num_neighbors: per-etype fanout dict or shared list.
+    seed_caps: ``{ntype: batch_cap}`` — the loader's seed widths.
+    edge_dir: 'out' (CSR by src) or 'in' (CSC by dst), as the dataset.
+    input_nodes: optional ``{ntype: seed pool}`` to draw probe seeds
+      from (defaults to each type's full id range).
+    num_probes / slack / seed / multiple: as estimate_frontier_caps.
+
+  Returns ``{edge_type: [per-hop caps]}`` for
+  ``NeighborSampler(frontier_caps=...)`` on a hetero graph — hop h's
+  entry clamps the NEW unique nodes etype ``et`` may add to its result
+  type at hop h (the engine's ``max_new``).
+  """
+  etypes = sorted(tuple(et) for et in graph)
+  fanouts_of = ((lambda et: list(num_neighbors[et]))
+                if isinstance(num_neighbors, dict)
+                else (lambda et: list(num_neighbors)))
+  num_hops = max(len(fanouts_of(et)) for et in etypes)
+  csr = {}
+  for et, g in graph.items():
+    src = getattr(g, 'topo', g)
+    csr[tuple(et)] = (np.asarray(src.indptr), np.asarray(src.indices))
+  rng = np.random.default_rng(seed)
+  maxima = {et: np.zeros(num_hops, np.int64) for et in etypes}
+  for _ in range(num_probes):
+    frontier = {}
+    seen = {}
+    for t, cap in seed_caps.items():
+      pool = None if input_nodes is None else input_nodes.get(t)
+      n_t = None
+      if pool is not None:
+        pool = np.asarray(pool).reshape(-1)
+        seeds = rng.choice(pool, cap)
+      else:
+        # seed id range: the src dimension of any etype keyed by t
+        for et in etypes:
+          key_t = et[0] if edge_dir == 'out' else et[2]
+          if key_t == t:
+            n_t = csr[et][0].shape[0] - 1
+            break
+        if n_t is None:
+          continue
+        seeds = rng.integers(0, n_t, cap)
+      frontier[t] = np.unique(seeds)
+      seen[t] = frontier[t]
+    for hop in range(num_hops):
+      parts = {}
+      for et in etypes:
+        fo = fanouts_of(et)
+        if hop >= len(fo) or fo[hop] == 0:
+          continue
+        key_t = et[0] if edge_dir == 'out' else et[2]
+        res_t = et[2] if edge_dir == 'out' else et[0]
+        f = frontier.get(key_t)
+        if f is None or f.size == 0:
+          continue
+        indptr, indices = csr[et]
+        cand = _sim_expand(indptr, indices, f, fo[hop], rng)
+        if cand.size == 0:
+          continue
+        uniq = np.unique(cand)
+        prev = seen.get(res_t)
+        new = (uniq if prev is None
+               else uniq[~np.isin(uniq, prev, assume_unique=True)])
+        maxima[et][hop] = max(maxima[et][hop], new.size)
+        seen[res_t] = new if prev is None else np.union1d(prev, new)
+        parts.setdefault(res_t, []).append(new)
+      frontier = {t: np.concatenate(v) for t, v in parts.items()}
+  return {et: [_round_up(int(m * slack), multiple) for m in maxima[et]]
+          for et in etypes}
 
 
 def link_seed_width(batch_size: int, neg_sampling=None) -> int:

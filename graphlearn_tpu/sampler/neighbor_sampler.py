@@ -162,13 +162,23 @@ def _tree_node_cap(caps, fanouts) -> int:
   return tree_layout_from_caps(caps, fanouts)[0][-1]
 
 
-def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir):
+def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir,
+                         etype_caps=None):
   """Static hetero buffer plan shared by the typed engine and the
   hierarchical model layout.
 
   Returns ``(ntypes, hop_caps, node_caps)``: ``hop_caps[h]`` maps each
-  edge type active at hop ``h`` to ``(source-frontier capacity, fanout)``;
-  ``node_caps[t]`` is node type ``t``'s total buffer size.
+  edge type active at hop ``h`` to ``(source-frontier capacity, fanout,
+  new-node cap)``; ``node_caps[t]`` is node type ``t``'s total buffer
+  size.
+
+  ``etype_caps`` (``{etype: [per-hop caps]}``,
+  calibrate.estimate_hetero_frontier_caps) clamps the NEW unique nodes
+  each (hop, etype) may contribute — without it the plan compounds
+  worst case across etypes every hop (new-node cap == fcap * k) and a
+  reference-shaped 3-hop config statically exceeds the graph itself.
+  Calibrated plans stay exact while no batch overflows a cap (the typed
+  engine raises the on-device overflow flag when one does).
   """
   # CANONICAL intra-hop order: every consumer of this plan — the typed
   # engines' per-hop expansion loops, hetero_tree_layout, and
@@ -198,8 +208,13 @@ def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir):
       fcap = frontier_cap.get(key_t, 0)
       if fcap == 0 or k == 0:
         continue
-      per_et[et] = (fcap, k)
-      adds[res_t] += fcap * k
+      cap = fcap * k
+      if etype_caps is not None:
+        ec = etype_caps.get(et)
+        if ec is not None and hop < len(ec) and ec[hop] is not None:
+          cap = min(cap, int(ec[hop]))
+      per_et[et] = (fcap, k, cap)
+      adds[res_t] += cap
     hop_caps.append(per_et)
     for t in ntypes:
       frontier_cap[t] = adds[t]
@@ -208,7 +223,8 @@ def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir):
 
 
 def hetero_tree_layout(seed_caps: Dict[NodeType, int], etypes,
-                       num_neighbors, edge_dir: str = 'out'):
+                       num_neighbors, edge_dir: str = 'out',
+                       etype_caps=None):
   """(hop_node_offsets, hop_edge_offsets) of the hetero tree-mode
   positional layout — the typed counterpart of ``tree_layout`` consumed
   by the hierarchical (trim-per-layer) hetero model forward.
@@ -222,13 +238,20 @@ def hetero_tree_layout(seed_caps: Dict[NodeType, int], etypes,
   <= h and ``e_h`` the edge-buffer prefix holding hops 1..h; output edge
   types are reversed from the stored etypes when ``edge_dir='out'``
   (the engine emits message-flow orientation).
+
+  ``etype_caps`` (calibrate.estimate_hetero_frontier_caps) gives the
+  CALIBRATED layout: node prefixes grow by each (hop, etype)'s clamped
+  new-node cap while edge segments keep their ``fcap * k`` emission
+  width — matching the clamped typed engine exactly (fcap itself
+  shrinks because the previous hop's frontier was clamped).
   """
   etypes = [tuple(et) for et in etypes]
   fanouts_of = ((lambda et: list(num_neighbors[et]))
                 if isinstance(num_neighbors, dict)
                 else (lambda et: list(num_neighbors)))
   ntypes, hop_caps, _ = hetero_capacity_plan(etypes, fanouts_of,
-                                             seed_caps, edge_dir)
+                                             seed_caps, edge_dir,
+                                             etype_caps=etype_caps)
   node_offs = {t: [seed_caps.get(t, 0)] for t in ntypes}
   out_ets = [reverse_edge_type(et) if edge_dir == 'out' else et
              for et in etypes]
@@ -237,11 +260,11 @@ def hetero_tree_layout(seed_caps: Dict[NodeType, int], etypes,
   for per_et in hop_caps:
     adds = {t: 0 for t in ntypes}
     seg = {et: 0 for et in out_ets}
-    for et, (fcap, k) in per_et.items():
+    for et, (fcap, k, cap) in per_et.items():
       res_t = et[2] if edge_dir == 'out' else et[0]
       out_et = reverse_edge_type(et) if edge_dir == 'out' else et
-      adds[res_t] += fcap * k
-      seg[out_et] += fcap * k
+      adds[res_t] += cap          # == fcap * k on unclamped plans
+      seg[out_et] += fcap * k     # emission width is never clamped
     for t in ntypes:
       node_offs[t].append(node_offs[t][-1] + adds[t])
     for et in out_ets:
@@ -252,7 +275,8 @@ def hetero_tree_layout(seed_caps: Dict[NodeType, int], etypes,
 
 
 def hetero_tree_blocks(seed_caps: Dict[NodeType, int], etypes,
-                       num_neighbors, edge_dir: str = 'out'):
+                       num_neighbors, edge_dir: str = 'out',
+                       etype_caps=None):
   """Per-(hop, edge-type) dense-aggregation records for typed tree
   batches — the typed counterpart of the homo dense-run layout
   (models.TreeSAGEConv): within hop ``h``, each edge type's children
@@ -263,34 +287,42 @@ def hetero_tree_blocks(seed_caps: Dict[NodeType, int], etypes,
   ``models.TreeHeteroConv``.
 
   Returns ``(records, node_offs, edge_offs)`` with ``records[h]`` a
-  tuple of dicts ``{et, out_et, key_t, res_t, fcap, k, child_base,
+  tuple of dicts ``{et, out_et, key_t, res_t, fcap, k, cap, child_base,
   parent_base, edge_base}`` and node_offs/edge_offs the
   hetero_tree_layout offsets (returned so one call serves both the
   records and the hierarchical model layout — paired calls with
   diverging arguments would silently mis-base the layout).
+
+  With ``etype_caps`` (the calibrated merge layout), ``cap`` is the
+  clamped new-node cap and ``child_base`` is NOT meaningful — clamped
+  merge states pack kept nodes by dynamic valid counts, so the dense
+  merge conv gathers children through the edge rows instead of a
+  positional slice (models.TreeHeteroConv mode='merge').
   """
   etypes = [tuple(et) for et in etypes]
   fanouts_of = ((lambda et: list(num_neighbors[et]))
                 if isinstance(num_neighbors, dict)
                 else (lambda et: list(num_neighbors)))
   ntypes, hop_caps, _ = hetero_capacity_plan(etypes, fanouts_of,
-                                             seed_caps, edge_dir)
+                                             seed_caps, edge_dir,
+                                             etype_caps=etype_caps)
   node_offs, edge_offs = hetero_tree_layout(seed_caps, etypes,
-                                            num_neighbors, edge_dir)
+                                            num_neighbors, edge_dir,
+                                            etype_caps=etype_caps)
   records = []
   for h, per_et in enumerate(hop_caps):
     recs = []
     child_off = {t: node_offs[t][h] for t in ntypes}   # hop-h block start
-    for et, (fcap, k) in per_et.items():
+    for et, (fcap, k, cap) in per_et.items():
       key_t = et[0] if edge_dir == 'out' else et[2]
       res_t = et[2] if edge_dir == 'out' else et[0]
       out_et = reverse_edge_type(et) if edge_dir == 'out' else et
       recs.append(dict(
           et=et, out_et=out_et, key_t=key_t, res_t=res_t, fcap=fcap,
-          k=k, child_base=child_off[res_t],
+          k=k, cap=cap, child_base=child_off[res_t],
           parent_base=0 if h == 0 else node_offs[key_t][h - 1],
           edge_base=(0 if h == 0 else edge_offs[out_et][h - 1])))
-      child_off[res_t] += fcap * k
+      child_off[res_t] += cap
     records.append(tuple(recs))
   return tuple(records), node_offs, edge_offs
 
@@ -433,14 +465,12 @@ class NeighborSampler(BaseSampler):
     self.strategy = strategy
     self.edge_dir = edge_dir
     self.node_budget = node_budget
-    # frontier_caps: per-hop post-dedup frontier capacity clamps — the
-    # calibrated-capacity mechanism (see capacity_plan /
-    # sampler.calibrate). Exact while no batch overflows them.
-    if frontier_caps is not None and isinstance(graph, dict):
-      raise ValueError('frontier_caps is homogeneous-only (the typed '
-                       'engine plans capacities per edge type; clamp '
-                       'seeds via batch_size / hops via node_budget '
-                       'instead)')
+    # frontier_caps: calibrated capacity clamps — per-hop post-dedup
+    # frontier caps on homo graphs (list), per-(hop, edge-type) new-node
+    # caps on hetero graphs (dict, calibrate.estimate_hetero_frontier_
+    # caps). Exact while no batch overflows them; every result carries
+    # an on-device metadata['overflow'] flag (see capacity_plan /
+    # hetero_capacity_plan / sampler.calibrate).
     if frontier_caps is not None and dedup in ('tree', 'none'):
       # tree frontiers are un-deduped (positional, ~fanout-product
       # wide): clamping them with POST-dedup calibrated caps would
@@ -457,8 +487,28 @@ class NeighborSampler(BaseSampler):
       raise ValueError(f'frontier_caps is not supported with the legacy '
                        f'{dedup!r} engine (no overflow detection); use '
                        "dedup='merge'")
-    self.frontier_caps = (tuple(frontier_caps)
-                          if frontier_caps is not None else None)
+    if frontier_caps is None:
+      self.frontier_caps = None
+    elif isinstance(graph, dict):
+      if not isinstance(frontier_caps, dict):
+        raise ValueError(
+            'list-form frontier_caps is homogeneous-only; hetero graphs '
+            'take a {edge_type: [per-hop caps]} dict '
+            '(calibrate.estimate_hetero_frontier_caps)')
+      known = {tuple(et) for et in graph}
+      fc = {}
+      for et, caps in frontier_caps.items():
+        et = tuple(et)
+        if et not in known:
+          raise ValueError(f'frontier_caps edge type {et!r} is not in '
+                           'the graph')
+        fc[et] = tuple(int(c) for c in caps)
+      self.frontier_caps = fc
+    else:
+      if isinstance(frontier_caps, dict):
+        raise ValueError('dict-form frontier_caps is hetero-only; pass '
+                         'a per-hop list on homogeneous graphs')
+      self.frontier_caps = tuple(frontier_caps)
     # fused=True (default) compiles the whole multi-hop sample into one
     # XLA program — one dispatch per batch, and in-program op fusion. The
     # chained path (fused=False) dispatches each per-op kernel from the
@@ -944,9 +994,13 @@ class NeighborSampler(BaseSampler):
 
     # Static per-hop/per-ntype buffer plan — shared with
     # hetero_tree_layout so the hierarchical model forward can never
-    # disagree with the engine's positional layout.
+    # disagree with the engine's positional layout. Calibrated
+    # per-(hop, etype) caps (dict-form frontier_caps) clamp the plan;
+    # 'clamped' gates the max_new threading + overflow flag below.
+    clamped = self.frontier_caps is not None
     ntypes, hop_caps, node_caps = hetero_capacity_plan(
-        etypes, self._etype_fanouts, caps_in, self.edge_dir)
+        etypes, self._etype_fanouts, caps_in, self.edge_dir,
+        etype_caps=self.frontier_caps if clamped else None)
     num_hops = len(hop_caps)
 
     states = {}
@@ -979,12 +1033,13 @@ class NeighborSampler(BaseSampler):
       nodes_per_hop[t].append(states[t].num_nodes if t in states
                               else jnp.asarray(0, jnp.int32))
 
+    overflow = jnp.zeros((), bool)
     for hop in range(num_hops):
       new_parts: Dict[NodeType, list] = {t: [] for t in ntypes}
       items = list(hop_caps[hop].items())
       last_touch = (_final_touch_map(items, self.edge_dir)
                     if hop + 1 == num_hops else {})
-      for j, (et, (fcap, k)) in enumerate(items):
+      for j, (et, (fcap, k, ecap)) in enumerate(items):
         key_t = et[0] if self.edge_dir == 'out' else et[2]
         res_t = et[2] if self.edge_dir == 'out' else et[0]
         out_et = reverse_edge_type(et) if self.edge_dir == 'out' else et
@@ -995,8 +1050,11 @@ class NeighborSampler(BaseSampler):
           states[res_t] = init_empty(node_caps[res_t])
         states[res_t], iout = induce(states[res_t], fidx, hop_out.nbrs,
                                      hop_out.mask, offsets[res_t],
-                                     final=last_touch.get(res_t) == j)
-        offsets[res_t] += fcap * k
+                                     final=last_touch.get(res_t) == j,
+                                     max_new=ecap if clamped else None)
+        # occupancy bound advances by the CLAMPED contribution (== the
+        # full fcap*k width on unclamped plans)
+        offsets[res_t] += ecap
         rows.setdefault(out_et, []).append(iout['cols'])
         cols.setdefault(out_et, []).append(iout['rows'])
         emasks.setdefault(out_et, []).append(iout['edge_mask'])
@@ -1006,9 +1064,13 @@ class NeighborSampler(BaseSampler):
               else jnp.full_like(iout['rows'], -1))
         edges_per_hop.setdefault(out_et, []).append(
             iout['edge_mask'].sum())
-        new_parts[res_t].append((iout['frontier'], iout['frontier_idx'],
-                                 iout['frontier_mask']))
-      # Merge per-type new frontiers; compact so valid entries lead.
+        if clamped and ecap < fcap * k:
+          overflow = overflow | (iout['num_new'] > ecap)
+        new_parts[res_t].append((iout['frontier'][:ecap],
+                                 iout['frontier_idx'][:ecap],
+                                 iout['frontier_mask'][:ecap]))
+      # Merge per-type new frontiers; each part is compact (valid
+      # leading, merge engine contract).
       for t in ntypes:
         parts = new_parts[t]
         if not parts:
@@ -1019,6 +1081,17 @@ class NeighborSampler(BaseSampler):
         fr = jnp.concatenate([p[0] for p in parts])
         fi = jnp.concatenate([p[1] for p in parts])
         fm = jnp.concatenate([p[2] for p in parts])
+        if mode == 'merge' and len(parts) > 1:
+          # cross-part compaction: each part may end in invalid slots;
+          # a stable valid-first sort restores the arithmetic
+          # frontier_idx prefix the dense (k-run) hetero aggregation
+          # relies on (models.TreeHeteroConv mode='merge' computes run
+          # bases as min(tgt - j)). Unconditional for merge batches so
+          # merge_dense is safe with or without calibrated caps. Tiny
+          # sort (frontier width); the valid fi of consecutive parts
+          # are consecutive appends.
+          order = jnp.argsort(~fm, stable=True)
+          fr, fi, fm = fr[order], fi[order], fm[order]
         frontier[t] = (fr, fi, fm)
         nodes_per_hop[t].append(fm.sum().astype(jnp.int32))
 
@@ -1035,7 +1108,7 @@ class NeighborSampler(BaseSampler):
         num_sampled_nodes=nodes_per_hop, num_sampled_edges=edges_per_hop,
         input_type=ntype,
         metadata={'seed_inverse': inv, 'seed_inverse_dict': inv_d,
-                  'seed_mask': smask})
+                  'seed_mask': smask, 'overflow': overflow})
     return out
 
   # ------------------------------------------------------------- link path

@@ -509,3 +509,58 @@ def test_overflow_guard_edges():
   for _ in loader:                             # full clean epoch
     pass                                       # must not raise
   assert loader._ovf_accum is None
+
+
+def test_hetero_loader_calibrated_caps_policies():
+  """Hetero NeighborLoader under dict-form calibrated caps: quiet epoch
+  with calibrated caps under the default raise policy; tiny caps raise
+  at epoch end; 'recompute' is rejected (no replayable hetero key)."""
+  import pytest
+  rng = np.random.default_rng(3)
+  n_p, n_a = 300, 150
+  cites = np.stack([rng.integers(0, n_p, n_p * 5),
+                    rng.integers(0, n_p, n_p * 5)])
+  writes = np.stack([rng.integers(0, n_a, n_a * 3),
+                     rng.integers(0, n_p, n_a * 3)])
+  CITES = ('paper', 'cites', 'paper')
+  WRITES = ('author', 'writes', 'paper')
+  REV = ('paper', 'rev_writes', 'author')
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph({CITES: cites, WRITES: writes, REV: writes[::-1].copy()},
+                graph_mode='CPU',
+                num_nodes={CITES: n_p, WRITES: n_a, REV: n_p})
+  ds.init_node_features(
+      {'paper': rng.standard_normal((n_p, 8)).astype(np.float32),
+       'author': rng.standard_normal((n_a, 8)).astype(np.float32)})
+  ds.init_node_labels({'paper': rng.integers(0, 4, n_p)})
+  fan = [3, 2]
+  caps = glt.sampler.estimate_hetero_frontier_caps(
+      ds.graph, fan, {'paper': 16}, num_probes=6, slack=1.5, multiple=8)
+
+  loader = glt.loader.NeighborLoader(
+      ds, fan, ('paper', np.arange(48)), batch_size=16, shuffle=False,
+      seed=0, dedup='merge', frontier_caps=caps)
+  steps = 0
+  for b in loader:   # default policy='raise' must stay quiet
+    steps += 1
+    assert 'paper' in b.x and b.x['paper'].shape[1] == 8
+  assert steps == 3
+
+  tiny = {et: [1] * len(fan) for et in ds.graph}
+  with pytest.raises(RuntimeError, match='frontier_caps overflowed'):
+    for _ in glt.loader.NeighborLoader(
+        ds, fan, ('paper', np.arange(48)), batch_size=16, shuffle=False,
+        seed=0, dedup='merge', frontier_caps=tiny):
+      pass
+
+  with pytest.warns(UserWarning, match='frontier_caps overflowed'):
+    for _ in glt.loader.NeighborLoader(
+        ds, fan, ('paper', np.arange(48)), batch_size=16, shuffle=False,
+        seed=0, dedup='merge', frontier_caps=tiny,
+        overflow_policy='warn'):
+      pass
+
+  with pytest.raises(ValueError, match='homogeneous-only'):
+    glt.loader.NeighborLoader(
+        ds, fan, ('paper', np.arange(48)), batch_size=16, seed=0,
+        dedup='merge', frontier_caps=tiny, overflow_policy='recompute')

@@ -461,3 +461,145 @@ def test_sampler_invariants_random_graphs(dedup, strategy, padded):
     # masked edge slots must not carry live local indices
     dead = ~em
     assert ((r[dead] == -1) | (c[dead] == -1)).all() or not dead.any()
+
+
+# ---------------- calibrated hetero caps (per-(hop, etype)) ----------------
+
+def make_hetero_medium(n_paper=400, n_author=200, seed=0):
+  """IGBH-shaped typed graph: cites + writes + rev_writes."""
+  rng = np.random.default_rng(seed)
+  cites = np.stack([rng.integers(0, n_paper, n_paper * 6),
+                    rng.integers(0, n_paper, n_paper * 6)])
+  writes = np.stack([rng.integers(0, n_author, n_author * 4),
+                     rng.integers(0, n_paper, n_author * 4)])
+  rev = writes[::-1].copy()
+  mk = lambda ei, n: glt.data.Graph(
+      glt.data.Topology(ei, num_nodes=n), 'CPU')
+  return {('paper', 'cites', 'paper'): mk(cites, n_paper),
+          ('author', 'writes', 'paper'): mk(writes, n_author),
+          ('paper', 'rev_writes', 'author'): mk(rev, n_paper)}
+
+
+def _hetero_adj(graphs):
+  adj = {}
+  for et, g in graphs.items():
+    r, c = g.topo.to_coo()
+    adj[et] = {(int(a), int(b)) for a, b in zip(r, c)}
+  return adj
+
+
+def test_estimate_hetero_frontier_caps_shrinks_plan():
+  """Calibrated per-(hop, etype) caps come in far below the compounding
+  worst case (the reason a reference-shaped 3-hop hetero config is
+  statically infeasible without them)."""
+  from graphlearn_tpu.sampler.neighbor_sampler import hetero_capacity_plan
+  graphs = make_hetero_medium()
+  fan = [3, 2]
+  caps = glt.sampler.estimate_hetero_frontier_caps(
+      graphs, fan, {'paper': 64}, num_probes=4, slack=1.5, multiple=8)
+  assert set(caps) == {tuple(et) for et in graphs}
+  assert all(len(v) == len(fan) for v in caps.values())
+  fo = lambda et: fan
+  ets = list(graphs)
+  _, _, full = hetero_capacity_plan(ets, fo, {'paper': 64}, 'out')
+  _, _, cal = hetero_capacity_plan(ets, fo, {'paper': 64}, 'out',
+                                   etype_caps=caps)
+  # every type's buffer shrinks; the deepest compounding type shrinks a lot
+  assert all(cal[t] <= full[t] for t in full)
+  assert sum(cal.values()) < 0.7 * sum(full.values())
+
+
+def test_hetero_caps_at_worst_case_are_byte_identical():
+  """Caps set exactly to the worst-case widths make the clamped engine a
+  structural no-op: byte-identical output to the uncapped sampler (same
+  shapes, same PRNG stream) — validates the max_new threading."""
+  from graphlearn_tpu.sampler.neighbor_sampler import hetero_capacity_plan
+  graphs = make_hetero_medium()
+  fan = [3, 2]
+  b = 32
+  ets = list(graphs)
+  _, hop_caps, _ = hetero_capacity_plan(ets, lambda et: fan,
+                                        {'paper': b}, 'out')
+  worst = {}
+  for h, per_et in enumerate(hop_caps):
+    for et, (fcap, k, cap) in per_et.items():
+      assert cap == fcap * k
+      worst.setdefault(et, [0] * len(hop_caps))[h] = cap
+  base = glt.sampler.NeighborSampler(graphs, fan, seed=3, dedup='merge')
+  capped = glt.sampler.NeighborSampler(graphs, fan, seed=3, dedup='merge',
+                                       frontier_caps=worst)
+  seeds = np.arange(b)
+  inp = NodeSamplerInput(seeds, input_type='paper')
+  o1 = base.sample_from_nodes(inp)
+  o2 = capped.sample_from_nodes(inp)
+  assert not bool(np.asarray(o2.metadata['overflow']))
+  for t in o1.node:
+    np.testing.assert_array_equal(np.asarray(o1.node[t]),
+                                  np.asarray(o2.node[t]))
+  for et in o1.row:
+    np.testing.assert_array_equal(np.asarray(o1.row[et]),
+                                  np.asarray(o2.row[et]))
+    np.testing.assert_array_equal(np.asarray(o1.edge_mask[et]),
+                                  np.asarray(o2.edge_mask[et]))
+
+
+def test_hetero_calibrated_caps_structure_and_overflow():
+  """Under real calibrated caps: buffers shrink, no overflow at the
+  calibrated batch shape, valid edges decode to real typed edges, node
+  buffers dedup; tiny caps trip the on-device overflow flag."""
+  graphs = make_hetero_medium()
+  adj = _hetero_adj(graphs)
+  fan = [3, 2]
+  b = 32
+  caps = glt.sampler.estimate_hetero_frontier_caps(
+      graphs, fan, {'paper': b}, num_probes=6, slack=1.5, multiple=8)
+  s = glt.sampler.NeighborSampler(graphs, fan, seed=5, dedup='merge',
+                                  frontier_caps=caps)
+  rng = np.random.default_rng(1)
+  for _ in range(3):
+    seeds = rng.integers(0, 400, b)
+    out = s.sample_from_nodes(NodeSamplerInput(seeds, input_type='paper'))
+    assert not bool(np.asarray(out.metadata['overflow']))
+    for t, buf in out.node.items():
+      nn = int(out.num_nodes[t])
+      valid = np.asarray(buf[:nn])
+      assert len(set(valid.tolist())) == nn           # exact dedup
+    for et in out.row:
+      r = np.asarray(out.row[et])
+      c = np.asarray(out.col[et])
+      em = np.asarray(out.edge_mask[et])
+      src_t, dst_t = et[0], et[2]
+      stored = (dst_t, et[1].replace('rev_', ''), src_t) \
+          if et[1].startswith('rev_') else et
+      for j in np.flatnonzero(em)[:50]:
+        u = int(np.asarray(out.node[src_t])[r[j]])
+        v = int(np.asarray(out.node[dst_t])[c[j]])
+        # emitted under message-flow orientation of a stored etype
+        ok = (u, v) in adj.get(et, set()) or \
+            (v, u) in adj.get(stored, set())
+        assert ok, (et, u, v)
+
+  tiny = {et: [1] * len(fan) for et in graphs}
+  s_tiny = glt.sampler.NeighborSampler(graphs, fan, seed=5, dedup='merge',
+                                       frontier_caps=tiny)
+  out = s_tiny.sample_from_nodes(
+      NodeSamplerInput(np.arange(b), input_type='paper'))
+  assert bool(np.asarray(out.metadata['overflow']))
+
+
+def test_hetero_caps_validation():
+  graphs = make_hetero_medium()
+  homo_g, _, _ = make_graph()
+  with pytest.raises(ValueError, match='homogeneous-only'):
+    glt.sampler.NeighborSampler(graphs, [2], dedup='merge',
+                                frontier_caps=[4])
+  with pytest.raises(ValueError, match='hetero-only'):
+    glt.sampler.NeighborSampler(homo_g, [2], dedup='merge',
+                                frontier_caps={('a', 'b', 'c'): [4]})
+  with pytest.raises(ValueError, match='not in'):
+    glt.sampler.NeighborSampler(graphs, [2], dedup='merge',
+                                frontier_caps={('x', 'y', 'z'): [4]})
+  with pytest.raises(ValueError, match='exact-dedup'):
+    glt.sampler.NeighborSampler(
+        graphs, [2], dedup='tree',
+        frontier_caps={('paper', 'cites', 'paper'): [4]})
