@@ -627,7 +627,7 @@ def main():
   try:
     result['hetero_ref_overflow'] = (
         bool(any(ldr.check_overflow() for ldr in ref_loaders))
-        if ref_loaders else None)
+        if len(ref_loaders) == 2 else None)   # both convs, or no verdict
   except Exception as e:
     result['hetero_ref_overflow'] = f'{type(e).__name__}'
   print(json.dumps(result))

@@ -1,0 +1,67 @@
+"""A/B trace of the dense convs' run-mean layout (VERDICT r4 item 8).
+
+PERF.md's byte audit attributes ~3.8 ms copy + ~3.7 ms reshape per
+step to XLA materialization between aggregation stages; the prime
+suspect is the [f*k, F] -> [f, k, F] run view (k = 15/10/5 is never
+tile-aligned, so the 3D view relayouts). models.RUN_MEAN_IMPL toggles
+the kernel: 'reshape' (status quo) vs 'window' (flat-layout
+lax.reduce_window, no 3D view). This script traces the bench train
+step under BOTH impls and prints the per-op-class tables + program
+ms, so one run on the chip decides which lands as default.
+
+Run on TPU: python benchmarks/prof_copytax.py [--variant exact|tree]
+"""
+import argparse
+import shutil
+
+import numpy as np
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--variant', default='exact', choices=['exact', 'tree'])
+  ap.add_argument('--iters', type=int, default=10)
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.models import models as M
+  import bench
+  glt.utils.enable_compilation_cache()
+  bench.E2E_ITERS = args.iters
+
+  graph = bench.build_graph()
+  rng = np.random.default_rng(2)
+  feat = rng.standard_normal((bench.NUM_NODES, bench.E2E_FEAT_DIM),
+                             dtype=np.float32)
+  labels = rng.integers(0, bench.E2E_CLASSES, bench.NUM_NODES)
+  ds = glt.data.Dataset(graph=graph)
+  ds.init_node_features(feat)
+  ds.init_node_labels(labels)
+  train_idx = rng.integers(0, bench.NUM_NODES,
+                           bench.BATCH * (args.iters + 6))
+  cal_caps = None
+  if args.variant == 'exact':
+    cal_caps = glt.sampler.estimate_frontier_caps(
+        graph, bench.FANOUT, bench.BATCH, num_probes=5, slack=1.5)
+
+  for impl in ('reshape', 'window'):
+    M.RUN_MEAN_IMPL = impl
+    td = f'/tmp/glt_prof_copytax_{args.variant}_{impl}'
+    shutil.rmtree(td, ignore_errors=True)
+    tot, tr = bench._run_e2e(ds, train_idx, jnp.bfloat16, jax, td,
+                             variant=args.variant, cal_caps=cal_caps)
+    print(f'\n=== {args.variant} / RUN_MEAN_IMPL={impl}: '
+          f'full {tot} ms, train program {tr} ms ===')
+    for n, (ms, cnt) in glt.utils.device_op_ms(td, top=14,
+                                               steps=args.iters).items():
+      print(f'  {n[:56]:58s} {ms:8.3f} ms x{cnt}')
+
+
+if __name__ == '__main__':
+  import os
+  import sys
+  sys.path.insert(0, os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+  main()

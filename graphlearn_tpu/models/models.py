@@ -106,6 +106,32 @@ def _masked_run_mean(vals, mask):
   return s * inv[:, None]
 
 
+# Run-aggregation implementation for the dense convs' mean kernels.
+# 'reshape' (default): reduce over axis 1 of a [runs, k, F] view — the
+# 3D reshape forces a relayout copy on TPU when k is not tile-aligned
+# (fanouts 15/10/5 never are), part of the measured ~3.7 ms/step
+# reshape tax (PERF.md 'MFU and the roofline'). 'window': keep the flat
+# [runs*k, F] layout and reduce k-runs with lax.reduce_window
+# (window/stride k on the row axis) — no 3D view materialized.
+# Numerically identical (equivalence tests run under both); A/B traced
+# by benchmarks/prof_copytax.py on the chip.
+RUN_MEAN_IMPL = 'reshape'
+
+
+def _masked_flat_run_mean(x, mask, k):
+  """Masked mean over k-runs of a FLAT [f*k, F] block with a [f, k]
+  mask, dispatching on RUN_MEAN_IMPL (see above)."""
+  f = mask.shape[0]
+  if RUN_MEAN_IMPL == 'window':
+    xz = jnp.where(mask.reshape(-1)[:, None], x,
+                   jnp.zeros((), x.dtype))
+    s = jax.lax.reduce_window(xz, jnp.zeros((), x.dtype), jax.lax.add,
+                              (k, 1), (k, 1), 'VALID')
+    inv = (1.0 / jnp.maximum(mask.sum(1), 1)).astype(x.dtype)
+    return s * inv[:, None]
+  return _masked_run_mean(x.reshape(f, k, -1), mask)
+
+
 class TreeSAGEConv(nn.Module):
   """SAGEConv over tree-positional batches, aggregation as DENSE reshape.
 
@@ -146,10 +172,9 @@ class TreeSAGEConv(nn.Module):
       if covered >= r:
         break
       b, k = blocks[d], self.fanouts[d]
-      ch = jax.lax.dynamic_slice_in_dim(x, no[d], blocks[d + 1]
-                                        ).reshape(b, k, x.shape[-1])
+      ch = jax.lax.dynamic_slice_in_dim(x, no[d], blocks[d + 1])
       m = edge_mask[eo[d]:eo[d + 1]].reshape(b, k)
-      aggs.append(_masked_run_mean(ch, m))
+      aggs.append(_masked_flat_run_mean(ch, m, k))
       covered += b
     if covered < r:
       # remaining rows are childless in this slice: aggregate = 0
@@ -214,8 +239,7 @@ class MergeSAGEConv(nn.Module):
       src = jax.lax.dynamic_slice_in_dim(row, e0, width)
       tgt_blk = jax.lax.dynamic_slice_in_dim(col, e0, width).reshape(f, k)
       m = jax.lax.dynamic_slice_in_dim(edge_mask, e0, width).reshape(f, k)
-      msgs = x[jnp.maximum(src, 0)].reshape(f, k, -1)
-      mean = _masked_run_mean(msgs, m)
+      mean = _masked_flat_run_mean(x[jnp.maximum(src, 0)], m, k)
       # the k-run's target local idx (masked slots carry -1: take max)
       tgt = tgt_blk.max(1)
       ok = m.any(1) & (tgt >= 0)
@@ -752,14 +776,9 @@ class TreeHeteroConv(nn.Module):
         break
       m, src, base, ok = self._run_layout(r, edge_mask_dict,
                                           edge_index_dict, n_out)
-      ch = x_dict[r['res_t']][src].reshape(r['fcap'], r['k'], -1)
-      mean = _masked_run_mean(ch, m)
+      mean = _masked_flat_run_mean(x_dict[r['res_t']][src], m, r['k'])
       agg = self._acc_add(agg, jnp.where(ok[:, None], mean, 0), base)
-    h = nn.Dense(self.out_dim, dtype=self.dtype,
-                 name=f'lin_self_{ename}')(x_key[:n_out])
-    return key_t, h + nn.Dense(self.out_dim, use_bias=False,
-                               dtype=self.dtype,
-                               name=f'lin_nbr_{ename}')(agg)
+    return self._sage_out(ename, key_t, x_key, n_out, agg)
 
   def _gat_et_merge(self, et, x_dict, edge_mask_dict, rows,
                     edge_index_dict):
@@ -769,20 +788,8 @@ class TreeHeteroConv(nn.Module):
       return None
     key_t, res_ts = recs[0]['key_t'], {r['res_t'] for r in recs}
     heads, hd = self.heads, self.out_dim
-    a_src = self.param(f'att_src_{ename}',
-                       nn.initializers.glorot_uniform(), (heads, hd))
-    a_dst = self.param(f'att_dst_{ename}',
-                       nn.initializers.glorot_uniform(), (heads, hd))
-    lin = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
-                   name=f'lin_{ename}')
-    w = {t: lin(x_dict[t]) for t in res_ts | {key_t}}
-    alpha_src = {t: jnp.einsum('nhd,hd->nh',
-                               w[t].reshape(-1, heads, hd), a_src,
-                               preferred_element_type=jnp.float32)
-                 for t in res_ts}
-    alpha_dst_key = jnp.einsum('nhd,hd->nh',
-                               w[key_t].reshape(-1, heads, hd), a_dst,
-                               preferred_element_type=jnp.float32)
+    w, alpha_src, alpha_dst_key = self._gat_setup(ename, key_t, res_ts,
+                                                  x_dict)
     n_out = rows[key_t]
     acc = jnp.zeros((n_out, heads * hd), w[key_t].dtype)
     for r in recs:
@@ -821,6 +828,40 @@ class TreeHeteroConv(nn.Module):
   def _resolve(parts, fdim, dtype):
     return resolve_hetero_parts(parts, (fdim,), dtype)
 
+  def _sage_out(self, ename, key_t, x_key, n_rows, agg):
+    """Shared SAGE tail: self projection on the output prefix + the
+    neighbor projection on the aggregated messages (tree and merge
+    paths must stay parameter- and semantics-identical)."""
+    h = nn.Dense(self.out_dim, dtype=self.dtype,
+                 name=f'lin_self_{ename}')(x_key[:n_rows])
+    return key_t, h + nn.Dense(self.out_dim, use_bias=False,
+                               dtype=self.dtype,
+                               name=f'lin_nbr_{ename}')(agg)
+
+  def _gat_setup(self, ename, key_t, res_ts, x_dict):
+    """Shared GAT preamble: per-etype attention params, ONE projection
+    per participating type (flat rows: PERF.md layout rule), and
+    SEPARATE src-/dst-alpha maps — a self-relation (e.g.
+    paper-cites-paper) needs BOTH for the same type: children read
+    a_src, parents read a_dst. Tree and merge paths must share this
+    exactly or the segment-equivalence guarantee diverges."""
+    heads, hd = self.heads, self.out_dim
+    a_src = self.param(f'att_src_{ename}',
+                       nn.initializers.glorot_uniform(), (heads, hd))
+    a_dst = self.param(f'att_dst_{ename}',
+                       nn.initializers.glorot_uniform(), (heads, hd))
+    lin = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
+                   name=f'lin_{ename}')
+    w = {t: lin(x_dict[t]) for t in res_ts | {key_t}}
+    alpha_src = {t: jnp.einsum('nhd,hd->nh',
+                               w[t].reshape(-1, heads, hd), a_src,
+                               preferred_element_type=jnp.float32)
+                 for t in res_ts}
+    alpha_dst_key = jnp.einsum('nhd,hd->nh',
+                               w[key_t].reshape(-1, heads, hd), a_dst,
+                               preferred_element_type=jnp.float32)
+    return w, alpha_src, alpha_dst_key
+
   def _sage_et(self, et, x_dict, edge_mask_dict, rows):
     ename = '__'.join(et)
     recs = self._et_recs(et, x_dict)
@@ -830,17 +871,12 @@ class TreeHeteroConv(nn.Module):
     def per_record(r, m):
       ch = jax.lax.slice_in_dim(x_dict[r['res_t']], r['child_base'],
                                 r['child_base'] + r['fcap'] * r['k'])
-      return _masked_run_mean(
-          ch.reshape(r['fcap'], r['k'], ch.shape[-1]), m)
+      return _masked_flat_run_mean(ch, m, r['k'])
 
     parts, key_t = self._walk(recs, edge_mask_dict, rows, per_record)
     x_key = x_dict[key_t]
     agg_all = self._resolve(parts, x_key.shape[-1], x_key.dtype)
-    h = nn.Dense(self.out_dim, dtype=self.dtype,
-                 name=f'lin_self_{ename}')(x_key[:rows[key_t]])
-    return key_t, h + nn.Dense(self.out_dim, use_bias=False,
-                               dtype=self.dtype,
-                               name=f'lin_nbr_{ename}')(agg_all)
+    return self._sage_out(ename, key_t, x_key, rows[key_t], agg_all)
 
   def _gat_et(self, et, x_dict, edge_mask_dict, rows):
     ename = '__'.join(et)
@@ -849,24 +885,8 @@ class TreeHeteroConv(nn.Module):
       return None
     key_t, res_ts = recs[0]['key_t'], {r['res_t'] for r in recs}
     heads, hd = self.heads, self.out_dim
-    a_src = self.param(f'att_src_{ename}',
-                       nn.initializers.glorot_uniform(), (heads, hd))
-    a_dst = self.param(f'att_dst_{ename}',
-                       nn.initializers.glorot_uniform(), (heads, hd))
-    lin = nn.Dense(heads * hd, use_bias=False, dtype=self.dtype,
-                   name=f'lin_{ename}')
-    # one projection per participating type (flat rows: PERF.md layout
-    # rule); SEPARATE src-/dst-alpha maps — a self-relation (e.g.
-    # paper-cites-paper) needs BOTH for the same type: children read
-    # a_src, parents read a_dst
-    w = {t: lin(x_dict[t]) for t in res_ts | {key_t}}
-    alpha_src = {t: jnp.einsum('nhd,hd->nh',
-                               w[t].reshape(-1, heads, hd), a_src,
-                               preferred_element_type=jnp.float32)
-                 for t in res_ts}
-    alpha_dst_key = jnp.einsum('nhd,hd->nh',
-                               w[key_t].reshape(-1, heads, hd), a_dst,
-                               preferred_element_type=jnp.float32)
+    w, alpha_src, alpha_dst_key = self._gat_setup(ename, key_t, res_ts,
+                                                  x_dict)
 
     def per_record(r, m):
       f, k = r['fcap'], r['k']

@@ -755,9 +755,6 @@ def test_hgt_tree_dense_matches_segment():
                              rtol=2e-4, atol=2e-4)
 
 
-import pytest
-
-
 @pytest.mark.parametrize('use_caps', [True, False])
 def test_merge_dense_hetero_matches_segment(use_caps):
   """TreeHeteroConv(mode='merge') — dense k-run typed aggregation over
@@ -859,3 +856,57 @@ def test_merge_dense_hetero_matches_segment(use_caps):
       nseed = int(np.asarray(b.num_sampled_nodes['paper'])[0])
       np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
                                  rtol=2e-4, atol=2e-4)
+
+
+def test_flat_run_mean_window_impl_matches():
+  """The flat reduce_window run-mean (RUN_MEAN_IMPL='window') is
+  numerically identical to the reshape kernel, at the kernel level and
+  through a full tree_dense forward — so the copy-tax A/B
+  (benchmarks/prof_copytax.py) compares layouts, not semantics."""
+  import jax
+  import jax.numpy as jnp
+  from graphlearn_tpu.models import models as M
+  rng = np.random.default_rng(0)
+  f, k, fd = 37, 5, 16
+  x = rng.standard_normal((f * k, fd)).astype(np.float32)
+  m = rng.random((f, k)) < 0.7
+  ref = np.asarray(M._masked_flat_run_mean(jnp.asarray(x),
+                                           jnp.asarray(m), k))
+  assert M.RUN_MEAN_IMPL == 'reshape'
+  try:
+    M.RUN_MEAN_IMPL = 'window'
+    win = np.asarray(M._masked_flat_run_mean(jnp.asarray(x),
+                                             jnp.asarray(m), k))
+  finally:
+    M.RUN_MEAN_IMPL = 'reshape'
+  np.testing.assert_allclose(ref, win, rtol=1e-6, atol=1e-6)
+
+  # end-to-end: a tree_dense forward under both impls
+  rng = np.random.default_rng(3)
+  n = 150
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rng.integers(0, n, 1200),
+                          rng.integers(0, n, 1200)]),
+                num_nodes=n, graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 8)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, 3, n))
+  loader = glt.loader.NeighborLoader(ds, [3, 2], np.arange(16),
+                                     batch_size=8, seed=0, dedup='tree')
+  b = next(iter(loader))
+  from graphlearn_tpu.models import train as train_lib
+  bd = train_lib.batch_to_dict(b)
+  no, eo = train_lib.tree_hop_offsets(8, [3, 2])
+  model = glt.models.GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2,
+                               hop_node_offsets=no, hop_edge_offsets=eo,
+                               tree_dense=True, fanouts=(3, 2))
+  params = model.init(jax.random.PRNGKey(0), bd['x'], bd['edge_index'],
+                      bd['edge_mask'])
+  o_ref = np.asarray(model.apply(params, bd['x'], bd['edge_index'],
+                                 bd['edge_mask']))
+  try:
+    M.RUN_MEAN_IMPL = 'window'
+    o_win = np.asarray(model.apply(params, bd['x'], bd['edge_index'],
+                                   bd['edge_mask']))
+  finally:
+    M.RUN_MEAN_IMPL = 'reshape'
+  np.testing.assert_allclose(o_ref, o_win, rtol=1e-5, atol=1e-5)
