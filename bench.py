@@ -605,9 +605,9 @@ def main():
   # (examples/igbh/train_rgnn.py defaults) under calibrated
   # per-(hop, etype) caps — statically infeasible without them
   ref_loaders = []
+  ref_convs = (('sage', 'hetero_rgnn_ref'), ('gat', 'hetero_rgat_ref'))
   try:
-    for conv, key in (('sage', 'hetero_rgnn_ref'),
-                      ('gat', 'hetero_rgat_ref')):
+    for conv, key in ref_convs:
       tot, tr, ldr = _run_hetero_e2e(
           jax, f'/tmp/glt_bench_hetero_ref_{conv}', conv=conv, hb=5120,
           hops=3, variant='calibrated')
@@ -627,7 +627,7 @@ def main():
   try:
     result['hetero_ref_overflow'] = (
         bool(any(ldr.check_overflow() for ldr in ref_loaders))
-        if len(ref_loaders) == 2 else None)   # both convs, or no verdict
+        if len(ref_loaders) == len(ref_convs) else None)   # all or null
   except Exception as e:
     result['hetero_ref_overflow'] = f'{type(e).__name__}'
   print(json.dumps(result))

@@ -4,15 +4,17 @@ Runs the products gate (examples/train_sage_ogbn_products.py, now tuned
 to plateau in the discriminative 0.70-0.85 band: p_intra=0.58,
 feat_snr=0.1) under every sampling mode at IDENTICAL budgets, one
 subprocess per mode (clean device state; the XLA compile cache is
-shared), and prints a table for PERF.md.
+shared), and prints a table for PERF.md (shared driver:
+benchmarks/matrix_driver.py).
 
 Run: python benchmarks/accuracy_matrix.py [--num-nodes N] [--epochs E]
 """
 import argparse
-import json
 import os
-import subprocess
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import matrix_driver  # noqa: E402
 
 EXAMPLE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), 'examples', 'train_sage_ogbn_products.py')
@@ -26,33 +28,7 @@ MODES = [
 ]
 
 
-def run_one(args, name, extra, budgets, seed):
-  """ONE training run at the largest budget, evaluated at every budget
-  (--eval-epochs): each (mode, seed) trains once instead of once per
-  budget."""
-  emax = max(budgets)
-  cmd = [sys.executable, EXAMPLE, '--num-nodes', str(args.num_nodes),
-         '--epochs', str(emax),
-         '--eval-epochs', ','.join(str(e) for e in budgets if e < emax),
-         '--eval-batches', str(args.eval_batches),
-         '--seed', str(seed), '--bf16-model'] + extra
-  print(f'# running {name} e{emax} s{seed}', flush=True)
-  out = subprocess.run(cmd, capture_output=True, text=True)
-  line = None
-  for ln in out.stdout.splitlines():
-    if ln.startswith('{'):
-      line = json.loads(ln)
-  if line is None:
-    print(f'# {name} s{seed} FAILED:\n'
-          f'{out.stdout[-2000:]}\n{out.stderr[-2000:]}', flush=True)
-  else:
-    print(f'#   test_acc_at={line["test_acc_at"]} '
-          f'epoch_s={line["epoch_time_s"]}', flush=True)
-  return line
-
-
 def main():
-  import numpy as np
   ap = argparse.ArgumentParser()
   ap.add_argument('--num-nodes', type=int, default=2_449_029)
   ap.add_argument('--epochs-list', default='4,8',
@@ -72,34 +48,20 @@ def main():
   if args.modes:
     keys = args.modes.split(',')
     modes = [(n, e) for n, e in MODES if any(k in n for k in keys)]
+  extra_of = dict(modes)
+  cells = [(n,) for n, _ in modes]
 
-  cells = {}
-  for name, extra in modes:
-    accs = {e: [] for e in budgets}
-    walls = []
-    for seed in range(args.seeds):
-      line = run_one(args, name, extra, budgets, seed)
-      if line is None:
-        continue
-      for e in budgets:
-        a = line['test_acc_at'].get(str(e))
-        if a is not None:
-          accs[e].append(a)
-      walls.append(line['epoch_time_s'])
-    cells[name] = (accs, walls)
+  def cmd_for(cell, seed):
+    emax = max(budgets)
+    return [sys.executable, EXAMPLE, '--num-nodes', str(args.num_nodes),
+            '--epochs', str(emax),
+            '--eval-epochs', ','.join(str(e) for e in budgets
+                                      if e < emax),
+            '--eval-batches', str(args.eval_batches),
+            '--seed', str(seed), '--bf16-model'] + extra_of[cell[0]]
 
-  hdr = ' | '.join(f'{e} epochs (mean+-std, n={args.seeds})'
-                   for e in budgets)
-  print(f'\n| mode | {hdr} | epoch wall s |')
-  print('|---' * (len(budgets) + 2) + '|')
-  for name, _ in modes:
-    accs, walls = cells[name]
-    parts = [(f'{np.mean(accs[e]):.4f} +- {np.std(accs[e]):.4f}'
-              if accs[e] else 'FAILED') for e in budgets]
-    wall = f'{np.mean(walls):.1f}' if walls else '-'
-    print(f'| {name} | ' + ' | '.join(parts) + f' | {wall} |')
-  print(json.dumps({n: {'accs_at': v[0], 'epoch_s': v[1]}
-                    for n, v in cells.items()}))
+  results = matrix_driver.drive(cells, cmd_for, budgets, args.seeds)
+  matrix_driver.report(cells, results, budgets, ('mode',))
 
 
 if __name__ == '__main__':

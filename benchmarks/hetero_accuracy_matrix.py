@@ -13,10 +13,11 @@ Run: python benchmarks/hetero_accuracy_matrix.py [--n-paper N]
      [--epochs-list 4,8] [--seeds 3] [--cells sage/segment,...]
 """
 import argparse
-import json
 import os
-import subprocess
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import matrix_driver  # noqa: E402
 
 EXAMPLE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), 'examples', 'igbh', 'train_rgnn_gate.py')
@@ -33,37 +34,7 @@ CELLS = [
 ]
 
 
-def run_one(args, conv, mode, budgets, seed):
-  emax = max(budgets)
-  cmd = [sys.executable, EXAMPLE, '--conv', conv, '--mode', mode,
-         '--n-paper', str(args.n_paper),
-         '--n-author', str(args.n_paper // 2),
-         '--batch-size', str(args.batch_size),
-         '--epochs', str(emax),
-         '--eval-epochs', ','.join(str(e) for e in budgets if e < emax),
-         '--eval-batches', str(args.eval_batches),
-         '--seed', str(seed), '--bf16-model']
-  if args.fanout:
-    cmd += ['--fanout'] + args.fanout.split(',')
-  if args.extra:
-    cmd += args.extra.split()
-  print(f'# running {conv}/{mode} e{emax} s{seed}', flush=True)
-  out = subprocess.run(cmd, capture_output=True, text=True)
-  line = None
-  for ln in out.stdout.splitlines():
-    if ln.startswith('{'):
-      line = json.loads(ln)
-  if line is None:
-    print(f'# {conv}/{mode} s{seed} FAILED:\n'
-          f'{out.stdout[-2000:]}\n{out.stderr[-2000:]}', flush=True)
-  else:
-    print(f'#   test_acc_at={line["test_acc_at"]} '
-          f'epoch_s={line["epoch_time_s"]}', flush=True)
-  return line
-
-
 def main():
-  import numpy as np
   ap = argparse.ArgumentParser()
   ap.add_argument('--n-paper', type=int, default=100_000)
   ap.add_argument('--batch-size', type=int, default=1024)
@@ -80,38 +51,31 @@ def main():
                        "e.g. '--hidden 64 --feat-dim 32'")
   args = ap.parse_args()
   budgets = sorted(int(x) for x in args.epochs_list.split(','))
-  cells_sel = CELLS
+  cells = CELLS
   if args.cells:
     want = {tuple(c.split('/')) for c in args.cells.split(',')}
-    cells_sel = [c for c in CELLS if c in want]
+    cells = [c for c in CELLS if c in want]
 
-  results = {}
-  for conv, mode in cells_sel:
-    accs = {e: [] for e in budgets}
-    walls = []
-    for seed in range(args.seeds):
-      line = run_one(args, conv, mode, budgets, seed)
-      if line is None:
-        continue
-      for e in budgets:
-        a = line['test_acc_at'].get(str(e))
-        if a is not None:
-          accs[e].append(a)
-      walls.append(line['epoch_time_s'])
-    results[(conv, mode)] = (accs, walls)
+  def cmd_for(cell, seed):
+    conv, mode = cell
+    emax = max(budgets)
+    cmd = [sys.executable, EXAMPLE, '--conv', conv, '--mode', mode,
+           '--n-paper', str(args.n_paper),
+           '--n-author', str(args.n_paper // 2),
+           '--batch-size', str(args.batch_size),
+           '--epochs', str(emax),
+           '--eval-epochs', ','.join(str(e) for e in budgets
+                                     if e < emax),
+           '--eval-batches', str(args.eval_batches),
+           '--seed', str(seed), '--bf16-model']
+    if args.fanout:
+      cmd += ['--fanout'] + args.fanout.split(',')
+    if args.extra:
+      cmd += args.extra.split()
+    return cmd
 
-  hdr = ' | '.join(f'{e} epochs (mean+-std, n={args.seeds})'
-                   for e in budgets)
-  print(f'\n| conv | mode | {hdr} | epoch wall s |')
-  print('|---' * (len(budgets) + 3) + '|')
-  for (conv, mode) in cells_sel:
-    accs, walls = results[(conv, mode)]
-    parts = [(f'{np.mean(accs[e]):.4f} +- {np.std(accs[e]):.4f}'
-              if accs[e] else 'FAILED') for e in budgets]
-    wall = f'{np.mean(walls):.1f}' if walls else '-'
-    print(f'| {conv} | {mode} | ' + ' | '.join(parts) + f' | {wall} |')
-  print(json.dumps({f'{c}/{m}': {'accs_at': v[0], 'epoch_s': v[1]}
-                    for (c, m), v in results.items()}))
+  results = matrix_driver.drive(cells, cmd_for, budgets, args.seeds)
+  matrix_driver.report(cells, results, budgets, ('conv', 'mode'))
 
 
 if __name__ == '__main__':

@@ -1,0 +1,65 @@
+"""Shared subprocess driver for the accuracy matrices (homo
+benchmarks/accuracy_matrix.py and hetero hetero_accuracy_matrix.py):
+one run per (cell, seed) at the largest budget, evaluated at every
+budget via --eval-epochs, mean +- std markdown with the REAL per-cell
+sample size (failed seeds shrink n, never silently inflate it), plus a
+machine-readable JSON dump."""
+import json
+import subprocess
+
+
+def run_cell(cmd, label):
+  """One gate subprocess; returns its JSON line dict or None."""
+  print(f'# running {label}', flush=True)
+  out = subprocess.run(cmd, capture_output=True, text=True)
+  line = None
+  for ln in out.stdout.splitlines():
+    if ln.startswith('{'):
+      line = json.loads(ln)
+  if line is None:
+    print(f'# {label} FAILED:\n'
+          f'{out.stdout[-2000:]}\n{out.stderr[-2000:]}', flush=True)
+  else:
+    print(f'#   test_acc_at={line["test_acc_at"]} '
+          f'epoch_s={line["epoch_time_s"]}', flush=True)
+  return line
+
+
+def drive(cells, cmd_for, budgets, seeds):
+  """{cell: (accs_at{budget: [..]}, walls[..])} over seeds x cells."""
+  results = {}
+  for cell in cells:
+    accs = {e: [] for e in budgets}
+    walls = []
+    for seed in range(seeds):
+      label = '/'.join(str(c) for c in cell) + \
+          f' e{max(budgets)} s{seed}'
+      line = run_cell(cmd_for(cell, seed), label)
+      if line is None:
+        continue
+      for e in budgets:
+        a = line['test_acc_at'].get(str(e))
+        if a is not None:
+          accs[e].append(a)
+      walls.append(line['epoch_time_s'])
+    results[cell] = (accs, walls)
+  return results
+
+
+def report(cells, results, budgets, head_cols):
+  """Markdown table (real n per cell) + one JSON line."""
+  import numpy as np
+  hdr = ' | '.join(f'{e} epochs (mean+-std)' for e in budgets)
+  print(f'\n| {" | ".join(head_cols)} | {hdr} | epoch wall s |')
+  print('|---' * (len(budgets) + len(head_cols) + 1) + '|')
+  for cell in cells:
+    accs, walls = results[cell]
+    parts = [(f'{np.mean(accs[e]):.4f} +- {np.std(accs[e]):.4f} '
+              f'(n={len(accs[e])})' if accs[e] else 'FAILED')
+             for e in budgets]
+    wall = f'{np.mean(walls):.1f}' if walls else '-'
+    lead = ' | '.join(str(c) for c in cell)
+    print(f'| {lead} | ' + ' | '.join(parts) + f' | {wall} |')
+  print(json.dumps({'/'.join(str(c) for c in cell):
+                    {'accs_at': v[0], 'epoch_s': v[1]}
+                    for cell, v in results.items()}))

@@ -1,0 +1,84 @@
+"""Device-trace full-pipeline epoch at REAL products scale (VERDICT r4
+item 5): bench.py's `epoch_time_s` extrapolates device-trace ms/batch
+from the 1M-node bench synthetic x 192 products steps; this script
+measures the SAME pipeline on the 2.45M-node products-matched gate
+graph (examples/train_sage_ogbn_products.py make_synthetic — power-law
+fit, p_intra 0.58) so `epoch_time_s_fullscale` is a measurement, not an
+extrapolation. Traces TRACE_STEPS batches (a full 192-step trace is
+gigabytes); ms/batch x 192 is still a device-trace number at the
+actual scale/degree structure.
+
+Run on TPU: python benchmarks/prof_epoch_fullscale.py
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_products_example():
+  import graphlearn_tpu as glt
+  return glt.utils.load_module(
+      os.path.join(REPO, 'examples', 'train_sage_ogbn_products.py'))
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-nodes', type=int, default=2_449_029)
+  ap.add_argument('--trace-steps', type=int, default=15)
+  ap.add_argument('--batch', type=int, default=None,
+                  help='override bench.BATCH (CPU smoke only)')
+  ap.add_argument('--fanout', type=int, nargs='+', default=None)
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import graphlearn_tpu as glt
+  import bench
+  glt.utils.enable_compilation_cache()
+  bench.E2E_ITERS = args.trace_steps
+  if args.batch:
+    bench.BATCH = args.batch
+  if args.fanout:
+    bench.FANOUT = args.fanout
+
+  ex = _load_products_example()
+  ei, feat, label, train_idx, _, _, ncls = ex.make_synthetic(
+      args.num_nodes, 25, 47, 100, 0.58, 0.1, np.random.default_rng(0))
+  ds = glt.data.Dataset()
+  ds.init_graph(ei, num_nodes=feat.shape[0], graph_mode='HBM')
+  ds.init_node_features(feat)
+  ds.init_node_labels(label)
+  steps_per_epoch = 196_615 // bench.BATCH   # products train split
+  idx = np.random.default_rng(1).permutation(train_idx)[
+      :bench.BATCH * (args.trace_steps + 6)]
+
+  result = {'num_nodes': args.num_nodes, 'trace_steps': args.trace_steps,
+            'steps_per_epoch': steps_per_epoch}
+  cal_caps = glt.sampler.estimate_frontier_caps(
+      ds.graph, bench.FANOUT, bench.BATCH, input_nodes=train_idx,
+      num_probes=5, slack=1.5)
+  result['calibrated_caps'] = cal_caps
+  for variant, kw in (('exact', dict(cal_caps=cal_caps)),
+                      ('tree', {})):
+    tot, tr = bench._run_e2e(ds, idx, jnp.bfloat16, jax,
+                             f'/tmp/glt_fullscale_{variant}',
+                             variant=variant, **kw)
+    if tot is None:
+      result[f'{variant}_error'] = 'no trace events (non-TPU backend?)'
+      continue
+    result[f'{variant}_step_ms'] = round(float(tot), 3)
+    result[f'{variant}_train_program_ms'] = (round(float(tr), 3)
+                                             if tr else None)
+    result[f'epoch_time_s_fullscale_{variant}'] = round(
+        steps_per_epoch * tot / 1e3, 3)
+  print(json.dumps(result), flush=True)
+
+
+if __name__ == '__main__':
+  main()

@@ -35,13 +35,21 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))))
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
 import graphlearn_tpu as glt  # noqa: E402
 
 CITES = ('paper', 'cites', 'paper')
 WRITES = ('author', 'writes', 'paper')
 REV = ('paper', 'rev_writes', 'author')
+
+
+def _products_gate():
+  """The homo gate module — its draw_class_targets is the ONE
+  power-law/searchsorted edge generator both gates share."""
+  return glt.utils.load_module(
+      os.path.join(_REPO, 'examples', 'train_sage_ogbn_products.py'))
 
 
 def powerlaw_weights(n, rng, alpha=1.68, dmax_frac=0.005):
@@ -57,48 +65,21 @@ def powerlaw_weights(n, rng, alpha=1.68, dmax_frac=0.005):
   return target / target.sum()
 
 
-def _draw_targets(rows_comm, comm, w, p_intra, rng):
-  """Power-law-weighted targets, ``p_intra`` of them within the source's
-  class: one searchsorted over class-sorted cumulative weights serves
-  both the intra-class and global draws (the homo gate's scheme)."""
-  n = comm.shape[0]
-  ncls = comm.max() + 1
-  order = np.argsort(comm, kind='stable').astype(np.int32)
-  w_sorted = w[order]
-  cw = np.cumsum(w_sorted)
-  counts = np.bincount(comm, minlength=ncls)
-  offsets = np.zeros(ncls + 1, np.int64)
-  np.cumsum(counts, out=offsets[1:])
-  bounds = np.concatenate([[0.0], cw])[offsets]
-  base, total_c = bounds[:-1], np.diff(bounds)
-
-  e = rows_comm.shape[0]
-  intra = rng.random(e) < p_intra
-  cols = np.empty(e, np.int32)
-  rc = rows_comm[intra]
-  u = rng.random(intra.sum())
-  pos = np.searchsorted(cw, base[rc] + u * total_c[rc], side='right')
-  cols[intra] = order[np.minimum(pos, n - 1)]
-  u2 = rng.random((~intra).sum())
-  pos2 = np.searchsorted(cw, u2 * cw[-1], side='right')
-  cols[~intra] = order[np.minimum(pos2, n - 1)]
-  return cols
-
-
 def make_synthetic(n_paper, n_author, ncls, feat_dim, p_intra, feat_snr,
                    avg_cites, avg_writes, rng):
+  draw_targets = _products_gate().draw_class_targets
   comm_p = rng.integers(0, ncls, n_paper).astype(np.int32)
   comm_a = rng.integers(0, ncls, n_author).astype(np.int32)
   w_p = powerlaw_weights(n_paper, rng)
 
   e_c = n_paper * avg_cites
   c_rows = rng.integers(0, n_paper, e_c).astype(np.int32)
-  c_cols = _draw_targets(comm_p[c_rows], comm_p, w_p, p_intra, rng)
+  c_cols = draw_targets(comm_p[c_rows], comm_p, w_p, p_intra, rng)
   cites = np.stack([c_rows, c_cols])
 
   e_w = n_author * avg_writes
   w_rows = rng.integers(0, n_author, e_w).astype(np.int32)
-  w_cols = _draw_targets(comm_a[w_rows], comm_p, w_p, p_intra, rng)
+  w_cols = draw_targets(comm_a[w_rows], comm_p, w_p, p_intra, rng)
   writes = np.stack([w_rows, w_cols])
 
   # independent bases: papers carry slice A of the class signal,
@@ -250,14 +231,27 @@ def main():
     ok = (logits.argmax(-1) == b['y'][:nl]) & sm
     return ok.sum(), sm.sum()
 
+  import warnings
+  eval_ovf_flags = []   # device scalars / bools; ONE fetch at the end
+
   def run_eval(p):
     correct = total = None
-    for i, batch in enumerate(test_loader):
-      if args.eval_batches and i >= args.eval_batches:
-        break
-      c, t = eval_counts(p, bdict(batch))
-      correct = c if correct is None else correct + c
-      total = t if total is None else total + t
+    # an EXHAUSTED eval pass fires the loader's epoch-end warning and
+    # consumes the flag, so capture warnings too; an early break leaves
+    # the device flag — bank it before the next __iter__ resets it.
+    # Either way truncation in ANY eval pass survives to the verdict.
+    with warnings.catch_warnings(record=True) as wl:
+      warnings.simplefilter('always')
+      for i, batch in enumerate(test_loader):
+        if args.eval_batches and i >= args.eval_batches:
+          break
+        c, t = eval_counts(p, bdict(batch))
+        correct = c if correct is None else correct + c
+        total = t if total is None else total + t
+    if test_loader._ovf_accum is not None:
+      eval_ovf_flags.append(test_loader._ovf_accum)
+    if any('overflowed' in str(w.message) for w in wl):
+      eval_ovf_flags.append(True)
     return correct, total
 
   eval_at = sorted(set(int(x) for x in args.eval_epochs.split(',')
@@ -291,11 +285,12 @@ def main():
   test_acc_at = {e: round(float(c) / max(float(t), 1.0), 4)
                  for e, (c, t) in sorted(evals.items())}
   if caps is not None:
-    # eval loops BREAK early (eval_batches cap), so their verdict must
-    # be fetched explicitly; train epochs report via counted warnings
+    # eval loops BREAK early (eval_batches cap), so their verdicts were
+    # banked per pass; train epochs report via counted warnings
+    eval_ovf = any(bool(np.asarray(f)) for f in eval_ovf_flags)
     print(f'# calibrated-caps overflow: train_epochs='
-          f'{train_ovf_epochs}/{args.epochs} '
-          f'eval={test_loader.check_overflow()}', flush=True)
+          f'{train_ovf_epochs}/{args.epochs} eval={eval_ovf}',
+          flush=True)
   print(json.dumps({
       'conv': args.conv, 'mode': args.mode, 'epochs': args.epochs,
       'steps_per_epoch': len(loader),

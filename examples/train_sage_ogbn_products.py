@@ -99,6 +99,37 @@ def powerlaw_degree_weights(num_nodes, avg_deg, rng):
   return target / target.sum(), alpha, dmax
 
 
+def draw_class_targets(rows_comm, comm, w, p_intra, rng):
+  """Power-law-weighted edge targets over ``comm``'s population,
+  ``p_intra`` of them within the source's class: nodes sorted by class,
+  one searchsorted over the class-ordered cumulative weights serves
+  both the weighted-global and the weighted-within-class draws. Shared
+  by this gate and the hetero gate (examples/igbh/train_rgnn_gate.py) —
+  both gates' claimed 'same dedup/calibration properties' rest on this
+  ONE generator."""
+  n = comm.shape[0]
+  num_classes = int(comm.max()) + 1
+  order = np.argsort(comm, kind='stable').astype(np.int32)
+  cw = np.cumsum(w[order])
+  counts = np.bincount(comm, minlength=num_classes)
+  offsets = np.zeros(num_classes + 1, np.int64)
+  np.cumsum(counts, out=offsets[1:])
+  bounds = np.concatenate([[0.0], cw])[offsets]     # [C+1] cum bounds
+  base, total_c = bounds[:-1], np.diff(bounds)
+
+  e = rows_comm.shape[0]
+  intra = rng.random(e) < p_intra
+  cols = np.empty(e, np.int32)
+  rc = rows_comm[intra]
+  u = rng.random(intra.sum())
+  pos = np.searchsorted(cw, base[rc] + u * total_c[rc], side='right')
+  cols[intra] = order[np.minimum(pos, n - 1)]
+  u2 = rng.random((~intra).sum())
+  pos2 = np.searchsorted(cw, u2 * cw[-1], side='right')
+  cols[~intra] = order[np.minimum(pos2, n - 1)]
+  return cols
+
+
 def make_synthetic(num_nodes, avg_deg, num_classes, feat_dim, p_intra,
                    feat_snr, rng):
   """Products-matched community graph: learnable but not feature-trivial.
@@ -114,30 +145,9 @@ def make_synthetic(num_nodes, avg_deg, num_classes, feat_dim, p_intra,
   """
   comm = rng.integers(0, num_classes, num_nodes).astype(np.int32)
   w, alpha, dmax = powerlaw_degree_weights(num_nodes, avg_deg, rng)
-  # nodes sorted by community; global cumulative weights over that order
-  # let one searchsorted serve both draw kinds (weighted-global and
-  # weighted-within-community)
-  order = np.argsort(comm, kind='stable').astype(np.int32)
-  w_sorted = w[order]
-  cw = np.cumsum(w_sorted)
-  counts = np.bincount(comm, minlength=num_classes)
-  offsets = np.zeros(num_classes + 1, np.int64)
-  np.cumsum(counts, out=offsets[1:])
-  bounds = np.concatenate([[0.0], cw])[offsets]     # [C+1] cum bounds
-  base, total_c = bounds[:-1], np.diff(bounds)
-
   e = num_nodes * avg_deg
   rows = rng.integers(0, num_nodes, e).astype(np.int32)
-  intra = rng.random(e) < p_intra
-  cols = np.empty(e, np.int32)
-  rc = comm[rows[intra]]
-  u = rng.random(intra.sum())
-  # weighted draw within the row's community
-  pos = np.searchsorted(cw, base[rc] + u * total_c[rc], side='right')
-  cols[intra] = order[np.minimum(pos, num_nodes - 1)]
-  u2 = rng.random((~intra).sum())
-  pos2 = np.searchsorted(cw, u2 * cw[-1], side='right')
-  cols[~intra] = order[np.minimum(pos2, num_nodes - 1)]
+  cols = draw_class_targets(comm[rows], comm, w, p_intra, rng)
 
   # show the match: realized in-degree stats vs the fitted model
   indeg = np.bincount(cols, minlength=num_nodes)

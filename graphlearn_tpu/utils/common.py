@@ -37,3 +37,18 @@ def get_free_port(host: str = 'localhost') -> int:
     return s.getsockname()[1]
   finally:
     s.close()
+
+
+def load_module(path, name=None):
+  """Import a source FILE as a module object (reference-free analog of
+  torch.hub-style script reuse): examples and benchmarks share helpers
+  from sibling scripts (e.g. the products gate's draw_class_targets /
+  make_synthetic) without packaging example code into the library."""
+  import importlib.util
+  import os
+  name = name or '_glt_mod_' + \
+      os.path.splitext(os.path.basename(path))[0]
+  spec = importlib.util.spec_from_file_location(name, path)
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod

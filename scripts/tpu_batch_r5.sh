@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 TPU measurement batch — run the moment the axon relay is back
+# (scripts/tpu_poll.sh exits when 127.0.0.1:8083/8082 accepts).
+# Ordered by value-per-chip-minute; each stage logs to /tmp/r5_*.log and
+# keeps going if an earlier stage fails. Findings land in PERF.md.
+#
+#   nohup bash scripts/tpu_batch_r5.sh > /tmp/r5_batch.log 2>&1 &
+set -x
+cd /root/repo
+
+# 1. BENCH_r05 dry run: verifies every round-4 on-chip claim (exact
+#    epoch 2.40s, train programs 6.97/4.81ms) + first numbers for the
+#    dense hetero path and the reference-shape calibrated hetero keys.
+timeout 3900 python bench.py > /tmp/r5_bench.json 2> /tmp/r5_bench.err
+
+# 2. Copy/reshape tax A/B: decides models.RUN_MEAN_IMPL default
+#    (VERDICT item 8) — exact first (the headline path), then tree.
+timeout 1800 python benchmarks/prof_copytax.py --variant exact \
+    > /tmp/r5_copytax_exact.log 2>&1
+timeout 1800 python benchmarks/prof_copytax.py --variant tree \
+    > /tmp/r5_copytax_tree.log 2>&1
+
+# 3. Padded accuracy-matrix cells (VERDICT item 2): the missing
+#    padded16 seeds + all padded64 seeds on the ON-DEVICE rebuild
+#    (ops/neighbor.py:233; the 90s/epoch host rebuild is gone —
+#    the run logs quote the per-epoch reseed cost).
+timeout 14400 python benchmarks/accuracy_matrix.py \
+    --modes padded16 --epochs-list 4,8 --seeds 3 \
+    > /tmp/r5_matrix_padded16.log 2>&1
+timeout 14400 python benchmarks/accuracy_matrix.py \
+    --modes padded64 --epochs-list 4,8 --seeds 3 \
+    > /tmp/r5_matrix_padded64.log 2>&1
+
+# 4. Device-trace epoch at REAL products scale (VERDICT item 4):
+#    epoch_time_s_fullscale from a 2.45M-node trace, exact + tree.
+timeout 3600 python benchmarks/prof_epoch_fullscale.py \
+    > /tmp/r5_fullscale.log 2>&1
+
+# 5. Papers100M-scale capability (VERDICT item 7): features exceed HBM,
+#    hot/cold split — measured hit rate + step time at 10M x 128.
+timeout 3600 python examples/train_sage_papers_scale.py \
+    > /tmp/r5_papers_scale.log 2>&1
+
+# 6. Reference-shape hetero at IGB-full author count (already in bench;
+#    this repeats it solo for a clean trace if stage 1 was tight).
+timeout 1800 python - > /tmp/r5_hetero_ref.log 2>&1 <<'EOF'
+import jax, bench
+for conv in ('sage', 'gat'):
+    tot, tr, ldr = bench._run_hetero_e2e(
+        jax, f'/tmp/r5_hetero_ref_{conv}', conv=conv, hb=5120, hops=3,
+        variant='calibrated')
+    print(conv, 'full', tot, 'train', tr, 'overflow', ldr.check_overflow(),
+          flush=True)
+EOF
+
+echo BATCH DONE
