@@ -31,6 +31,7 @@ CELLS = [
     ('gat', 'merge_dense'),
     ('hgt', 'segment'),
     ('hgt', 'tree_dense'),
+    ('hgt', 'merge_dense'),
 ]
 
 

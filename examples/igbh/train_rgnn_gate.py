@@ -180,15 +180,13 @@ def main():
   rev_et = tuple(glt.typing.reverse_edge_type(et) for et in fan)
   depth = len(args.fanout)
   if args.conv == 'hgt':
-    if args.mode == 'merge_dense':
-      raise SystemExit('HGT merge_dense is not implemented; use '
-                       'segment or tree_dense')
     model = glt.models.HGT(
         ntypes=('paper', 'author'), etypes=rev_et,
         hidden_dim=args.hidden, out_dim=ncls, heads=args.heads,
         num_layers=depth, out_ntype='paper', dtype=mdtype,
         hop_node_offsets=no, hop_edge_offsets=eo,
-        tree_records=recs if args.mode == 'tree_dense' else None)
+        tree_records=recs if args.mode != 'segment' else None,
+        merge_dense=args.mode == 'merge_dense')
   else:
     model = glt.models.RGNN(
         etypes=rev_et, hidden_dim=args.hidden, out_dim=ncls,

@@ -413,19 +413,39 @@ class DistNeighborSampler:
       if isinstance(frontier_caps, str):
         raise ValueError(
             f'frontier_caps={frontier_caps!r}: the distributed engine '
-            'takes an explicit per-hop caps list — calibrate on the '
-            'host CSR with sampler.calibrate.estimate_frontier_caps '
-            "(batch_size = the PER-SHARD seed width); 'auto' exists on "
-            'the local loaders only')
-      if self.is_hetero:
-        raise ValueError('frontier_caps is homogeneous-only (the typed '
-                         'engine plans capacities per edge type)')
+            'takes explicit caps — calibrate on the host CSR with '
+            'sampler.calibrate.estimate_frontier_caps (homo list; '
+            'batch_size = the PER-SHARD seed width) or '
+            'estimate_hetero_frontier_caps (hetero dict); '
+            "'auto' exists on the local loaders only")
       if self.dedup == 'tree':
         raise ValueError('frontier_caps requires an exact-dedup mode '
                          "('sort'/'map'/'merge'); tree frontiers are "
                          'positional, use node_budget there')
-    self.frontier_caps = (tuple(frontier_caps)
-                          if frontier_caps is not None else None)
+    if frontier_caps is None:
+      self.frontier_caps = None
+    elif self.is_hetero:
+      if not isinstance(frontier_caps, dict):
+        raise ValueError(
+            'list-form frontier_caps is homogeneous-only; hetero graphs '
+            'take a {edge_type: [per-hop caps]} dict '
+            '(calibrate.estimate_hetero_frontier_caps, per-SHARD seed '
+            'width)')
+      known = {tuple(et) for et in dist_graph.etypes}
+      fc = {}
+      for et, caps in frontier_caps.items():
+        et = tuple(et)
+        if et not in known:
+          raise ValueError(f'frontier_caps edge type {et!r} is not in '
+                           'the graph')
+        # None = no clamp at that hop (the plan skips it)
+        fc[et] = tuple(None if c is None else int(c) for c in caps)
+      self.frontier_caps = fc
+    else:
+      if isinstance(frontier_caps, dict):
+        raise ValueError('dict-form frontier_caps is hetero-only; pass '
+                         'a per-hop list on homogeneous graphs')
+      self.frontier_caps = tuple(frontier_caps)
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     # every-axis collectives: ('g',) on the flat mesh, or
     # ('slice', 'chip') on a 2-axis multi-slice mesh (init_multihost
@@ -535,8 +555,12 @@ class DistNeighborSampler:
   def _hetero_plan(self, seed_widths: Dict):
     """Static per-hop capacity schedule (mirror of the single-machine
     sampler's plan, sampler/neighbor_sampler.py hetero path), generalized
-    to multi-type seed sets (link sampling seeds both endpoint types)."""
+    to multi-type seed sets (link sampling seeds both endpoint types).
+    Dict-form frontier_caps clamp each (hop, etype)'s new-node
+    contribution exactly like the local plan's etype_caps — hop entries
+    become ``(fcap, k, cap)`` with cap == fcap*k when unclamped."""
     g = self.graph
+    etype_caps = self.frontier_caps if self.is_hetero else None
     # canonical intra-hop order (see hetero_capacity_plan): the layout
     # helpers sort, so the engine's plan must sort identically
     etypes = sorted(tuple(et) for et in g.etypes)
@@ -562,8 +586,13 @@ class DistNeighborSampler:
           continue
         if self.node_budget is not None:
           fcap = min(fcap, self.node_budget)
-        per_et[et] = (fcap, fo[hop])
-        adds[res_t] += fcap * fo[hop]
+        cap = fcap * fo[hop]
+        if etype_caps is not None:
+          ec = etype_caps.get(et)
+          if ec is not None and hop < len(ec) and ec[hop] is not None:
+            cap = min(cap, int(ec[hop]))
+        per_et[et] = (fcap, fo[hop], cap)
+        adds[res_t] += cap
       hop_caps.append(per_et)
       for t in ntypes:
         frontier_cap[t] = adds[t]
@@ -861,13 +890,17 @@ class DistNeighborSampler:
     edges_per_hop = {}
     keys = jax.random.split(key, max(1, num_hops * max(1, len(etypes))))
     ki = 0
+    # calibrated dict caps (hetero clamps): overflow is tracked on
+    # device and psum'd below so every shard reports the SAME verdict
+    clamped = self.is_hetero and self.frontier_caps is not None
+    overflow = jnp.zeros((), bool)
     for hop in range(num_hops):
       new_parts = {t: [] for t in ntypes}
       items = list(hop_caps[hop].items())
       from ..sampler.neighbor_sampler import _final_touch_map
       last_touch = (_final_touch_map(items, edge_dir)
                     if hop + 1 == num_hops else {})
-      for j, (et, (fcap, k)) in enumerate(items):
+      for j, (et, (fcap, k, ecap)) in enumerate(items):
         key_t = et[0] if edge_dir == 'out' else et[2]
         res_t = et[2] if edge_dir == 'out' else et[0]
         out_et = out_et_of[et]
@@ -882,8 +915,10 @@ class DistNeighborSampler:
         ki += 1
         states[res_t], iout = induce(states[res_t], fidx, nbrs, m,
                                      offsets[res_t],
-                                     final=last_touch.get(res_t) == j)
-        offsets[res_t] += fcap * k
+                                     final=last_touch.get(res_t) == j,
+                                     max_new=ecap if clamped else None)
+        # occupancy bound advances by the CLAMPED contribution
+        offsets[res_t] += ecap
         rows.setdefault(out_et, []).append(iout['cols'])
         cols.setdefault(out_et, []).append(iout['rows'])
         emasks.setdefault(out_et, []).append(iout['edge_mask'])
@@ -892,8 +927,11 @@ class DistNeighborSampler:
               jnp.where(iout['edge_mask'], e.reshape(-1), -1))
         edges_per_hop.setdefault(out_et, []).append(
             iout['edge_mask'].sum())
-        new_parts[res_t].append((iout['frontier'], iout['frontier_idx'],
-                                 iout['frontier_mask']))
+        if clamped and ecap < fcap * k:
+          overflow = overflow | (iout['num_new'] > ecap)
+        new_parts[res_t].append((iout['frontier'][:ecap],
+                                 iout['frontier_idx'][:ecap],
+                                 iout['frontier_mask'][:ecap]))
       for t in ntypes:
         parts = new_parts[t]
         if not parts:
@@ -902,12 +940,21 @@ class DistNeighborSampler:
                          jnp.zeros((0,), bool))
           nodes_per_hop[t].append(jnp.asarray(0, jnp.int32))
           continue
-        frontier[t] = (jnp.concatenate([p[0] for p in parts]),
-                       jnp.concatenate([p[1] for p in parts]),
-                       jnp.concatenate([p[2] for p in parts]))
-        nodes_per_hop[t].append(frontier[t][2].sum().astype(jnp.int32))
+        fr = jnp.concatenate([p[0] for p in parts])
+        fi = jnp.concatenate([p[1] for p in parts])
+        fm = jnp.concatenate([p[2] for p in parts])
+        if self.dedup == 'merge' and len(parts) > 1:
+          # cross-part compaction, as the local typed engine: restores
+          # the arithmetic frontier_idx prefix under clamps
+          order = jnp.argsort(~fm, stable=True)
+          fr, fi, fm = fr[order], fi[order], fm[order]
+        frontier[t] = (fr, fi, fm)
+        nodes_per_hop[t].append(fm.sum().astype(jnp.int32))
 
+    # replicated verdict: every shard must agree (uniform collectives)
+    overflow = jax.lax.psum(overflow.astype(jnp.int32), self._axes) > 0
     res = dict(
+        overflow=overflow,
         node={t: s.nodes for t, s in states.items()},
         num_nodes={t: s.num_nodes for t, s in states.items()},
         row={et: jnp.concatenate(v) for et, v in rows.items()},
@@ -939,7 +986,7 @@ class DistNeighborSampler:
         node={t: P(ax) for t in g.ntypes if node_caps[t] > 0},
         num_nodes={t: P(ax) for t in g.ntypes if node_caps[t] > 0},
         row={}, col={}, edge_mask={}, num_sampled_nodes={},
-        num_sampled_edges={})
+        num_sampled_edges={}, overflow=P(ax))
     for oet in touched:
       for k in ('row', 'col', 'edge_mask', 'num_sampled_edges'):
         out_specs[k][oet] = P(ax)
@@ -1134,7 +1181,8 @@ class DistNeighborSampler:
         num_sampled_edges=res['num_sampled_edges'],
         input_type=input_ntype,
         metadata={'seed_inverse': res['seed_inverse'],
-                  'seed_mask': jnp.asarray(smask)})
+                  'seed_mask': jnp.asarray(smask),
+                  'overflow': res['overflow']})
 
   # ------------------------------------------------------------ public API
 
@@ -1232,7 +1280,9 @@ class DistNeighborSampler:
           batch=None, batch_size=b,
           num_sampled_nodes=res['num_sampled_nodes'],
           num_sampled_edges=res['num_sampled_edges'],
-          input_type=etype, metadata={'seed_mask': jnp.asarray(smask)})
+          input_type=etype,
+          metadata={'seed_mask': jnp.asarray(smask),
+                    'overflow': res['overflow']})
     else:
       sig = ('link', b, num_neg, mode)
       if sig not in self._fns:

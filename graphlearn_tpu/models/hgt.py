@@ -54,6 +54,12 @@ class HGTConv(nn.Module):
   # output prefix widths (the consumer's typed prefixes).
   tree_records: Any = None
   out_rows: Any = None
+  # merge=True: the records came from a CALIBRATED exact-dedup layout
+  # (hetero_tree_blocks(etype_caps=...)): children are gathered through
+  # the edge rows and run blocks land at dynamic bases, exactly like
+  # models.TreeHeteroConv mode='merge' (clamped merge states pack by
+  # dynamic valid counts — nothing is positional).
+  merge: bool = False
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict):
@@ -118,9 +124,10 @@ class HGTConv(nn.Module):
       v_rel = jnp.einsum('nhd,hde->nhe', v[src_t],
                          m_rel.astype(v[src_t].dtype))
       if dense:
-        agg[dst_t] = agg[dst_t] + self._dense_et(
-            et, k_rel, v_rel, q[dst_t], p_rel, edge_mask_dict,
-            rows_out[dst_t], heads, d, cdtype)
+        fn = self._merge_et if self.merge else self._dense_et
+        agg[dst_t] = agg[dst_t] + fn(
+            et, k_rel, v_rel, q[dst_t], p_rel, edge_index_dict,
+            edge_mask_dict, rows_out[dst_t], heads, d, cdtype)
         continue
       ei = edge_index_dict[et]
       em = edge_mask_dict[et]
@@ -163,39 +170,77 @@ class HGTConv(nn.Module):
         out[t] = a
     return out
 
-  def _dense_et(self, et, k_rel, v_rel, q_dst, p_rel, edge_mask_dict,
-                r_out, heads, d, cdtype):
+  @staticmethod
+  def _run_attention(kc, vc, qp, m, p_rel, d, cdtype):
+    """Masked k-run typed attention shared by the tree and merge dense
+    paths: [f,k,H,D] keys/values vs [f,H,D] parent queries -> [f,H,D]
+    (f32 logits, same stabilization as the segment softmax)."""
+    logits = (qp[:, None].astype(jnp.float32) *
+              kc.astype(jnp.float32)).sum(-1)
+    logits = logits * p_rel[None, None, :] / math.sqrt(d)    # [f, k, H]
+    logits = jnp.where(m[..., None], logits, -jnp.inf)
+    mx = logits.max(axis=1, keepdims=True)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    ex = jnp.where(m[..., None], jnp.exp(logits - mx), 0.0)
+    denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-9)
+    attn = (ex / denom).astype(cdtype)
+    return (vc * attn[..., None]).sum(axis=1)               # [f, H, D]
+
+  def _et_records(self, et):
+    return [r for hop in self.tree_records for r in hop
+            if r['out_et'] == tuple(et)]
+
+  def _dense_et(self, et, k_rel, v_rel, q_dst, p_rel, edge_index_dict,
+                edge_mask_dict, r_out, heads, d, cdtype):
     """Dense k-run attention for one etype over tree records: a
     parent's in-edges per etype are its contiguous k-run, so the
-    per-destination softmax is a masked run softmax (f32, same
-    stabilization as the segment path)."""
+    per-destination softmax is a masked run softmax."""
+    del edge_index_dict   # positional layout: children via child_base
     from .models import resolve_hetero_parts, walk_hetero_records
-    recs = [r for hop in self.tree_records for r in hop
-            if r['out_et'] == tuple(et)]
+    recs = self._et_records(et)
 
     def per_record(r, m):
       f, kk = r['fcap'], r['k']
       kc = jax.lax.slice_in_dim(k_rel, r['child_base'],
                                 r['child_base'] + f * kk
                                 ).reshape(f, kk, heads, d)
-      qp = jax.lax.slice_in_dim(q_dst, r['parent_base'],
-                                r['parent_base'] + f)
-      logits = (qp[:, None].astype(jnp.float32) *
-                kc.astype(jnp.float32)).sum(-1)
-      logits = logits * p_rel[None, None, :] / math.sqrt(d)  # [f, k, H]
-      logits = jnp.where(m[..., None], logits, -jnp.inf)
-      mx = logits.max(axis=1, keepdims=True)
-      mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
-      ex = jnp.where(m[..., None], jnp.exp(logits - mx), 0.0)
-      denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-9)
-      attn = (ex / denom).astype(cdtype)
       vc = jax.lax.slice_in_dim(v_rel, r['child_base'],
                                 r['child_base'] + f * kk
                                 ).reshape(f, kk, heads, d)
-      return (vc * attn[..., None]).sum(axis=1)           # [f, H, D]
+      qp = jax.lax.slice_in_dim(q_dst, r['parent_base'],
+                                r['parent_base'] + f)
+      return self._run_attention(kc, vc, qp, m, p_rel, d, cdtype)
 
     parts = walk_hetero_records(recs, edge_mask_dict, r_out, per_record)
     return resolve_hetero_parts(parts, (heads, d), cdtype)
+
+  def _merge_et(self, et, k_rel, v_rel, q_dst, p_rel, edge_index_dict,
+                edge_mask_dict, r_out, heads, d, cdtype):
+    """Dense k-run attention over CALIBRATED merge records: children
+    gathered through the edge rows (FLAT 2D gathers — PERF.md layout
+    rule — then reshaped), parent queries dynamic-sliced at the run
+    base, run blocks accumulated read-modify-write (TreeHeteroConv
+    mode='merge' machinery)."""
+    from .models import TreeHeteroConv
+    recs = self._et_records(et)
+    acc = jnp.zeros((r_out, heads, d), cdtype)
+    kf = k_rel.reshape(-1, heads * d)
+    vf = v_rel.reshape(-1, heads * d)
+    for r in recs:
+      if r['parent_base'] >= r_out:
+        break
+      f, kk = r['fcap'], r['k']
+      m, src, base, ok = TreeHeteroConv._run_layout(
+          r, edge_mask_dict, edge_index_dict, r_out)
+      kc = kf[src].reshape(f, kk, heads, d)
+      vc = vf[src].reshape(f, kk, heads, d)
+      qp = jax.lax.dynamic_slice_in_dim(q_dst, base, f)
+      vals = self._run_attention(kc, vc, qp, m, p_rel, d, cdtype)
+      vals = jnp.where(ok[:, None, None], vals,
+                       jnp.zeros((), vals.dtype))
+      cur = jax.lax.dynamic_slice_in_dim(acc, base, f)
+      acc = jax.lax.dynamic_update_slice(acc, cur + vals, (base, 0, 0))
+    return acc
 
 
 class HGT(nn.Module):
@@ -223,6 +268,10 @@ class HGT(nn.Module):
   # attention per layer (see HGTConv.tree_records) with per-type
   # out_rows prefix outputs — requires the hierarchical offsets.
   tree_records: Any = None
+  # merge_dense: tree_records/offsets came from a calibrated merge
+  # layout (hetero_tree_blocks(etype_caps=...)) — dense attention on
+  # clamped exact-dedup batches (HGTConv merge=True); dedup='merge'.
+  merge_dense: bool = False
   # per-type RAW feature widths: when given, the input Dense lin_{t} is
   # materialized for every ntype even if absent from the init batch, so
   # the param tree never depends on batch content (see HGTConv.in_dims)
@@ -268,7 +317,8 @@ class HGT(nn.Module):
                     for t in x_in}
       x_dict = HGTConv(self.hidden_dim, meta, heads=self.heads,
                        dtype=self.dtype, tree_records=recs,
-                       out_rows=out_rows, name=f'conv{i}')(x_in, ei, em)
+                       out_rows=out_rows, merge=self.merge_dense,
+                       name=f'conv{i}')(x_in, ei, em)
     head = nn.Dense(self.out_dim, dtype=self.dtype, name='head')
     if self.out_ntype is None:
       return {t: head(x) for t, x in x_dict.items()}

@@ -502,7 +502,8 @@ class NeighborSampler(BaseSampler):
         if et not in known:
           raise ValueError(f'frontier_caps edge type {et!r} is not in '
                            'the graph')
-        fc[et] = tuple(int(c) for c in caps)
+        # None = no clamp at that hop (the plan skips it)
+        fc[et] = tuple(None if c is None else int(c) for c in caps)
       self.frontier_caps = fc
     else:
       if isinstance(frontier_caps, dict):

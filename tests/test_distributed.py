@@ -1082,3 +1082,56 @@ def test_dist_hier_exchange_skewed_fallback_s4():
       assert v in ((u + 1) % N, (u + 2) % N)
     nn = int(np.asarray(out.num_nodes)[p])
     assert len(set(node[p][:nn].tolist())) == nn
+
+
+def test_dist_hetero_calibrated_caps():
+  """Dict-form calibrated caps on the DISTRIBUTED typed engine
+  (round-5 parity with the local hetero clamps): caps at the plan's own
+  worst case are byte-identical to the uncapped program (the max_new
+  threading is a no-op at full width); tiny caps trip the REPLICATED
+  on-device overflow flag; clamped results keep exact per-shard dedup;
+  list caps on hetero graphs are rejected."""
+  num_parts = 4
+  parts, feats, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  fanouts = {et1: [2, 2], et2: [1, 1]}
+  seeds = np.arange(2 * num_parts, dtype=np.int32).reshape(num_parts, 2)
+
+  base = glt.distributed.DistNeighborSampler(dg, fanouts, mesh, seed=0,
+                                             dedup='merge')
+  _, hop_caps, _ = base._hetero_plan({'u': 2})
+  worst = {}
+  for h, per in enumerate(hop_caps):
+    for et, (fcap, k, cap) in per.items():
+      assert cap == fcap * k
+      worst.setdefault(et, [0] * len(hop_caps))[h] = cap
+  capped = glt.distributed.DistNeighborSampler(
+      dg, fanouts, mesh, seed=0, dedup='merge', frontier_caps=worst)
+  o1 = base.sample_from_nodes(('u', seeds))
+  o2 = capped.sample_from_nodes(('u', seeds))
+  assert not bool(np.any(np.asarray(o2.metadata['overflow'])))
+  for t in o1.node:
+    np.testing.assert_array_equal(np.asarray(o1.node[t]),
+                                  np.asarray(o2.node[t]))
+  for et in o1.row:
+    np.testing.assert_array_equal(np.asarray(o1.row[et]),
+                                  np.asarray(o2.row[et]))
+    np.testing.assert_array_equal(np.asarray(o1.edge_mask[et]),
+                                  np.asarray(o2.edge_mask[et]))
+
+  tiny = {et1: [1, 1], et2: [1, 1]}
+  s_tiny = glt.distributed.DistNeighborSampler(
+      dg, fanouts, mesh, seed=0, dedup='merge', frontier_caps=tiny)
+  o3 = s_tiny.sample_from_nodes(('u', seeds))
+  assert bool(np.any(np.asarray(o3.metadata['overflow'])))
+  for t in o3.node:
+    node = np.asarray(o3.node[t])
+    nn = np.asarray(o3.num_nodes[t])
+    for p in range(num_parts):
+      valid = node[p][:int(nn[p])]
+      assert len(set(valid.tolist())) == len(valid)
+
+  with pytest.raises(ValueError, match='homogeneous-only'):
+    glt.distributed.DistNeighborSampler(dg, fanouts, mesh, dedup='merge',
+                                        frontier_caps=[4, 4])

@@ -910,3 +910,56 @@ def test_flat_run_mean_window_impl_matches():
   finally:
     M.RUN_MEAN_IMPL = 'reshape'
   np.testing.assert_allclose(o_ref, o_win, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('use_caps', [True, False])
+def test_hgt_merge_dense_matches_segment(use_caps):
+  """HGT(merge_dense=True) — dense k-run typed attention on exact-dedup
+  merge batches (calibrated caps and uncapped) — matches the segment
+  softmax path with the SAME params (merge is a mode of the same
+  conv), seed logits compared."""
+  import jax
+  ET1, ET2 = ('u', 'to', 'v'), ('v', 'back', 'u')
+  rng = np.random.default_rng(6)
+  nu, nv = 90, 70
+  e1 = np.stack([rng.integers(0, nu, 500), rng.integers(0, nv, 500)])
+  e2 = np.stack([rng.integers(0, nv, 400), rng.integers(0, nu, 400)])
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph({ET1: e1, ET2: e2}, graph_mode='CPU',
+                num_nodes={ET1: nu, ET2: nv})
+  ds.init_node_features(
+      {'u': rng.standard_normal((nu, 8)).astype(np.float32),
+       'v': rng.standard_normal((nv, 8)).astype(np.float32)})
+  ds.init_node_labels({'u': rng.integers(0, 3, nu)})
+  fan = {ET1: [3, 2], ET2: [2, 2]}
+  caps = None
+  if use_caps:
+    caps = glt.sampler.estimate_hetero_frontier_caps(
+        ds.graph, fan, {'u': 8}, num_probes=6, slack=1.5, multiple=4)
+  loader = glt.loader.NeighborLoader(ds, fan, ('u', np.arange(nu)),
+                                     batch_size=8, seed=0, dedup='merge',
+                                     frontier_caps=caps)
+  recs, no, eo = glt.sampler.hetero_tree_blocks({'u': 8}, tuple(fan),
+                                                fan, etype_caps=caps)
+  ntypes = ('u', 'v')
+  from graphlearn_tpu.models import HGT
+  params = None
+  for bi, b in enumerate(loader):
+    if bi >= 2:
+      break
+    x = {t: np.asarray(v) for t, v in b.x.items()}
+    ei = {et: np.asarray(v) for et, v in b.edge_index.items()}
+    em = {et: np.asarray(v) for et, v in b.edge_mask.items()}
+    etypes = tuple(sorted(ei))
+    kw = dict(ntypes=ntypes, etypes=etypes, hidden_dim=8, out_dim=3,
+              heads=2, num_layers=2, out_ntype='u',
+              hop_node_offsets=no, hop_edge_offsets=eo)
+    seg = HGT(**kw)
+    dense = HGT(**kw, tree_records=recs, merge_dense=True)
+    if params is None:
+      params = jax.jit(seg.init)(jax.random.PRNGKey(0), x, ei, em)
+    o_seg = np.asarray(jax.jit(seg.apply)(params, x, ei, em))
+    o_dense = np.asarray(jax.jit(dense.apply)(params, x, ei, em))
+    nseed = int(np.asarray(b.num_sampled_nodes['u'])[0])
+    np.testing.assert_allclose(o_seg[:nseed], o_dense[:nseed],
+                               rtol=2e-4, atol=2e-4)
