@@ -1,0 +1,164 @@
+"""HGT on the MAG-shaped synthetic via the MP (subprocess) loader.
+
+Counterpart of /root/reference/examples/hetero/train_hgt_mag_mp.py:
+the same model/graph as train_hgt_mag.py, but batches are produced by
+sampling SUBPROCESSES feeding a native shm channel
+(MpDistNeighborLoader -> DistMpSamplingProducer -> ShmChannel), so
+host-side sampling + typed feature/label gathering overlap device
+training — the reference's mp worker mode. Workers rebuild the typed
+graph from per-etype ipc handles and run the EXACT-dedup typed engine
+on CPU, so the model uses the merge-dense hierarchical path
+(HGT(merge_dense=True)) — equivalence-tested against the segment
+softmax path.
+
+Run: python examples/hetero/train_hgt_mag_mp.py --epochs 2
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import graphlearn_tpu as glt  # noqa: E402
+from graphlearn_tpu.models import HGT  # noqa: E402
+
+_BASE = glt.utils.load_module(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 'train_hgt_mag.py'))
+CITES, WRITES, AFFIL, TOPIC = (_BASE.CITES, _BASE.WRITES, _BASE.AFFIL,
+                               _BASE.TOPIC)
+rev = _BASE.rev
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--epochs', type=int, default=2)
+  ap.add_argument('--n-paper', type=int, default=60_000)
+  ap.add_argument('--batch-size', type=int, default=512)
+  ap.add_argument('--hidden', type=int, default=64)
+  ap.add_argument('--heads', type=int, default=4)
+  ap.add_argument('--lr', type=float, default=3e-3)
+  ap.add_argument('--num-workers', type=int, default=2)
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import optax
+  glt.utils.enable_compilation_cache()
+  rng = np.random.default_rng(0)
+  ncls = 8
+  n_author, n_inst, n_field = args.n_paper // 2, 200, 500
+  cites, writes, affil, topic, feats, label = _BASE.make_mag_like(
+      args.n_paper, n_author, n_inst, n_field, ncls, rng)
+  edges = {CITES: cites, WRITES: writes, AFFIL: affil, TOPIC: topic,
+           rev(WRITES): writes[::-1].copy(),
+           rev(AFFIL): affil[::-1].copy(),
+           rev(TOPIC): topic[::-1].copy()}
+  nnodes = {'paper': args.n_paper, 'author': n_author,
+            'institution': n_inst, 'field_of_study': n_field}
+  # CPU graph: the mp workers sample host-side; the training process
+  # keeps the device for the model step (single-controller split)
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph(edges, graph_mode='CPU',
+                num_nodes={et: nnodes[et[0]] for et in edges})
+  ds.init_node_features(feats)
+  ds.init_node_labels({'paper': label})
+
+  fan = {et: [10, 10] for et in edges}
+  n_tr = int(args.n_paper * 0.2)
+  loader = glt.distributed.MpDistNeighborLoader(
+      ds, fan, ('paper', np.arange(n_tr)), batch_size=args.batch_size,
+      shuffle=True, drop_last=True, num_workers=args.num_workers,
+      seed=0)
+  test_loader = glt.distributed.MpDistNeighborLoader(
+      ds, fan, ('paper', np.arange(n_tr, int(args.n_paper * 0.25))),
+      batch_size=args.batch_size, shuffle=False,
+      num_workers=args.num_workers, seed=1)
+
+  # mp workers run the EXACT typed engine (merge layout): dense k-run
+  # attention via the merge records; same worst-case offsets as the
+  # tree layout on unclamped plans
+  recs, no, eo = glt.sampler.hetero_tree_blocks(
+      {'paper': args.batch_size}, tuple(edges), fan)
+  model_etypes = tuple(rev(et) for et in edges)
+  model = HGT(ntypes=tuple(nnodes), etypes=model_etypes,
+              hidden_dim=args.hidden, out_dim=ncls, heads=args.heads,
+              num_layers=2, out_ntype='paper',
+              hop_node_offsets=no, hop_edge_offsets=eo,
+              tree_records=recs, merge_dense=True)
+
+  def bdict(batch):
+    return dict(x=batch.x, ei=batch.edge_index, em=batch.edge_mask,
+                y=batch.y['paper'],
+                num_seed=jnp.asarray(
+                    batch.num_sampled_nodes['paper'])[0])
+
+  def loss_fn(params, b):
+    logits = model.apply(params, b['x'], b['ei'], b['em'])
+    n = logits.shape[0]
+    y = b['y'][:n]
+    seed_mask = jnp.arange(n) < b['num_seed']
+    ce = optax.softmax_cross_entropy(logits, jax.nn.one_hot(y, ncls))
+    loss = jnp.where(seed_mask, ce, 0.0).sum() / jnp.maximum(
+        seed_mask.sum(), 1)
+    correct = ((logits.argmax(-1) == y) & seed_mask).sum()
+    return loss, (correct, seed_mask.sum())
+
+  @jax.jit
+  def step(params, opt_state, b):
+    (loss, _), g = jax.value_and_grad(loss_fn, has_aux=True)(params, b)
+    updates, opt_state = tx.update(g, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+  @jax.jit
+  def eval_counts(params, b):
+    return loss_fn(params, b)[1]
+
+  try:
+    it = iter(loader)
+    first = bdict(next(it))
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), first['x'],
+                                 first['ei'], first['em'])
+    tx = optax.adam(args.lr)
+    opt_state = tx.init(params)
+    params, opt_state, loss = step(params, opt_state, first)
+    losses = [loss]
+    epoch_times = []
+    for b in it:                      # finish epoch 1
+      params, opt_state, loss = step(params, opt_state, bdict(b))
+      losses.append(loss)
+    for _ in range(args.epochs - 1):
+      t0 = time.perf_counter()
+      for b in loader:
+        params, opt_state, loss = step(params, opt_state, bdict(b))
+        losses.append(loss)
+      jax.block_until_ready(losses[-1])
+      epoch_times.append(time.perf_counter() - t0)
+
+    correct = total = None
+    for b in test_loader:
+      c, t = eval_counts(params, bdict(b))
+      correct = c if correct is None else correct + c
+      total = t if total is None else total + t
+    jax.block_until_ready((correct, total))
+  finally:
+    loader.shutdown()
+    test_loader.shutdown()
+
+  print(json.dumps({
+      'model': 'HGT (mp loader)', 'n_paper': args.n_paper,
+      'epochs': args.epochs, 'num_workers': args.num_workers,
+      'first_loss': round(float(losses[0]), 4),
+      'final_loss': round(float(losses[-1]), 4),
+      'test_acc': round(float(correct) / max(float(total), 1.0), 4),
+      'epoch_time_s_wall': (round(float(np.mean(epoch_times)), 3)
+                            if epoch_times else None),
+  }), flush=True)
+
+
+if __name__ == '__main__':
+  main()

@@ -167,17 +167,27 @@ class MpDistNeighborLoader:
   host-side seed prep/feature IO should overlap device training; the
   collocated mesh loader (DistNeighborLoader) is the device-fast path."""
 
-  def __init__(self, data, num_neighbors: List[int], input_nodes,
+  def __init__(self, data, num_neighbors, input_nodes,
                batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, num_workers: int = 2,
                channel_size: int = 1 << 26, seed: Optional[int] = None):
     from ..sampler import SamplingConfig, SamplingType
+    # hetero seeds: ('paper', ids) — workers sample the typed engine and
+    # stream HeteroData messages (message.hetero_output_to_message)
+    input_type = None
+    if isinstance(input_nodes, tuple) and len(input_nodes) == 2 and \
+        isinstance(input_nodes[0], str):
+      input_type, input_nodes = input_nodes
     config = SamplingConfig(
-        SamplingType.NODE, list(num_neighbors), batch_size, shuffle,
+        SamplingType.NODE,
+        (dict(num_neighbors) if isinstance(num_neighbors, dict)
+         else list(num_neighbors)), batch_size, shuffle,
         drop_last, with_edge, collect_features, False, False,
         data.edge_dir, seed)
-    self._setup(data, NodeSamplerInput(np.asarray(input_nodes).reshape(-1)),
+    self._setup(data,
+                NodeSamplerInput(np.asarray(input_nodes).reshape(-1),
+                                 input_type=input_type),
                 config, channel_size, num_workers, seed)
 
   def _setup(self, data, sampler_input, config, channel_size, num_workers,
@@ -233,6 +243,14 @@ class MpDistLinkNeighborLoader(MpDistNeighborLoader):
                num_workers: int = 2, channel_size: int = 1 << 26,
                seed: Optional[int] = None):
     from ..sampler import (EdgeSamplerInput, SamplingConfig, SamplingType)
+    if isinstance(data.graph, dict):
+      # the mp link worker builds EdgeSamplerInput without a seed edge
+      # type, which the typed engine requires — fail fast here instead
+      # of a 60s worker-death timeout in the subprocess
+      raise ValueError('hetero LINK sampling through the mp loader is '
+                       'not supported; use the collocated '
+                       'DistNeighborLoader link path (typed) or the mp '
+                       'NODE loader')
     ei = np.asarray(edge_label_index)
     config = SamplingConfig(
         SamplingType.LINK, list(num_neighbors), batch_size, shuffle,

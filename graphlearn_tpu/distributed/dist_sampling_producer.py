@@ -18,7 +18,7 @@ import numpy as np
 
 from ..channel import ChannelBase
 from ..sampler import NodeSamplerInput, SamplingConfig, SamplingType
-from .message import output_to_message
+from .message import hetero_output_to_message, output_to_message
 
 
 class MpCommand(Enum):
@@ -38,16 +38,27 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
   import graphlearn_tpu as glt
 
   # rebuild from host-side ipc handles; device state stays on CPU here
-  topo, _ = dataset_handle['graph_ipc']
-  graph = glt.data.Graph(topo, 'CPU')
+  gipc = dataset_handle['graph_ipc']
+  hetero = isinstance(gipc, dict)
+  if hetero:
+    graph = {tuple(et): glt.data.Graph(h[0], 'CPU')
+             for et, h in gipc.items()}
+  else:
+    topo, _ = gipc
+    graph = glt.data.Graph(topo, 'CPU')
+  fipc = dataset_handle['feature_ipc']
   feature = None
-  if dataset_handle['feature_ipc'] is not None:
-    feature = glt.data.Feature.from_ipc_handle(
-        dataset_handle['feature_ipc'])
-    feature.with_device = False
+  if fipc is not None:
+    def _rebuild(h):
+      f = glt.data.Feature.from_ipc_handle(h)
+      f.with_device = False
+      return f
+    feature = ({t: _rebuild(h) for t, h in fipc.items()}
+               if isinstance(fipc, dict) else _rebuild(fipc))
   dataset = glt.data.Dataset(graph, feature, None,
                              dataset_handle['node_labels'],
                              dataset_handle['edge_dir'])
+  input_type = dataset_handle.get('input_type')
   cfg: SamplingConfig = sampling_config
   # fold the worker rank into the seed: same-seeded workers would draw
   # IDENTICAL negative edges per batch index (negatives depend only on
@@ -94,8 +105,26 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
             label=(label_[idx] if label_ is not None else None),
             neg_sampling=neg))
       else:
-        out = sampler.sample_from_nodes(NodeSamplerInput(seeds[idx]),
-                                        batch_cap=bs)
+        out = sampler.sample_from_nodes(
+            NodeSamplerInput(seeds[idx], input_type=input_type),
+            batch_cap=bs)
+      if hetero:
+        x_d = y_d = None
+        if cfg.collect_features and \
+            isinstance(dataset.node_features, dict):
+          x_d = {t: dataset.node_features[t].cpu_get(
+              np.maximum(np.asarray(out.node[t]), 0))
+              for t in out.node if t in dataset.node_features}
+        if isinstance(dataset.node_labels, dict):
+          y_d = {}
+          for t, lab in dataset.node_labels.items():
+            if t not in out.node:
+              continue
+            lab = np.asarray(lab)
+            y_d[t] = lab[np.clip(np.asarray(out.node[t]), 0,
+                                 len(lab) - 1)]
+        channel.send(hetero_output_to_message(out, x_d, y_d))
+        continue
       x = y = None
       if cfg.collect_features and dataset.node_features is not None:
         x = dataset.node_features.cpu_get(
@@ -131,6 +160,7 @@ class DistMpSamplingProducer:
     else:
       self._link_input = None
       self.seeds = np.asarray(sampler_input.node).reshape(-1)
+      self._input_type = getattr(sampler_input, 'input_type', None)
       n = self.seeds.shape[0]
     self._num_seeds = n
     self.channel = channel
@@ -144,12 +174,17 @@ class DistMpSamplingProducer:
   def init(self):
     ctx = mp.get_context('spawn')
     self._done = ctx.Value('i', 0)
+    g = self.dataset.graph
+    nf = self.dataset.node_features
     handle = dict(
-        graph_ipc=self.dataset.graph.share_ipc(),
-        feature_ipc=(self.dataset.node_features.share_ipc()
-                     if self.dataset.node_features is not None else None),
+        graph_ipc=({et: gr.share_ipc() for et, gr in g.items()}
+                   if isinstance(g, dict) else g.share_ipc()),
+        feature_ipc=(None if nf is None else
+                     {t: f.share_ipc() for t, f in nf.items()}
+                     if isinstance(nf, dict) else nf.share_ipc()),
         node_labels=self.dataset.node_labels,
-        edge_dir=self.dataset.edge_dir)
+        edge_dir=self.dataset.edge_dir,
+        input_type=getattr(self, '_input_type', None))
     # ship host containers; subprocesses rebuild on the CPU backend
     for w in range(self.num_workers):
       q = ctx.Queue()

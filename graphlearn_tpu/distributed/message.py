@@ -42,17 +42,128 @@ def output_to_message(out: SamplerOutput, x=None, y=None) -> dict:
     msg['y'] = np.asarray(y)
   for k, v in out.metadata.items():
     try:
-      msg[META_PREFIX + k] = np.asarray(v)
+      arr = np.asarray(v)
     except Exception:
-      pass
+      continue
+    if arr.dtype == object:    # nested containers can't ride the channel
+      continue
+    msg[META_PREFIX + k] = arr
   return msg
+
+
+def _et_key(et) -> str:
+  from ..typing import as_str
+  return as_str(tuple(et))
+
+
+def hetero_output_to_message(out, x_dict=None, y_dict=None) -> dict:
+  """Flatten a HeteroSamplerOutput + optional typed features/labels.
+
+  Typed payloads use dotted keys (``node.paper``,
+  ``row.paper__cites__paper``) mirroring the reference's hetero
+  SampleMessage convention (dist_neighbor_sampler.py:650-744 '#'-keyed
+  dicts); ``#META.hetero`` marks the message so message_to_data
+  rebuilds a HeteroData. Node/edge type names must not contain '.' or
+  '__' (the framework-wide etype-name convention)."""
+  msg = {'#META.hetero': np.asarray(1)}
+  for t, v in out.node.items():
+    msg[f'node.{t}'] = np.asarray(v)
+    msg[f'num_nodes.{t}'] = np.asarray(out.num_nodes[t])
+  for et, v in out.row.items():
+    k = _et_key(et)
+    msg[f'row.{k}'] = np.asarray(v)
+    msg[f'col.{k}'] = np.asarray(out.col[et])
+    msg[f'edge_mask.{k}'] = np.asarray(out.edge_mask[et])
+    if out.edge is not None and et in out.edge:
+      msg[f'edge.{k}'] = np.asarray(out.edge[et])
+    if out.num_sampled_edges is not None and et in out.num_sampled_edges:
+      msg[f'num_sampled_edges.{k}'] = np.asarray(
+          [np.asarray(c) for c in out.num_sampled_edges[et]])
+  if out.batch is not None:
+    for t, v in out.batch.items():
+      msg[f'batch.{t}'] = np.asarray(v)
+  if out.num_sampled_nodes is not None:
+    for t, v in out.num_sampled_nodes.items():
+      msg[f'num_sampled_nodes.{t}'] = np.asarray(
+          [np.asarray(c) for c in v])
+  if out.batch_size is not None:
+    msg['#META.batch_size'] = np.asarray(out.batch_size)
+  if out.input_type is not None:
+    msg['#META.input_type'] = np.frombuffer(
+        str(out.input_type).encode(), dtype=np.uint8).copy()
+  for t, v in (x_dict or {}).items():
+    msg[f'x.{t}'] = np.asarray(v)
+  for t, v in (y_dict or {}).items():
+    msg[f'y.{t}'] = np.asarray(v)
+  for k, v in out.metadata.items():
+    try:
+      arr = np.asarray(v)
+    except Exception:
+      continue
+    if arr.dtype == object:    # nested dicts (e.g. seed_inverse_dict)
+      continue                 # don't serialize; channel is flat arrays
+    msg[META_PREFIX + k] = arr
+  return msg
+
+
+def _hetero_message_to_data(msg: dict, to_device: bool):
+  """SampleMessage -> loader.HeteroData (typed counterpart of
+  message_to_data; keys per hetero_output_to_message)."""
+  import jax.numpy as jnp
+
+  from ..loader.transform import HeteroData
+  from ..typing import to_edge_type
+  conv = (lambda a: jnp.asarray(a)) if to_device else (lambda a: a)
+
+  def group(prefix, et_keyed=False):
+    d = {}
+    for k, v in msg.items():
+      if not k.startswith(prefix + '.'):
+        continue
+      name = k[len(prefix) + 1:]
+      d[to_edge_type(name) if et_keyed else name] = v
+    return d
+
+  node = {t: conv(v) for t, v in group('node').items()}
+  num_nodes = {t: int(np.asarray(v).reshape(-1)[0])
+               for t, v in group('num_nodes').items() if '__' not in t}
+  rows = group('row', et_keyed=True)
+  cols = group('col', et_keyed=True)
+  ei = {}
+  for et, r in rows.items():
+    r2, c2 = conv(r), conv(cols[et])
+    ei[et] = jnp.stack([r2, c2]) if to_device else np.stack([r2, c2])
+  em = {et: conv(v) for et, v in group('edge_mask', True).items()}
+  eids = {et: conv(v) for et, v in group('edge', True).items()} or None
+  x = {t: conv(v) for t, v in group('x').items()} or None
+  y = {t: conv(v) for t, v in group('y').items()} or None
+  batch = {t: conv(v) for t, v in group('batch').items()} or None
+  nsn = {t: v for t, v in group('num_sampled_nodes').items()
+         if '__' not in t} or None
+  nse = group('num_sampled_edges', et_keyed=True) or None
+  metadata = {k[len(META_PREFIX):]: v for k, v in msg.items()
+              if k.startswith(META_PREFIX) and
+              k not in ('#META.batch_size', '#META.hetero',
+                        '#META.input_type')}
+  if '#META.input_type' in msg:
+    metadata['input_type'] = bytes(
+        np.asarray(msg['#META.input_type'])).decode()
+  return HeteroData(
+      node=node, num_nodes=num_nodes, edge_index=ei, edge_mask=em,
+      x=x, y=y, edge_ids=eids, batch=batch,
+      batch_size=(int(np.asarray(msg['#META.batch_size']).reshape(-1)[0])
+                  if '#META.batch_size' in msg else None),
+      num_sampled_nodes=nsn, num_sampled_edges=nse, metadata=metadata)
 
 
 def message_to_data(msg: dict, to_device: bool = True) -> Data:
   """SampleMessage -> loader.Data (reference: DistLoader._collate_fn,
   dist_loader.py:331-441). Arrays stay padded; device transfer is one
-  device_put per array when `to_device`."""
+  device_put per array when `to_device`. Messages flagged
+  ``#META.hetero`` rebuild a HeteroData instead."""
   import jax.numpy as jnp
+  if '#META.hetero' in msg:
+    return _hetero_message_to_data(msg, to_device)
   conv = (lambda a: jnp.asarray(a)) if to_device else (lambda a: a)
   node = conv(msg['node'])
   row, col = conv(msg['row']), conv(msg['col'])

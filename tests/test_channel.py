@@ -82,7 +82,10 @@ def test_shm_channel_cross_process():
   got = []
   while True:
     try:
-      msg = ch.recv(timeout_ms=10000)
+      # 60s first-message budget: the spawned child imports the full
+      # module tree (incl. jax) before producing — >10s under load
+      # (same posture as the mp loaders' recv timeout)
+      msg = ch.recv(timeout_ms=60000)
     except StopIteration:
       break
     got.append(int(msg['i'][0]))
