@@ -22,6 +22,22 @@ from .dist_dataset import DistDataset
 from .dist_neighbor_sampler import DistNeighborSampler
 
 
+def _split_input_type(input_nodes):
+  """The framework-wide seed convention: ``('ntype', ids)`` for typed
+  seeds, a bare array otherwise. ONE implementation for every loader
+  front-end (collocated / mp / remote)."""
+  if isinstance(input_nodes, tuple) and len(input_nodes) == 2 and \
+      isinstance(input_nodes[0], str):
+    return input_nodes[0], input_nodes[1]
+  return None, input_nodes
+
+
+def _norm_num_neighbors(num_neighbors):
+  """Picklable copy: per-etype dict fanouts or a shared list."""
+  return (dict(num_neighbors) if isinstance(num_neighbors, dict)
+          else list(num_neighbors))
+
+
 class DistLoader(OverflowGuardMixin):
   """Reference: dist_loader.py:128-441 (collocated branch)."""
 
@@ -38,10 +54,7 @@ class DistLoader(OverflowGuardMixin):
     # (supervision reads seed slots; skips a full-capacity sharded
     # label gather — the same knob as the local loaders)
     self.seed_labels_only = seed_labels_only
-    if isinstance(input_nodes, tuple) and isinstance(input_nodes[0], str):
-      self.input_type, input_nodes = input_nodes
-    else:
-      self.input_type = None
+    self.input_type, input_nodes = _split_input_type(input_nodes)
     self.input_seeds = np.asarray(input_nodes).reshape(-1)
     self.batch_size = batch_size  # per shard
     self.shuffle = shuffle
@@ -175,16 +188,11 @@ class MpDistNeighborLoader:
     from ..sampler import SamplingConfig, SamplingType
     # hetero seeds: ('paper', ids) — workers sample the typed engine and
     # stream HeteroData messages (message.hetero_output_to_message)
-    input_type = None
-    if isinstance(input_nodes, tuple) and len(input_nodes) == 2 and \
-        isinstance(input_nodes[0], str):
-      input_type, input_nodes = input_nodes
+    input_type, input_nodes = _split_input_type(input_nodes)
     config = SamplingConfig(
-        SamplingType.NODE,
-        (dict(num_neighbors) if isinstance(num_neighbors, dict)
-         else list(num_neighbors)), batch_size, shuffle,
-        drop_last, with_edge, collect_features, False, False,
-        data.edge_dir, seed)
+        SamplingType.NODE, _norm_num_neighbors(num_neighbors),
+        batch_size, shuffle, drop_last, with_edge, collect_features,
+        False, False, data.edge_dir, seed)
     self._setup(data,
                 NodeSamplerInput(np.asarray(input_nodes).reshape(-1),
                                  input_type=input_type),
@@ -243,14 +251,8 @@ class MpDistLinkNeighborLoader(MpDistNeighborLoader):
                num_workers: int = 2, channel_size: int = 1 << 26,
                seed: Optional[int] = None):
     from ..sampler import (EdgeSamplerInput, SamplingConfig, SamplingType)
-    if isinstance(data.graph, dict):
-      # the mp link worker builds EdgeSamplerInput without a seed edge
-      # type, which the typed engine requires — fail fast here instead
-      # of a 60s worker-death timeout in the subprocess
-      raise ValueError('hetero LINK sampling through the mp loader is '
-                       'not supported; use the collocated '
-                       'DistNeighborLoader link path (typed) or the mp '
-                       'NODE loader')
+    # typed-graph rejection lives in DistMpSamplingProducer (shared by
+    # the node/link loaders AND the server producers)
     ei = np.asarray(edge_label_index)
     config = SamplingConfig(
         SamplingType.LINK, list(num_neighbors), batch_size, shuffle,
@@ -267,12 +269,13 @@ class RemoteDistNeighborLoader:
   batches stream back over RPC (reference: dist_loader.py:155-195 +
   dist_neighbor_loader.py remote branch)."""
 
-  def __init__(self, num_neighbors: List[int], input_nodes,
+  def __init__(self, num_neighbors, input_nodes,
                batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, worker_options=None,
                seed: Optional[int] = None):
     from ..channel import RemoteReceivingChannel
+    from ..sampler import NodeSamplerInput as NSI
     from ..sampler import SamplingConfig, SamplingType
     from . import dist_client
     from .message import message_to_data
@@ -283,15 +286,22 @@ class RemoteDistNeighborLoader:
     if isinstance(ranks, int):
       ranks = [ranks]
     self.server_ranks = list(ranks)
+    # hetero seeds: ('paper', ids) — the server's mp workers run the
+    # typed engine and stream HeteroData messages back (round 5); ship
+    # typed NodeSamplerInputs so the tuple convention (type FIRST)
+    # never hits CastMixin's positional cast
+    input_type, input_nodes = _split_input_type(input_nodes)
     config = SamplingConfig(
-        SamplingType.NODE, list(num_neighbors), batch_size, shuffle,
-        drop_last, with_edge, collect_features, False, False, 'out', seed)
+        SamplingType.NODE, _norm_num_neighbors(num_neighbors),
+        batch_size, shuffle, drop_last, with_edge, collect_features,
+        False, False, 'out', seed)
     seeds = np.asarray(input_nodes).reshape(-1)
     # split seeds across servers; each server samples its share
     splits = np.array_split(seeds, len(self.server_ranks))
     self.producer_ids = []
     self._expected = 0
     for rank, part in zip(self.server_ranks, splits):
+      part = NSI(part, input_type) if input_type is not None else part
       pid = dist_client.request_server(
           rank, 'create_sampling_producer', part, config,
           opts.num_workers if opts else 1,

@@ -162,6 +162,18 @@ class DistMpSamplingProducer:
       self.seeds = np.asarray(sampler_input.node).reshape(-1)
       self._input_type = getattr(sampler_input, 'input_type', None)
       n = self.seeds.shape[0]
+    # typed-graph contract, validated HERE so every mp consumer (node
+    # loader, link loader, server producers) fails fast instead of a
+    # worker assert surfacing as a 60s channel timeout
+    if isinstance(dataset.graph, dict):
+      if self._link_input is not None:
+        raise ValueError('hetero LINK sampling through the mp producers '
+                         'is not supported; use the collocated '
+                         'DistNeighborLoader link path (typed)')
+      if self._input_type is None:
+        raise ValueError("hetero sampling requires typed seeds — pass "
+                         "('ntype', ids) (or a NodeSamplerInput with "
+                         'input_type)')
     self._num_seeds = n
     self.channel = channel
     self.num_workers = num_workers

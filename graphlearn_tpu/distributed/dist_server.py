@@ -47,7 +47,13 @@ class DistServer:
       pid = self._next_id
       self._next_id += 1
       buf = ShmChannel(shm_size=buffer_size)
+      import dataclasses
+
       from ..sampler import EdgeSamplerInput, SamplingType
+      # the server's dataset is the authority on edge orientation —
+      # remote clients can't know it and default to 'out'
+      sampling_config = dataclasses.replace(
+          sampling_config, edge_dir=self.dataset.edge_dir)
       if sampling_config.sampling_type == SamplingType.LINK:
         # seeds arrive as [2, E] (or an EdgeSamplerInput); negatives are
         # requested through config.with_neg (binary, amount 1 — pass an
@@ -144,6 +150,12 @@ class DistServer:
 
   def get_dataset_meta(self):
     g = self.dataset.graph
+    if isinstance(g, dict):     # hetero: per-etype counts
+      return dict(
+          num_nodes={et: gr.num_nodes for et, gr in g.items()},
+          num_edges={et: gr.num_edges for et, gr in g.items()},
+          edge_types=sorted(tuple(et) for et in g),
+          edge_dir=self.dataset.edge_dir)
     return dict(num_nodes=g.num_nodes, num_edges=g.num_edges,
                 edge_dir=self.dataset.edge_dir)
 

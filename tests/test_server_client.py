@@ -395,3 +395,72 @@ def test_mp_dist_hetero_loader():
     assert batch.metadata.get('input_type') == 'user'
   finally:
     loader.shutdown()
+
+
+def _hetero_server_main(port_queue):
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except RuntimeError:
+    pass
+  import graphlearn_tpu as glt_mod
+  ub = np.array([[0, 0, 1, 2, 2, 3, 4, 5], [0, 1, 2, 3, 0, 1, 2, 3]])
+  UB, BU = ('user', 'buys', 'item'), ('item', 'rev_buys', 'user')
+  ds = glt_mod.data.Dataset(edge_dir='out')
+  ds.init_graph({UB: ub, BU: ub[::-1].copy()}, graph_mode='CPU',
+                num_nodes={UB: 6, BU: 4})
+  ds.init_node_features(
+      {'user': np.arange(6, dtype=np.float32)[:, None] *
+       np.ones((1, 3), np.float32),
+       'item': 100.0 + np.arange(4, dtype=np.float32)[:, None] *
+       np.ones((1, 3), np.float32)})
+  ds.init_node_labels({'user': np.arange(6) % 2})
+  host, port = glt_mod.distributed.init_server(
+      num_servers=1, num_clients=1, server_rank=0, dataset=ds)
+  port_queue.put((host, port))
+  glt_mod.distributed.wait_and_shutdown_server(timeout=120)
+
+
+def test_server_client_hetero_end_to_end():
+  """Remote (server-client) HETERO node loading (round 5): the server's
+  mp workers run the typed engine and stream HeteroData messages back
+  over RPC — typed seeds ship as NodeSamplerInput('user', ...) and
+  typed features/labels resolve client-side."""
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  server = ctx.Process(target=_hetero_server_main, args=(q,))
+  server.start()
+  host, port = q.get(timeout=120)
+  glt.distributed.init_client(num_servers=1, num_clients=1,
+                              client_rank=0, server_addrs=[(host, port)])
+  meta = glt.distributed.request_server(0, 'get_dataset_meta')
+  assert meta['edge_dir'] == 'out'
+  assert ('user', 'buys', 'item') in meta['edge_types']
+  assert meta['num_nodes'][('user', 'buys', 'item')] == 6
+  opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+      server_rank=0, num_workers=2, prefetch_size=2)
+  loader = glt.distributed.RemoteDistNeighborLoader(
+      {('user', 'buys', 'item'): [2, 2],
+       ('item', 'rev_buys', 'user'): [2, 2]},
+      ('user', np.arange(6)), batch_size=2, collect_features=True,
+      worker_options=opts, seed=0)
+  for epoch in range(2):
+    seen = []
+    batches = 0
+    for batch in loader:
+      batches += 1
+      assert set(batch.node) == {'user', 'item'}
+      nu = batch.num_nodes['user']
+      user = np.asarray(batch.node['user'])
+      xu = np.asarray(batch.x['user'])
+      np.testing.assert_allclose(xu[:nu, 0], user[:nu])
+      yu = np.asarray(batch.y['user'])
+      np.testing.assert_array_equal(yu[:nu], user[:nu] % 2)
+      seen.extend(
+          np.asarray(batch.batch['user'])[:batch.batch_size].tolist())
+    assert batches == len(loader)
+    assert sorted(seen) == list(range(6))
+  loader.shutdown()
+  glt.distributed.shutdown_client()
+  server.join(timeout=30)
+  assert not server.is_alive()
