@@ -251,15 +251,26 @@ class MpDistLinkNeighborLoader(MpDistNeighborLoader):
                num_workers: int = 2, channel_size: int = 1 << 26,
                seed: Optional[int] = None):
     from ..sampler import (EdgeSamplerInput, SamplingConfig, SamplingType)
-    # typed-graph rejection lives in DistMpSamplingProducer (shared by
-    # the node/link loaders AND the server producers)
+    # hetero seed edges: ((src_t, rel, dst_t), [2, E]) — the LinkLoader
+    # tuple convention; workers run the typed link engine
+    edge_type = None
+    if isinstance(edge_label_index, tuple) and \
+        len(edge_label_index) == 2 and \
+        isinstance(edge_label_index[0], (tuple, list)) and \
+        len(edge_label_index[0]) == 3 and \
+        all(isinstance(s, str) for s in edge_label_index[0]):
+      # the str check keeps a homogeneous (rows, cols) pair with
+      # exactly 3 edges from being misread as a typed seed tuple
+      edge_type, edge_label_index = edge_label_index
+      edge_type = tuple(edge_type)
     ei = np.asarray(edge_label_index)
     config = SamplingConfig(
-        SamplingType.LINK, list(num_neighbors), batch_size, shuffle,
-        drop_last, with_edge, collect_features,
+        SamplingType.LINK, _norm_num_neighbors(num_neighbors),
+        batch_size, shuffle, drop_last, with_edge, collect_features,
         neg_sampling is not None, False, data.edge_dir, seed)
     self._setup(data,
                 EdgeSamplerInput(ei[0], ei[1], label=edge_label,
+                                 input_type=edge_type,
                                  neg_sampling=neg_sampling),
                 config, channel_size, num_workers, seed)
 

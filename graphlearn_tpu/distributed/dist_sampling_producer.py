@@ -103,6 +103,7 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
         out = sampler.sample_from_edges(EdgeSamplerInput(
             rows_[idx], cols_[idx],
             label=(label_[idx] if label_ is not None else None),
+            input_type=input_type,
             neg_sampling=neg))
       else:
         out = sampler.sample_from_nodes(
@@ -155,6 +156,9 @@ class DistMpSamplingProducer:
                  if sampler_input.label is not None else None),
           neg_mode=(neg.mode if neg is not None else None),
           neg_amount=(neg.amount if neg is not None else 1))
+      # one channel for the typed-seed tag: the shared dataset handle
+      # (input_type below), not per-worker seed payloads
+      self._input_type = getattr(sampler_input, 'input_type', None)
       n = self._link_input['rows'].shape[0]
       self.seeds = None
     else:
@@ -165,15 +169,12 @@ class DistMpSamplingProducer:
     # typed-graph contract, validated HERE so every mp consumer (node
     # loader, link loader, server producers) fails fast instead of a
     # worker assert surfacing as a 60s channel timeout
-    if isinstance(dataset.graph, dict):
-      if self._link_input is not None:
-        raise ValueError('hetero LINK sampling through the mp producers '
-                         'is not supported; use the collocated '
-                         'DistNeighborLoader link path (typed)')
-      if self._input_type is None:
-        raise ValueError("hetero sampling requires typed seeds — pass "
-                         "('ntype', ids) (or a NodeSamplerInput with "
-                         'input_type)')
+    if isinstance(dataset.graph, dict) and self._input_type is None:
+      raise ValueError(
+          'hetero sampling requires typed seeds — pass '
+          "('ntype', ids) node seeds (or a NodeSamplerInput with "
+          'input_type), or ((src, rel, dst), edge_label_index) link '
+          'seeds (EdgeSamplerInput with input_type)')
     self._num_seeds = n
     self.channel = channel
     self.num_workers = num_workers

@@ -89,8 +89,9 @@ def hetero_output_to_message(out, x_dict=None, y_dict=None) -> dict:
   if out.batch_size is not None:
     msg['#META.batch_size'] = np.asarray(out.batch_size)
   if out.input_type is not None:
+    from ..typing import as_str
     msg['#META.input_type'] = np.frombuffer(
-        str(out.input_type).encode(), dtype=np.uint8).copy()
+        as_str(out.input_type).encode(), dtype=np.uint8).copy()
   for t, v in (x_dict or {}).items():
     msg[f'x.{t}'] = np.asarray(v)
   for t, v in (y_dict or {}).items():

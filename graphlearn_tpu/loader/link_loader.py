@@ -28,7 +28,10 @@ class LinkLoader(NodeLoader):
                overflow_policy: str = 'raise'):
     if isinstance(edge_label_index, tuple) and len(edge_label_index) == 2 \
         and isinstance(edge_label_index[0], (tuple, list)) \
-        and len(edge_label_index[0]) == 3:
+        and len(edge_label_index[0]) == 3 \
+        and all(isinstance(s, str) for s in edge_label_index[0]):
+      # str check: a homogeneous (rows, cols) pair with exactly 3 edges
+      # must not be misread as a typed seed tuple
       self.edge_type, edge_label_index = edge_label_index
     else:
       self.edge_type = None

@@ -1215,8 +1215,7 @@ class NeighborSampler(BaseSampler):
     neg_rows = neg_cols = None
     if neg is not None:
       num_neg = neg.num_negatives(b)
-      sorted_idx, _ = ops.sort_csr_segments(np.asarray(g.indptr),
-                                            np.asarray(g.indices))
+      sorted_idx, _ = self._neg_sorted(etype)
       nr, nc, _ = ops.random_negative_sample(
           g.indptr, jnp.asarray(sorted_idx), num_key, num_other, num_neg,
           self._next_key(), padding=True)
@@ -1274,8 +1273,11 @@ class NeighborSampler(BaseSampler):
     return out
 
   @functools.lru_cache(maxsize=None)
-  def _neg_sorted(self):
-    g = self._get_graph()
+  def _neg_sorted(self, etype=None):
+    """Per-(edge type) sorted CSR view for negative membership checks —
+    cached: the graph is static across batches, and the mp hetero link
+    hot loop would otherwise re-sort the whole CSR every batch."""
+    g = self._get_graph(etype)
     return ops.sort_csr_segments(np.asarray(g.indptr), np.asarray(g.indices))
 
   def __hash__(self):
