@@ -8,6 +8,7 @@ from .dist_graph import DistGraph, DistHeteroGraph, build_local_csr
 from .dist_loader import (DistLinkNeighborLoader, DistLoader,
                           DistNeighborLoader, DistSubGraphLoader,
                           MpDistLinkNeighborLoader, MpDistNeighborLoader,
+                          RemoteDistLinkNeighborLoader,
                           RemoteDistNeighborLoader)
 from .dist_neighbor_sampler import DistNeighborSampler
 from .dist_options import (CollocatedDistSamplingWorkerOptions,

@@ -38,6 +38,21 @@ def _norm_num_neighbors(num_neighbors):
           else list(num_neighbors))
 
 
+def _split_edge_type(edge_label_index):
+  """LinkLoader's typed seed-edge convention:
+  ``((src, rel, dst), [2, E])`` -> (etype, edges); anything else ->
+  (None, edges). The all-strings check keeps a homogeneous
+  (rows, cols) pair with exactly 3 edges from being misread as a
+  typed tuple."""
+  if isinstance(edge_label_index, tuple) and \
+      len(edge_label_index) == 2 and \
+      isinstance(edge_label_index[0], (tuple, list)) and \
+      len(edge_label_index[0]) == 3 and \
+      all(isinstance(s, str) for s in edge_label_index[0]):
+    return tuple(edge_label_index[0]), edge_label_index[1]
+  return None, edge_label_index
+
+
 class DistLoader(OverflowGuardMixin):
   """Reference: dist_loader.py:128-441 (collocated branch)."""
 
@@ -253,16 +268,7 @@ class MpDistLinkNeighborLoader(MpDistNeighborLoader):
     from ..sampler import (EdgeSamplerInput, SamplingConfig, SamplingType)
     # hetero seed edges: ((src_t, rel, dst_t), [2, E]) — the LinkLoader
     # tuple convention; workers run the typed link engine
-    edge_type = None
-    if isinstance(edge_label_index, tuple) and \
-        len(edge_label_index) == 2 and \
-        isinstance(edge_label_index[0], (tuple, list)) and \
-        len(edge_label_index[0]) == 3 and \
-        all(isinstance(s, str) for s in edge_label_index[0]):
-      # the str check keeps a homogeneous (rows, cols) pair with
-      # exactly 3 edges from being misread as a typed seed tuple
-      edge_type, edge_label_index = edge_label_index
-      edge_type = tuple(edge_type)
+    edge_type, edge_label_index = _split_edge_type(edge_label_index)
     ei = np.asarray(edge_label_index)
     config = SamplingConfig(
         SamplingType.LINK, _norm_num_neighbors(num_neighbors),
@@ -275,46 +281,32 @@ class MpDistLinkNeighborLoader(MpDistNeighborLoader):
                 config, channel_size, num_workers, seed)
 
 
-class RemoteDistNeighborLoader:
-  """Remote (server-client) mode: producers run on sampling servers,
-  batches stream back over RPC (reference: dist_loader.py:155-195 +
-  dist_neighbor_loader.py remote branch)."""
+class _RemoteLoaderBase:
+  """Shared remote (server-client) machinery: create one producer per
+  server from a per-server sampler-input split, pull batches through
+  the RemoteReceivingChannel, restart producers per epoch (reference:
+  dist_loader.py:155-195 + dist_neighbor_loader.py remote branch)."""
 
-  def __init__(self, num_neighbors, input_nodes,
-               batch_size: int = 64, shuffle: bool = False,
-               drop_last: bool = False, with_edge: bool = False,
-               collect_features: bool = True, worker_options=None,
-               seed: Optional[int] = None):
+  def _setup_remote(self, config, per_server_inputs, worker_options):
+    import dataclasses
+
     from ..channel import RemoteReceivingChannel
-    from ..sampler import NodeSamplerInput as NSI
-    from ..sampler import SamplingConfig, SamplingType
     from . import dist_client
     from .message import message_to_data
     self._message_to_data = message_to_data
     opts = worker_options
-    ranks = opts.server_rank if opts and opts.server_rank is not None \
-        else [0]
-    if isinstance(ranks, int):
-      ranks = [ranks]
-    self.server_ranks = list(ranks)
-    # hetero seeds: ('paper', ids) — the server's mp workers run the
-    # typed engine and stream HeteroData messages back (round 5); ship
-    # typed NodeSamplerInputs so the tuple convention (type FIRST)
-    # never hits CastMixin's positional cast
-    input_type, input_nodes = _split_input_type(input_nodes)
-    config = SamplingConfig(
-        SamplingType.NODE, _norm_num_neighbors(num_neighbors),
-        batch_size, shuffle, drop_last, with_edge, collect_features,
-        False, False, 'out', seed)
-    seeds = np.asarray(input_nodes).reshape(-1)
-    # split seeds across servers; each server samples its share
-    splits = np.array_split(seeds, len(self.server_ranks))
     self.producer_ids = []
     self._expected = 0
-    for rank, part in zip(self.server_ranks, splits):
-      part = NSI(part, input_type) if input_type is not None else part
+    for i, (rank, part) in enumerate(zip(self.server_ranks,
+                                         per_server_inputs)):
+      # fold the SERVER index into the seed: same-ranked mp workers on
+      # different servers would otherwise derive identical worker
+      # seeds and draw identical negative edges per batch index
+      # (negatives depend only on the graph + key)
+      cfg_i = dataclasses.replace(
+          config, seed=(config.seed or 0) * 7919 + i)
       pid = dist_client.request_server(
-          rank, 'create_sampling_producer', part, config,
+          rank, 'create_sampling_producer', part, cfg_i,
           opts.num_workers if opts else 1,
           worker_key=(opts.worker_key if opts else None))
       self.producer_ids.append(pid)
@@ -326,6 +318,14 @@ class RemoteDistNeighborLoader:
         self.server_ranks, self.producer_ids,
         prefetch_size=(opts.prefetch_size if opts else 4))
     self._dist_client = dist_client
+
+  def _resolve_ranks(self, worker_options):
+    opts = worker_options
+    ranks = opts.server_rank if opts and opts.server_rank is not None \
+        else [0]
+    if isinstance(ranks, int):
+      ranks = [ranks]
+    self.server_ranks = list(ranks)
 
   def __len__(self):
     return self._expected
@@ -357,6 +357,71 @@ class RemoteDistNeighborLoader:
         pass
 
 
+class RemoteDistNeighborLoader(_RemoteLoaderBase):
+  """Remote (server-client) NODE loading: producers run on sampling
+  servers, batches stream back over RPC; hetero seeds as
+  ('ntype', ids)."""
+
+  def __init__(self, num_neighbors, input_nodes,
+               batch_size: int = 64, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               collect_features: bool = True, worker_options=None,
+               seed: Optional[int] = None):
+    from ..sampler import NodeSamplerInput as NSI
+    from ..sampler import SamplingConfig, SamplingType
+    self._resolve_ranks(worker_options)
+    # hetero seeds: ('paper', ids) — the server's mp workers run the
+    # typed engine and stream HeteroData messages back (round 5); ship
+    # typed NodeSamplerInputs so the tuple convention (type FIRST)
+    # never hits CastMixin's positional cast
+    input_type, input_nodes = _split_input_type(input_nodes)
+    config = SamplingConfig(
+        SamplingType.NODE, _norm_num_neighbors(num_neighbors),
+        batch_size, shuffle, drop_last, with_edge, collect_features,
+        False, False, 'out', seed)
+    seeds = np.asarray(input_nodes).reshape(-1)
+    # split seeds across servers; each server samples its share
+    splits = np.array_split(seeds, len(self.server_ranks))
+    parts = [NSI(p, input_type) if input_type is not None else p
+             for p in splits]
+    self._setup_remote(config, parts, worker_options)
+
+
+class RemoteDistLinkNeighborLoader(_RemoteLoaderBase):
+  """Remote (server-client) LINK loading: seed edges split across the
+  sampling servers, whose mp workers draw negatives + run the (typed)
+  link engine; batches stream back with edge_label metadata. Hetero
+  seed edges as ((src_t, rel, dst_t), [2, E])."""
+
+  def __init__(self, num_neighbors, edge_label_index, edge_label=None,
+               neg_sampling=None, batch_size: int = 64,
+               shuffle: bool = False, drop_last: bool = False,
+               with_edge: bool = False, collect_features: bool = True,
+               worker_options=None, seed: Optional[int] = None):
+    from ..sampler import (EdgeSamplerInput, NegativeSampling,
+                           SamplingConfig, SamplingType)
+    self._resolve_ranks(worker_options)
+    edge_type, edge_label_index = _split_edge_type(edge_label_index)
+    ei = np.asarray(edge_label_index)
+    label = (np.asarray(edge_label).reshape(-1)
+             if edge_label is not None else None)
+    ns = (NegativeSampling.cast(neg_sampling)
+          if neg_sampling is not None else None)
+    config = SamplingConfig(
+        SamplingType.LINK, _norm_num_neighbors(num_neighbors),
+        batch_size, shuffle, drop_last, with_edge, collect_features,
+        ns is not None, False, 'out', seed)
+    nsrv = len(self.server_ranks)
+    row_s = np.array_split(ei[0].reshape(-1), nsrv)
+    col_s = np.array_split(ei[1].reshape(-1), nsrv)
+    lab_s = (np.array_split(label, nsrv) if label is not None
+             else [None] * nsrv)
+    parts = [EdgeSamplerInput(r, c, label=lb, input_type=edge_type,
+                              neg_sampling=ns)
+             for r, c, lb in zip(row_s, col_s, lab_s)]
+    self._setup_remote(config, parts, worker_options)
+
+
 class DistLinkNeighborLoader(DistLoader):
   """Distributed link-prediction loader: per-shard seed-edge blocks ->
   one SPMD link-sampling program (reference:
@@ -383,11 +448,7 @@ class DistLinkNeighborLoader(DistLoader):
       from .dist_context import get_context
       ctx = get_context()
       mesh = ctx.mesh if ctx else None
-    if isinstance(edge_label_index, tuple) and \
-        isinstance(edge_label_index[0], tuple):
-      input_type, edge_label_index = edge_label_index
-    else:
-      input_type = None
+    input_type, edge_label_index = _split_edge_type(edge_label_index)
     ei = np.asarray(edge_label_index)
     self.seed_rows = ei[0].reshape(-1)
     self.seed_cols = ei[1].reshape(-1)

@@ -506,3 +506,43 @@ def test_mp_dist_hetero_link_loader():
     assert batches == len(loader)
   finally:
     loader.shutdown()
+
+
+def test_server_client_link_end_to_end():
+  """Remote LINK loading (round 5): seed edges split across sampling
+  servers; producers draw negatives server-side and stream batches
+  with edge_label metadata back over RPC."""
+  from graphlearn_tpu.sampler import NegativeSampling
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  server = ctx.Process(target=_server_main, args=(q,))
+  server.start()
+  host, port = q.get(timeout=120)
+  glt.distributed.init_client(num_servers=1, num_clients=1,
+                              client_rank=0, server_addrs=[(host, port)])
+  opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+      server_rank=0, num_workers=2, prefetch_size=2)
+  rows = np.arange(N)
+  cols = (np.arange(N) + 1) % N
+  loader = glt.distributed.RemoteDistLinkNeighborLoader(
+      [2], np.stack([rows, cols]),
+      neg_sampling=NegativeSampling('binary', 1), batch_size=4,
+      collect_features=True, worker_options=opts, seed=0)
+  for epoch in range(2):
+    batches = 0
+    for batch in loader:
+      batches += 1
+      node = np.asarray(batch.node)
+      eli = np.asarray(batch.metadata['edge_label_index'])
+      label = np.asarray(batch.metadata['edge_label'])
+      npos = int((label == 1).sum())
+      assert npos > 0 and (label == 0).sum() > 0
+      for i in range(npos):   # positives decode to the ring edges
+        u = int(node[eli[0, i]])
+        v = int(node[eli[1, i]])
+        assert v == (u + 1) % N
+    assert batches == len(loader)
+  loader.shutdown()
+  glt.distributed.shutdown_client()
+  server.join(timeout=30)
+  assert not server.is_alive()
