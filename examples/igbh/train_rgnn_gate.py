@@ -22,7 +22,7 @@ segment convs; 'tree_dense' = computation-tree batches + dense k-run
 typed aggregation (TreeHeteroConv); 'merge_dense' = CALIBRATED
 per-(hop,etype) caps + dense k-run aggregation on exact merge batches
 (sampler.estimate_hetero_frontier_caps). Convs (--conv): sage / gat
-(RGNN) / hgt (HGT; segment + tree_dense).
+(RGNN) / hgt (HGT) — every conv supports all three modes.
 
 Prints ONE JSON line with test_acc_at per requested budget —
 benchmarks/hetero_accuracy_matrix.py drives the seeded mode matrix.

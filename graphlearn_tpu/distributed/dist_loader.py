@@ -38,19 +38,7 @@ def _norm_num_neighbors(num_neighbors):
           else list(num_neighbors))
 
 
-def _split_edge_type(edge_label_index):
-  """LinkLoader's typed seed-edge convention:
-  ``((src, rel, dst), [2, E])`` -> (etype, edges); anything else ->
-  (None, edges). The all-strings check keeps a homogeneous
-  (rows, cols) pair with exactly 3 edges from being misread as a
-  typed tuple."""
-  if isinstance(edge_label_index, tuple) and \
-      len(edge_label_index) == 2 and \
-      isinstance(edge_label_index[0], (tuple, list)) and \
-      len(edge_label_index[0]) == 3 and \
-      all(isinstance(s, str) for s in edge_label_index[0]):
-    return tuple(edge_label_index[0]), edge_label_index[1]
-  return None, edge_label_index
+from ..typing import split_edge_type_seeds as _split_edge_type  # noqa: E402
 
 
 class DistLoader(OverflowGuardMixin):

@@ -425,22 +425,9 @@ class DistNeighborSampler:
     if frontier_caps is None:
       self.frontier_caps = None
     elif self.is_hetero:
-      if not isinstance(frontier_caps, dict):
-        raise ValueError(
-            'list-form frontier_caps is homogeneous-only; hetero graphs '
-            'take a {edge_type: [per-hop caps]} dict '
-            '(calibrate.estimate_hetero_frontier_caps, per-SHARD seed '
-            'width)')
-      known = {tuple(et) for et in dist_graph.etypes}
-      fc = {}
-      for et, caps in frontier_caps.items():
-        et = tuple(et)
-        if et not in known:
-          raise ValueError(f'frontier_caps edge type {et!r} is not in '
-                           'the graph')
-        # None = no clamp at that hop (the plan skips it)
-        fc[et] = tuple(None if c is None else int(c) for c in caps)
-      self.frontier_caps = fc
+      from ..sampler.calibrate import normalize_hetero_frontier_caps
+      self.frontier_caps = normalize_hetero_frontier_caps(
+          frontier_caps, dist_graph.etypes)
     else:
       if isinstance(frontier_caps, dict):
         raise ValueError('dict-form frontier_caps is hetero-only; pass '
@@ -586,11 +573,8 @@ class DistNeighborSampler:
           continue
         if self.node_budget is not None:
           fcap = min(fcap, self.node_budget)
-        cap = fcap * fo[hop]
-        if etype_caps is not None:
-          ec = etype_caps.get(et)
-          if ec is not None and hop < len(ec) and ec[hop] is not None:
-            cap = min(cap, int(ec[hop]))
+        from ..sampler.calibrate import clamp_etype_cap
+        cap = clamp_etype_cap(etype_caps, et, hop, fcap * fo[hop])
         per_et[et] = (fcap, fo[hop], cap)
         adds[res_t] += cap
       hop_caps.append(per_et)

@@ -26,15 +26,9 @@ class LinkLoader(NodeLoader):
                collect_features: bool = True, to_device=None,
                seed: Optional[int] = None,
                overflow_policy: str = 'raise'):
-    if isinstance(edge_label_index, tuple) and len(edge_label_index) == 2 \
-        and isinstance(edge_label_index[0], (tuple, list)) \
-        and len(edge_label_index[0]) == 3 \
-        and all(isinstance(s, str) for s in edge_label_index[0]):
-      # str check: a homogeneous (rows, cols) pair with exactly 3 edges
-      # must not be misread as a typed seed tuple
-      self.edge_type, edge_label_index = edge_label_index
-    else:
-      self.edge_type = None
+    from ..typing import split_edge_type_seeds
+    self.edge_type, edge_label_index = \
+        split_edge_type_seeds(edge_label_index)
     eli = np.asarray(edge_label_index)
     self.rows, self.cols = eli[0].reshape(-1), eli[1].reshape(-1)
     self.edge_label = (np.asarray(edge_label).reshape(-1)

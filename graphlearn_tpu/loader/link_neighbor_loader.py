@@ -33,11 +33,9 @@ class LinkNeighborLoader(LinkLoader):
       if frontier_caps != 'auto':
         raise ValueError(f'frontier_caps={frontier_caps!r}: pass a list '
                          "of per-hop caps or 'auto'")
-      if isinstance(data.graph, dict) or (
-          isinstance(edge_label_index, tuple) and
-          len(edge_label_index) == 2 and
-          isinstance(edge_label_index[0], (tuple, list)) and
-          len(edge_label_index[0]) == 3):
+      from ..typing import split_edge_type_seeds
+      if isinstance(data.graph, dict) or \
+          split_edge_type_seeds(edge_label_index)[0] is not None:
         # hetero dataset, or an (etype, index) pair on LinkLoader's own
         # tuple convention — fail clearly, not with an AttributeError
         # inside estimate_frontier_caps

@@ -202,6 +202,41 @@ def estimate_hetero_frontier_caps(graph, num_neighbors, seed_caps,
           for et in etypes}
 
 
+def normalize_hetero_frontier_caps(frontier_caps, known_etypes) -> dict:
+  """Validate + normalize dict-form hetero caps to
+  ``{tuple(etype): tuple(int|None per hop)}`` — the ONE contract shared
+  by the local and distributed samplers (None = no clamp at that hop).
+  Raises the shared error messages on list-form caps or unknown edge
+  types."""
+  if not isinstance(frontier_caps, dict):
+    raise ValueError(
+        'list-form frontier_caps is homogeneous-only; hetero graphs '
+        'take a {edge_type: [per-hop caps]} dict '
+        '(calibrate.estimate_hetero_frontier_caps)')
+  known = {tuple(et) for et in known_etypes}
+  fc = {}
+  for et, caps in frontier_caps.items():
+    et = tuple(et)
+    if et not in known:
+      raise ValueError(f'frontier_caps edge type {et!r} is not in '
+                       'the graph')
+    fc[et] = tuple(None if c is None else int(c) for c in caps)
+  return fc
+
+
+def clamp_etype_cap(etype_caps, et, hop: int, worst: int) -> int:
+  """The per-(hop, edge-type) clamp rule shared by
+  ``hetero_capacity_plan`` and the distributed ``_hetero_plan`` — ONE
+  implementation so the engines' buffer plans can never desynchronize
+  from the layout helpers' offsets."""
+  if etype_caps is None:
+    return worst
+  ec = etype_caps.get(et)
+  if ec is not None and hop < len(ec) and ec[hop] is not None:
+    return min(worst, int(ec[hop]))
+  return worst
+
+
 def link_seed_width(batch_size: int, neg_sampling=None) -> int:
   """EFFECTIVE seed width of one link-loader batch: src + dst positives
   (2*batch_size) plus the negatives the sampler seeds alongside them

@@ -208,11 +208,8 @@ def hetero_capacity_plan(etypes, fanouts_of, seed_caps, edge_dir,
       fcap = frontier_cap.get(key_t, 0)
       if fcap == 0 or k == 0:
         continue
-      cap = fcap * k
-      if etype_caps is not None:
-        ec = etype_caps.get(et)
-        if ec is not None and hop < len(ec) and ec[hop] is not None:
-          cap = min(cap, int(ec[hop]))
+      from .calibrate import clamp_etype_cap
+      cap = clamp_etype_cap(etype_caps, et, hop, fcap * k)
       per_et[et] = (fcap, k, cap)
       adds[res_t] += cap
     hop_caps.append(per_et)
@@ -490,21 +487,9 @@ class NeighborSampler(BaseSampler):
     if frontier_caps is None:
       self.frontier_caps = None
     elif isinstance(graph, dict):
-      if not isinstance(frontier_caps, dict):
-        raise ValueError(
-            'list-form frontier_caps is homogeneous-only; hetero graphs '
-            'take a {edge_type: [per-hop caps]} dict '
-            '(calibrate.estimate_hetero_frontier_caps)')
-      known = {tuple(et) for et in graph}
-      fc = {}
-      for et, caps in frontier_caps.items():
-        et = tuple(et)
-        if et not in known:
-          raise ValueError(f'frontier_caps edge type {et!r} is not in '
-                           'the graph')
-        # None = no clamp at that hop (the plan skips it)
-        fc[et] = tuple(None if c is None else int(c) for c in caps)
-      self.frontier_caps = fc
+      from .calibrate import normalize_hetero_frontier_caps
+      self.frontier_caps = normalize_hetero_frontier_caps(
+          frontier_caps, graph)
     else:
       if isinstance(frontier_caps, dict):
         raise ValueError('dict-form frontier_caps is hetero-only; pass '

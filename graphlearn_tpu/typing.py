@@ -39,6 +39,22 @@ def to_edge_type(type_str: str) -> EdgeType:
   return tuple(parts)
 
 
+def split_edge_type_seeds(edge_label_index):
+  """The framework-wide typed seed-edge convention:
+  ``((src, rel, dst), [2, E])`` -> ``(etype, edges)``; anything else ->
+  ``(None, edges)``. ONE implementation for every link front-end
+  (local / mp / remote loaders). The all-strings check keeps a
+  homogeneous ``(rows, cols)`` pair with exactly 3 edges from being
+  misread as a typed tuple."""
+  if isinstance(edge_label_index, tuple) and \
+      len(edge_label_index) == 2 and \
+      isinstance(edge_label_index[0], (tuple, list)) and \
+      len(edge_label_index[0]) == 3 and \
+      all(isinstance(s, str) for s in edge_label_index[0]):
+    return tuple(edge_label_index[0]), edge_label_index[1]
+  return None, edge_label_index
+
+
 def reverse_edge_type(etype: EdgeType) -> EdgeType:
   """Reverse of an edge type: flips endpoints and toggles the 'rev_' prefix."""
   src, rel, dst = etype
