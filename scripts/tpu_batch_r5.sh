@@ -5,6 +5,15 @@
 # keeps going if an earlier stage fails. Findings land in PERF.md.
 #
 #   nohup bash scripts/tpu_batch_r5.sh > /tmp/r5_batch.log 2>&1 &
+#
+# Lockfile-guarded HERE (not in the poller) so manual and poller
+# launches can never double-run the chip; released on exit.
+LOCK=/tmp/glt_r5_batch.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "batch already running (lock $LOCK held); exiting"
+  exit 0
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
 set -x
 cd /root/repo
 

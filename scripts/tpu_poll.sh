@@ -2,8 +2,9 @@
 # Poll the axon relay ports (8082 session / 8083 devices) with bare TCP
 # connects — never via jax init, which hangs forever when the relay is
 # down (see PERF.md "TPU-host failure mode").  Appends a line to
-# /root/repo/.tpu_poll.log on each state change and EXITS once the
-# relay is up (one-shot recovery watch, not a persistent monitor).
+# /root/repo/.tpu_poll.log on each state change; once the relay is up,
+# LAUNCHES the round-5 measurement batch and exits (the batch script
+# holds its own lock, so manual launches can't double-run the chip).
 LOG=/root/repo/.tpu_poll.log
 prev=""
 while true; do
@@ -15,9 +16,14 @@ while true; do
     fi
   done
   if [ "$state" != "$prev" ]; then
-    echo "$(date -u +%FT%TZ) relay8083=$state" >> "$LOG"
+    echo "$(date -u +%FT%TZ) relay=$state" >> "$LOG"
     prev="$state"
   fi
-  [ "$state" = "up" ] && exit 0
+  if [ "$state" = "up" ]; then
+    echo "$(date -u +%FT%TZ) launching tpu_batch_r5" >> "$LOG"
+    nohup bash /root/repo/scripts/tpu_batch_r5.sh \
+        > /tmp/r5_batch.log 2>&1 &
+    exit 0
+  fi
   sleep 60
 done
