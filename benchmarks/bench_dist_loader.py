@@ -36,6 +36,13 @@ def main():
                        'worst-case capacities vs calibrated '
                        'frontier_caps (estimate_frontier_caps on the '
                        'host CSR) and report the step-time ratio')
+  ap.add_argument('--compare-hetero-calibrated', action='store_true',
+                  help='per mesh size, run the TYPED exact engine at '
+                       'worst-case capacities vs calibrated '
+                       'per-(hop,etype) caps '
+                       '(estimate_hetero_frontier_caps) on an '
+                       'IGBH-shaped typed graph and report the '
+                       'step-time ratio (round 5)')
   args = ap.parse_args()
 
   import jax
@@ -47,6 +54,10 @@ def main():
   sys.path.insert(0, __file__.rsplit('/', 2)[0])
   import graphlearn_tpu as glt
   from graphlearn_tpu.typing import GraphPartitionData
+
+  if args.compare_hetero_calibrated:
+    _compare_hetero(args, jax, glt, GraphPartitionData, Mesh)
+    return
 
   n = args.num_nodes
   rng = np.random.default_rng(0)
@@ -77,14 +88,8 @@ def main():
     dg = glt.distributed.DistGraph(p, 0, parts, node_pb)
     seeds = rng.integers(0, n, (p, args.batch_size)).astype(np.int32)
 
-    def timed(sampler):
-      outs = [sampler.sample_from_nodes(seeds) for _ in range(3)]
-      jax.block_until_ready([o.edge_mask for o in outs])
-      t0 = time.perf_counter()
-      outs = [sampler.sample_from_nodes(seeds)
-              for _ in range(args.iters)]
-      jax.block_until_ready([o.edge_mask for o in outs])
-      return time.perf_counter() - t0, outs[-1]
+    timed = _make_timed(jax, seeds, args.iters,
+                        lambda o: o.edge_mask)
 
     if args.compare_calibrated:
       from graphlearn_tpu.sampler.calibrate import estimate_frontier_caps
@@ -120,6 +125,95 @@ def main():
         'value': round(args.iters * p / dt, 2),
         'seeds_per_sec': round(args.iters * p * args.batch_size / dt, 1),
         'secs': round(dt, 4),
+        'backend': jax.default_backend(),
+    }), flush=True)
+
+
+def _make_timed(jax, seeds, iters, ready_of):
+  """Shared warmup+measure closure: ONE timing protocol for the homo
+  and hetero comparisons (a drift here would skew the PERF.md
+  speedup tables against each other)."""
+
+  def timed(sampler):
+    outs = [sampler.sample_from_nodes(seeds) for _ in range(3)]
+    jax.block_until_ready([ready_of(o) for o in outs])
+    t0 = time.perf_counter()
+    outs = [sampler.sample_from_nodes(seeds) for _ in range(iters)]
+    jax.block_until_ready([ready_of(o) for o in outs])
+    return time.perf_counter() - t0, outs[-1]
+
+  return timed
+
+
+def _compare_hetero(args, jax, glt, GraphPartitionData, Mesh):
+  """Typed worst-case vs calibrated per-(hop, etype) caps on the
+  sharded engine — the hetero counterpart of --compare-calibrated
+  (whose homo CPU-mesh ratio was 3.65x at the products config,
+  PERF.md round 4). 3 typed hops: where the worst case compounds
+  ACROSS etypes every hop."""
+  n_p = args.num_nodes
+  n_a = n_p // 2
+  rng = np.random.default_rng(0)
+  CITES = ('paper', 'cites', 'paper')
+  WRITES = ('author', 'writes', 'paper')
+  REV = ('paper', 'rev_writes', 'author')
+  e_c = n_p * args.avg_deg
+  c_rows = rng.integers(0, n_p, e_c)
+  c_cols = np.empty(e_c, np.int64)
+  c_cols[:e_c // 2] = rng.integers(0, n_p, e_c // 2)
+  c_cols[e_c // 2:] = rng.zipf(1.5, e_c - e_c // 2) % n_p
+  e_w = n_a * max(args.avg_deg // 3, 2)
+  w_rows = rng.integers(0, n_a, e_w)
+  w_cols = rng.zipf(1.5, e_w) % n_p
+  edges = {CITES: (c_rows, c_cols), WRITES: (w_rows, w_cols),
+           REV: (w_cols, w_rows)}
+  fan = {et: list(args.fanout) for et in edges}
+  host = {et: glt.data.Graph(
+      glt.data.Topology(np.stack([r, c]),
+                        num_nodes=(n_a if et[0] == 'author' else n_p)),
+      'CPU') for et, (r, c) in edges.items()}
+  caps = glt.sampler.estimate_hetero_frontier_caps(
+      host, fan, {'paper': args.batch_size}, num_probes=4, slack=1.5)
+
+  for p in [int(x) for x in args.mesh_sizes.split(',')]:
+    if p > len(jax.devices()):
+      continue
+    pb_p = {t: (v % p).astype(np.int32) for t, v in
+            (('paper', np.arange(n_p)), ('author', np.arange(n_a)))}
+    parts = []
+    for q in range(p):
+      part = {}
+      for et, (r, c) in edges.items():
+        key_pb = pb_p[et[0]]
+        m = key_pb[r] == q
+        part[et] = GraphPartitionData(
+            edge_index=np.stack([r[m], c[m]]),
+            eids=np.flatnonzero(m))
+      parts.append(part)
+    mesh = Mesh(np.array(jax.devices()[:p]), ('g',))
+    dg = glt.distributed.DistHeteroGraph(p, 0, parts, pb_p)
+    seeds = rng.integers(0, n_p, (p, args.batch_size)).astype(np.int32)
+    timed = _make_timed(jax, ('paper', seeds), args.iters,
+                        lambda o: list(o.edge_mask.values()))
+
+    full = glt.distributed.DistNeighborSampler(
+        dg, fan, mesh, seed=0, dedup='merge')
+    cal = glt.distributed.DistNeighborSampler(
+        dg, fan, mesh, seed=0, dedup='merge', frontier_caps=caps)
+    dt_full, _ = timed(full)
+    dt_cal, out = timed(cal)
+    _, _, nc_full = full._hetero_plan({'paper': args.batch_size})
+    _, _, nc_cal = cal._hetero_plan({'paper': args.batch_size})
+    print(json.dumps({
+        'metric': 'dist_hetero_calibrated_speedup',
+        'mesh_size': p,
+        'value': round(dt_full / dt_cal, 3),
+        'full_ms_per_step': round(1e3 * dt_full / args.iters, 2),
+        'calibrated_ms_per_step': round(1e3 * dt_cal / args.iters, 2),
+        'node_caps_full': {t: int(v) for t, v in nc_full.items()},
+        'node_caps_calibrated': {t: int(v) for t, v in nc_cal.items()},
+        'caps': {'/'.join(et): list(v) for et, v in caps.items()},
+        'overflow': bool(np.any(np.asarray(out.metadata['overflow']))),
         'backend': jax.default_backend(),
     }), flush=True)
 

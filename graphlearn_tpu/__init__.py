@@ -14,10 +14,22 @@ import os as _os
 # must run BEFORE first backend use. Without this, subprocesses
 # launched with JAX_PLATFORMS=cpu (tests, example smokes) silently
 # attach to the accelerator — or hang when it is unreachable.
+# NEVER override an EXPLICIT jax.config choice though: a caller that
+# ran jax.config.update('jax_platforms', 'cpu') before importing this
+# package chose deliberately, and resetting it from the env (= 'axon'
+# on the rig) would re-point the next backend init at the tunnel —
+# a hang when the relay is down (round-5 bench_dist_loader bug).
 if _os.environ.get('JAX_PLATFORMS'):
   try:
     import jax as _jax
-    _jax.config.update('jax_platforms', _os.environ['JAX_PLATFORMS'])
+    # the axon plugin installs jax_platforms='axon,cpu' at interpreter
+    # start (register/pjrt.py), so that value (or unset) means "nobody
+    # chose yet" — apply the env var. Any OTHER value is an explicit
+    # caller choice (e.g. jax.config.update('jax_platforms', 'cpu')
+    # before importing this package) and must never be clobbered back
+    # to the tunnel — a hang when the relay is down.
+    if _jax.config.jax_platforms in (None, 'axon,cpu'):
+      _jax.config.update('jax_platforms', _os.environ['JAX_PLATFORMS'])
   except (ImportError, RuntimeError):
     pass   # backend already initialized (config then already applied)
 

@@ -21,8 +21,10 @@ while true; do
   fi
   if [ "$state" = "up" ]; then
     echo "$(date -u +%FT%TZ) launching tpu_batch_r5" >> "$LOG"
+    # APPEND: a concurrent manual batch writes the same log; O_TRUNC
+    # here would corrupt a live multi-hour measurement trace
     nohup bash /root/repo/scripts/tpu_batch_r5.sh \
-        > /tmp/r5_batch.log 2>&1 &
+        >> /tmp/r5_batch.log 2>&1 &
     exit 0
   fi
   sleep 60
