@@ -22,13 +22,17 @@ import os as _os
 if _os.environ.get('JAX_PLATFORMS'):
   try:
     import jax as _jax
-    # the axon plugin installs jax_platforms='axon,cpu' at interpreter
-    # start (register/pjrt.py), so that value (or unset) means "nobody
-    # chose yet" — apply the env var. Any OTHER value is an explicit
-    # caller choice (e.g. jax.config.update('jax_platforms', 'cpu')
-    # before importing this package) and must never be clobbered back
-    # to the tunnel — a hang when the relay is down.
-    if _jax.config.jax_platforms in (None, 'axon,cpu'):
+    # The axon plugin installs an axon-containing jax_platforms value
+    # at interpreter start ('axon,cpu' today, register/pjrt.py), so an
+    # unset or axon-containing value means "the tunnel is still the
+    # default" — apply the env var (robust to the plugin renaming its
+    # default, unlike an exact-string match). Any explicit NON-axon
+    # value is a deliberate caller choice (e.g.
+    # jax.config.update('jax_platforms', 'cpu') before importing this
+    # package) and must never be clobbered back to the tunnel — a hang
+    # when the relay is down.
+    _cur = _jax.config.jax_platforms
+    if _cur is None or 'axon' in _cur:
       _jax.config.update('jax_platforms', _os.environ['JAX_PLATFORMS'])
   except (ImportError, RuntimeError):
     pass   # backend already initialized (config then already applied)

@@ -1135,3 +1135,55 @@ def test_dist_hetero_calibrated_caps():
   with pytest.raises(ValueError, match='homogeneous-only'):
     glt.distributed.DistNeighborSampler(dg, fanouts, mesh, dedup='merge',
                                         frontier_caps=[4, 4])
+
+
+def test_dist_hetero_link_calibrated_caps():
+  """Distributed hetero LINK sampling under dict-form calibrated caps:
+  the typed link plan (multi-type seed widths) threads the clamps;
+  worst-case caps are byte-identical to uncapped; results carry the
+  replicated overflow flag."""
+  from graphlearn_tpu.sampler import EdgeSamplerInput, NegativeSampling
+  num_parts = 2
+  parts, _, node_pb, (et1, et2) = hetero_ring_fixture(num_parts)
+  mesh = make_mesh(num_parts)
+  dg = glt.distributed.DistHeteroGraph(num_parts, 0, parts, node_pb)
+  fan = {et1: [2], et2: [1]}
+  rows = np.array([[0, 4], [1, 5]], np.int32)
+  cols = rows.copy()   # u_i -> v_i are real et1 edges
+  inp = lambda: EdgeSamplerInput(
+      rows, cols, input_type=et1,
+      neg_sampling=NegativeSampling('binary', 1))
+
+  base = glt.distributed.DistNeighborSampler(dg, fan, mesh, seed=0,
+                                             dedup='merge')
+  # the link plan seeds BOTH endpoint types (binary adds negatives):
+  # take the worst-case caps from the engine's own plan
+  o1 = base.sample_from_edges(inp())
+  _, hop_caps, _ = base._hetero_plan(
+      {'u': 2 + 2, 'v': 2 + 2})   # b + num_neg per endpoint type
+  worst = {}
+  for h, per in enumerate(hop_caps):
+    for et, (fcap, k, cap) in per.items():
+      worst.setdefault(et, [0] * len(hop_caps))[h] = cap
+  capped = glt.distributed.DistNeighborSampler(
+      dg, fan, mesh, seed=0, dedup='merge', frontier_caps=worst)
+  o2 = capped.sample_from_edges(inp())
+  assert not bool(np.any(np.asarray(o2.metadata['overflow'])))
+  for t in o1.node:
+    np.testing.assert_array_equal(np.asarray(o1.node[t]),
+                                  np.asarray(o2.node[t]))
+  np.testing.assert_array_equal(
+      np.asarray(o1.metadata['edge_label_index']),
+      np.asarray(o2.metadata['edge_label_index']))
+
+  tiny = {et1: [1], et2: [1]}
+  s_tiny = glt.distributed.DistNeighborSampler(
+      dg, fan, mesh, seed=0, dedup='merge', frontier_caps=tiny)
+  o3 = s_tiny.sample_from_edges(inp())
+  assert bool(np.any(np.asarray(o3.metadata['overflow'])))
+  for t in o3.node:   # clamped results stay exact-dedup per shard
+    node = np.asarray(o3.node[t])
+    nn = np.asarray(o3.num_nodes[t])
+    for p in range(num_parts):
+      valid = node[p][:int(nn[p])]
+      assert len(set(valid.tolist())) == len(valid)
