@@ -22,17 +22,17 @@ import os as _os
 if _os.environ.get('JAX_PLATFORMS'):
   try:
     import jax as _jax
-    # The axon plugin installs an axon-containing jax_platforms value
-    # at interpreter start ('axon,cpu' today, register/pjrt.py), so an
-    # unset or axon-containing value means "the tunnel is still the
-    # default" — apply the env var (robust to the plugin renaming its
-    # default, unlike an exact-string match). Any explicit NON-axon
-    # value is a deliberate caller choice (e.g.
-    # jax.config.update('jax_platforms', 'cpu') before importing this
-    # package) and must never be clobbered back to the tunnel — a hang
-    # when the relay is down.
-    _cur = _jax.config.jax_platforms
-    if _cur is None or 'axon' in _cur:
+    # Apply the env var ONLY over the axon plugin's own installed
+    # default (exactly 'axon,cpu' today — register/pjrt.py:86) or an
+    # unset config. Anything else is an explicit caller choice —
+    # including an explicit 'axon' — and is preserved: clobbering an
+    # explicit CPU selection back to the tunnel hangs when the relay
+    # is down, and clobbering an explicit axon selection to CPU
+    # silently drops the accelerator. If the plugin ever renames its
+    # installed default, update the literal below (symptom: env
+    # JAX_PLATFORMS stops applying and CPU subprocesses dial the
+    # tunnel — conftest's direct jax.config path stays unaffected).
+    if _jax.config.jax_platforms in (None, 'axon,cpu'):
       _jax.config.update('jax_platforms', _os.environ['JAX_PLATFORMS'])
   except (ImportError, RuntimeError):
     pass   # backend already initialized (config then already applied)

@@ -1084,6 +1084,18 @@ def test_dist_hier_exchange_skewed_fallback_s4():
     assert len(set(node[p][:nn].tolist())) == nn
 
 
+def worst_caps_from_plan(hop_caps):
+  """{etype: [per-hop worst-case cap]} from an engine's own plan —
+  caps at exactly the worst case make the clamped engine a structural
+  no-op (shared by the node and link dist hetero caps tests)."""
+  worst = {}
+  for h, per in enumerate(hop_caps):
+    for et, (fcap, k, cap) in per.items():
+      assert cap == fcap * k
+      worst.setdefault(et, [0] * len(hop_caps))[h] = cap
+  return worst
+
+
 def test_dist_hetero_calibrated_caps():
   """Dict-form calibrated caps on the DISTRIBUTED typed engine
   (round-5 parity with the local hetero clamps): caps at the plan's own
@@ -1101,11 +1113,7 @@ def test_dist_hetero_calibrated_caps():
   base = glt.distributed.DistNeighborSampler(dg, fanouts, mesh, seed=0,
                                              dedup='merge')
   _, hop_caps, _ = base._hetero_plan({'u': 2})
-  worst = {}
-  for h, per in enumerate(hop_caps):
-    for et, (fcap, k, cap) in per.items():
-      assert cap == fcap * k
-      worst.setdefault(et, [0] * len(hop_caps))[h] = cap
+  worst = worst_caps_from_plan(hop_caps)
   capped = glt.distributed.DistNeighborSampler(
       dg, fanouts, mesh, seed=0, dedup='merge', frontier_caps=worst)
   o1 = base.sample_from_nodes(('u', seeds))
@@ -1161,10 +1169,7 @@ def test_dist_hetero_link_calibrated_caps():
   o1 = base.sample_from_edges(inp())
   _, hop_caps, _ = base._hetero_plan(
       {'u': 2 + 2, 'v': 2 + 2})   # b + num_neg per endpoint type
-  worst = {}
-  for h, per in enumerate(hop_caps):
-    for et, (fcap, k, cap) in per.items():
-      worst.setdefault(et, [0] * len(hop_caps))[h] = cap
+  worst = worst_caps_from_plan(hop_caps)
   capped = glt.distributed.DistNeighborSampler(
       dg, fan, mesh, seed=0, dedup='merge', frontier_caps=worst)
   o2 = capped.sample_from_edges(inp())

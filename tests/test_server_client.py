@@ -546,3 +546,43 @@ def test_server_client_link_end_to_end():
   glt.distributed.shutdown_client()
   server.join(timeout=30)
   assert not server.is_alive()
+
+
+def test_server_client_hetero_link_end_to_end():
+  """Remote HETERO LINK loading: typed seed edges ship to the server
+  inside EdgeSamplerInputs, its mp workers run the typed link engine,
+  and HeteroData batches with label metadata stream back — the
+  composition of the round-5 remote link + mp hetero link paths."""
+  from graphlearn_tpu.sampler import NegativeSampling
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  server = ctx.Process(target=_hetero_server_main, args=(q,))
+  server.start()
+  host, port = q.get(timeout=120)
+  glt.distributed.init_client(num_servers=1, num_clients=1,
+                              client_rank=0, server_addrs=[(host, port)])
+  opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+      server_rank=0, num_workers=2, prefetch_size=2)
+  ub = np.array([[0, 0, 1, 2, 2, 3, 4, 5], [0, 1, 2, 3, 0, 1, 2, 3]])
+  pos = {(int(r), int(c)) for r, c in zip(ub[0], ub[1])}
+  loader = glt.distributed.RemoteDistLinkNeighborLoader(
+      {('user', 'buys', 'item'): [2], ('item', 'rev_buys', 'user'): [2]},
+      (('user', 'buys', 'item'), ub),
+      neg_sampling=NegativeSampling('binary', 1), batch_size=4,
+      collect_features=True, worker_options=opts, seed=0)
+  batches = 0
+  for batch in loader:
+    batches += 1
+    eli = np.asarray(batch.metadata['edge_label_index'])
+    label = np.asarray(batch.metadata['edge_label'])
+    user = np.asarray(batch.node['user'])
+    item = np.asarray(batch.node['item'])
+    npos = int((label == 1).sum())
+    assert npos > 0 and (label == 0).sum() > 0
+    for i in range(npos):
+      assert (int(user[eli[0, i]]), int(item[eli[1, i]])) in pos
+  assert batches == len(loader)
+  loader.shutdown()
+  glt.distributed.shutdown_client()
+  server.join(timeout=30)
+  assert not server.is_alive()
