@@ -42,6 +42,11 @@ def main():
   ap.add_argument('--modes', default=None,
                   help='comma-separated substrings selecting a subset '
                        'of MODES (default: all)')
+  ap.add_argument('--extra', default='',
+                  help='extra args passed through to the gate script, '
+                       "e.g. '--batch-size 256' (reduced-scale CPU "
+                       'runs need more steps/epoch than the default '
+                       'products batch gives)')
   args = ap.parse_args()
   budgets = sorted(int(x) for x in args.epochs_list.split(','))
   modes = MODES
@@ -58,7 +63,8 @@ def main():
             '--eval-epochs', ','.join(str(e) for e in budgets
                                       if e < emax),
             '--eval-batches', str(args.eval_batches),
-            '--seed', str(seed), '--bf16-model'] + extra_of[cell[0]]
+            '--seed', str(seed), '--bf16-model'] + extra_of[cell[0]] + \
+        args.extra.split()
 
   results = matrix_driver.drive(cells, cmd_for, budgets, args.seeds)
   matrix_driver.report(cells, results, budgets, ('mode',))
