@@ -589,6 +589,109 @@ def main():
   except Exception as e:                        # never break the headline
     result['train_step_error'] = f'{type(e).__name__}: {e}'[:200]
 
+  # ---- scanned epoch: epoch-as-a-program (loader/scan_epoch.py) -----
+  # The dispatch tax is the wall-clock story on this rig (PERF.md), so
+  # report the ScanTrainer epoch's WALL time, DEVICE-TRACE time and
+  # dispatch count side by side with epoch_time_s: the subsystem's claim
+  # is wall -> device-trace at ~ceil(steps/K) dispatches. Graceful on
+  # CPU: the trace has no TPU lanes there, so the device keys stay null.
+  try:
+    from graphlearn_tpu.models import GraphSAGE
+    from graphlearn_tpu.models import train as train_lib
+    from graphlearn_tpu.utils import count_dispatches
+    # overflow_policy='off': the guard's epoch-end flag fetch is a
+    # device->host sync, and the FIRST fetch permanently degrades later
+    # dispatches on the axon runtime (PERF.md fetch rules)
+    scan_loader = glt.loader.NeighborLoader(
+        ds, FANOUT, train_idx, batch_size=BATCH, shuffle=True,
+        drop_last=True, seed=0, dedup='map', frontier_caps=cal_caps,
+        seed_labels_only=True, overflow_policy='off')
+    no_s, eo_s = train_lib.merge_hop_offsets(BATCH, FANOUT,
+                                             frontier_caps=cal_caps)
+    scan_model = GraphSAGE(hidden_dim=E2E_HIDDEN, out_dim=E2E_CLASSES,
+                           num_layers=len(FANOUT), hop_node_offsets=no_s,
+                           hop_edge_offsets=eo_s, dtype=jnp.bfloat16,
+                           merge_dense=True, fanouts=tuple(FANOUT))
+    tmpl_loader = glt.loader.NeighborLoader(
+        ds, FANOUT, train_idx[:BATCH], batch_size=BATCH, seed=0,
+        dedup='map', frontier_caps=cal_caps, seed_labels_only=True,
+        overflow_policy='off')
+    first = train_lib.batch_to_dict(next(iter(tmpl_loader)))
+    sstate, stx = train_lib.create_train_state(
+        scan_model, jax.random.PRNGKey(0), first)
+    scan_k = 8
+    trainer = glt.loader.ScanTrainer(scan_loader, scan_model, stx,
+                                     E2E_CLASSES, chunk_size=scan_k)
+    sstate, losses, _ = trainer.run_epoch(sstate)      # compile epoch
+    jax.block_until_ready(losses)
+    with count_dispatches() as dc:
+      t0 = time.perf_counter()
+      sstate, losses, _ = trainer.run_epoch(sstate)
+      jax.block_until_ready(losses)
+      scan_wall = time.perf_counter() - t0
+    scan_steps = int(losses.shape[0])
+    steps_products = PRODUCTS_TRAIN_SEEDS // BATCH
+    # epoch_dispatches is MEASURED on this bench's scan_epoch_steps-step
+    # epoch; the products-scale figure at the same K is the _est key
+    result['epoch_dispatches'] = dc.total
+    result['epoch_dispatches_products_est'] = \
+        -(-steps_products // scan_k) + 2
+    result['scan_epoch_steps'] = scan_steps
+    result['scan_epoch_chunk'] = scan_k
+    result['scan_epoch_wall_s'] = round(scan_wall, 3)
+    td = '/tmp/glt_bench_scan_epoch'
+    shutil.rmtree(td, ignore_errors=True)
+    jax.profiler.start_trace(td)
+    sstate, losses, _ = trainer.run_epoch(sstate)
+    jax.block_until_ready(losses)
+    jax.profiler.stop_trace()
+    sprogs = _device_program_ms(td)
+    if sprogs:
+      # split per-step work (the scan chunks) from per-EPOCH fixed cost
+      # (seed-permutation prologue, metrics concat): only the former
+      # scales with the products step count — keeps the estimate on the
+      # same per-step basis as epoch_time_s
+      chunk_ms = sum(ms * cnt for n_, (ms, cnt) in sprogs.items()
+                     if 'scan_epoch_chunk' in n_)
+      fixed_ms = sum(ms * cnt for n_, (ms, cnt) in sprogs.items()
+                     if 'scan_epoch_chunk' not in n_)
+      result['scan_epoch_device_trace_s'] = round(
+          (chunk_ms + fixed_ms) / 1e3, 3)
+      result['epoch_time_s_scanned'] = round(
+          (chunk_ms / scan_steps * steps_products + fixed_ms) / 1e3, 3)
+    else:
+      result['scan_epoch_device_trace_s'] = None
+      result['epoch_time_s_scanned'] = None
+  except Exception as e:
+    result['scan_epoch_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- RUN_MEAN_IMPL A/B (the prof_copytax.py decision, VERDICT r5):
+  # emit both impls' e2e step ms as bench keys so the next on-chip run
+  # DECIDES the models.RUN_MEAN_IMPL default instead of staying stalled
+  # behind a manual probe run.
+  try:
+    from graphlearn_tpu.models import models as models_lib
+    prev_impl = models_lib.RUN_MEAN_IMPL
+    try:
+      # per-impl isolation: reduce_window's vjp asserts on jax 0.4.x
+      # (this container), so a 'window' failure must not take the
+      # 'reshape' number down with it — the pair is the decision input
+      for impl in ('reshape', 'window'):
+        key = f'run_mean_impl_{impl}_ms'
+        try:
+          models_lib.RUN_MEAN_IMPL = impl
+          tot_i, _ = _run_e2e(ds, train_idx, jnp.bfloat16, jax,
+                              f'/tmp/glt_bench_copytax_{impl}',
+                              variant='exact', cal_caps=cal_caps)
+          result[key] = round(float(tot_i), 3) if tot_i else None
+        except Exception as e:
+          result[key] = None
+          result[f'{key}_error'] = f'{type(e).__name__}: {e}'[:200]
+    finally:
+      models_lib.RUN_MEAN_IMPL = prev_impl
+  except Exception as e:
+    result['run_mean_impl_error'] = f'{type(e).__name__}: {e}'[:200]
+
   # ---- hetero (IGBH-shaped RGNN/RGAT) train step --------------------
   try:
     for conv, key in (('sage', 'hetero_rgnn'), ('gat', 'hetero_rgat')):

@@ -39,5 +39,8 @@ if _os.environ.get('JAX_PLATFORMS'):
 
 from . import (channel, data, distributed, loader, models, ops, partition,
                sampler, typing, utils)
+# the epoch executors are the package's training entry points — exported
+# at the root alongside their loader-submodule homes
+from .loader import OverlappedTrainer, ScanTrainer
 
 __version__ = '0.1.0'

@@ -26,6 +26,7 @@ class SeedBatcher:
     self.batch_size = batch_size
     self.shuffle = shuffle
     self.drop_last = drop_last
+    self.seed = seed   # kept: ScanTrainer derives its device perm key
     self._rng = np.random.default_rng(seed)
     # mid-epoch resume bookkeeping (see state_dict below)
     self._epoch_start_state = self._rng.bit_generator.state
@@ -353,6 +354,8 @@ class NodeLoader(OverflowGuardMixin):
       edt = self.data.edge_features.device_table()
       if edt is not None:
         efeats = edt[0]
+    from ..utils.trace import record_dispatch
+    record_dispatch('collate')
     res = ops.collate_batch(out.node, out.num_nodes, out.row, out.col,
                             feats, id2i, self._label_table(), efeats,
                             out.edge,

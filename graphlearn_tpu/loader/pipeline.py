@@ -40,25 +40,29 @@ _RECOMPUTE_MSG = (
     'accumulates on device and is checked once at epoch end).')
 
 
-class OverlappedTrainer:
-  """Fuses batch n's train step with batch n+1's sample+collate.
+class FusedEpochTrainer:
+  """Shared plumbing for the fused epoch executors (OverlappedTrainer,
+  scan_epoch.ScanTrainer): scope validation, the device feature/label
+  tables, and the pure sample+collate body both trainers trace into
+  their programs.
 
   Requirements: homogeneous graph, fused sampler, device-resident
-  feature/label tables, no edge features (the overlapped program keeps
-  the reference fast path's scope: supervised node classification).
+  feature/label tables, no edge features (the fused programs keep the
+  reference fast path's scope: supervised node classification).
   """
+
+  _NAME = 'FusedEpochTrainer'
 
   def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
                seed_labels_only: Optional[bool] = None):
-    import jax
     sampler = loader.sampler
     if getattr(sampler, 'is_hetero', False):
-      raise ValueError('OverlappedTrainer is homogeneous-only')
+      raise ValueError(f'{self._NAME} is homogeneous-only')
     if not sampler.fused:
-      raise ValueError('OverlappedTrainer needs the fused sampler path')
+      raise ValueError(f'{self._NAME} needs the fused sampler path')
     if sampler.with_edge:
       raise ValueError('with_edge batches are not supported in the '
-                       'overlapped program')
+                       'fused epoch programs')
     if getattr(sampler, 'clamped_exact', False) and \
         loader.overflow_policy == 'recompute':
       raise ValueError(_RECOMPUTE_MSG)
@@ -76,18 +80,17 @@ class OverlappedTrainer:
     dt = loader.data.node_features.device_table() \
         if loader.data.node_features is not None else None
     if dt is None:
-      raise ValueError('OverlappedTrainer needs a device-resident '
+      raise ValueError(f'{self._NAME} needs a device-resident '
                        'feature table (Feature on HBM)')
     self._feats, self._id2i = dt
     self._labels = loader._label_table()
     if self._labels is None:
-      raise ValueError('OverlappedTrainer needs node labels')
+      raise ValueError(f'{self._NAME} needs node labels')
 
     from ..models import train as train_lib
     self._train_step, _ = train_lib.make_train_step(model, tx, num_classes)
 
     sample_fn, label_cap = self._sample_fn, self._label_cap
-    train_step = self._train_step
 
     def _sample_collate(fargs, feats, id2i, labels, seeds, smask, key):
       res = sample_fn(*fargs, seeds, smask, key)
@@ -100,6 +103,22 @@ class OverlappedTrainer:
       # the calibrated-caps truncation flag rides OUTSIDE the batch dict
       # (train_step must not see it; the batch buffers are donated)
       return batch, res['overflow']
+
+    self._sample_collate = _sample_collate
+
+
+class OverlappedTrainer(FusedEpochTrainer):
+  """Fuses batch n's train step with batch n+1's sample+collate."""
+
+  _NAME = 'OverlappedTrainer'
+
+  def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
+               seed_labels_only: Optional[bool] = None):
+    import jax
+    super().__init__(loader, model, tx, num_classes, seed_labels_only)
+
+    _sample_collate = self._sample_collate
+    train_step = self._train_step
 
     def _fused(state, batch, ovf, pending, fargs, feats, id2i, labels,
                seeds, smask, key):
@@ -131,6 +150,8 @@ class OverlappedTrainer:
 
   def _dispatch_prime(self, padded, mask):
     import jax.numpy as jnp
+    from ..utils.trace import record_dispatch
+    record_dispatch('prime')
     return self._prime_fn(self._sampler._fused_args(), self._feats,
                           self._id2i, self._labels, jnp.asarray(padded),
                           jnp.asarray(mask), self._sampler._next_key())
@@ -140,6 +161,7 @@ class OverlappedTrainer:
     ``losses`` a list of device scalars (one per step) — fetch once,
     after the epoch, to keep the hot loop pipelined."""
     import jax.numpy as jnp
+    from ..utils.trace import record_dispatch
     # _seed_batches walks loader._batcher directly (bypassing
     # NodeLoader.__iter__), so the per-epoch padded-table reseed must be
     # driven explicitly — same counter as plain iteration
@@ -160,6 +182,7 @@ class OverlappedTrainer:
       if batch is None:
         batch, pending = self._dispatch_prime(padded, mask)
         continue
+      record_dispatch('fused_step')
       state, loss, _, batch, ovf, pending = self._fused_fn(
           state, batch, ovf, pending, self._sampler._fused_args(),
           self._feats, self._id2i, self._labels, jnp.asarray(padded),
@@ -173,6 +196,7 @@ class OverlappedTrainer:
       # train step. A max_steps break drops the pending batch instead —
       # exactly max_steps optimizer updates, step-exact for benchmarks
       # and LR schedules.
+      record_dispatch('train_step')
       state, loss, _ = self._train_step(state, batch)
       losses.append(loss)
       ovf = jnp.logical_or(ovf, pending)

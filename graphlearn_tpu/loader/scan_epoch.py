@@ -1,0 +1,251 @@
+"""Epoch-as-a-program: scanned K-step sample -> collate -> train execution.
+
+PERF.md establishes that on this rig the per-step DISPATCH is the dominant
+wall-clock tax: device trace and wall clock diverge by 100-1000x once any
+fetch lands, which is why `OverlappedTrainer` already collapsed 3
+dispatches/step to 1. But an epoch is still ~steps dispatches plus
+per-step host numpy (seed padding). The reference hides sampling latency
+with producer processes/streams (dist_sampling_producer.py); on TPU the
+native answer is to put the LOOP ITSELF on device: `ScanTrainer` executes
+an epoch as ~ceil(steps/K) dispatches — a `lax.scan` over a static chunk
+of K steps whose body is the existing fused sample+collate+train program
+(`pipeline.FusedEpochTrainer` plumbing).
+
+Design points:
+  * The epoch's seed permutation is drawn ON DEVICE
+    (`jax.random.permutation` over the input-seed array, reshaped to
+    [steps, B] with a validity mask for the ragged tail). The host
+    `SeedBatcher` remains the shuffle=False / mid-epoch-resume path; the
+    device permutation is a different (but equally uniform) stream.
+  * PRNG keys are derived INSIDE the scan body via
+    `fold_in(base_key, count)` with the same host-counter discipline as
+    `NeighborSampler._next_key` — global step g uses
+    count = call_count_at_epoch_start + 1 + g, so a shuffle=False scanned
+    epoch replays the per-step loader loop's draws EXACTLY (equivalence-
+    tested), and the sampler's counter is advanced afterwards so later
+    sampling continues the same stream.
+  * Losses/accuracies come back as [K] scan outputs; the calibrated-caps
+    overflow flag accumulates in the carry — zero host syncs inside the
+    epoch. overflow_policy='recompute' is rejected exactly like
+    `OverlappedTrainer` (it needs a per-batch host sync).
+  * The train state is DONATED across chunk dispatches, so HBM stays
+    flat at one state + one in-flight chunk. The state passed INTO
+    run_epoch is consumed — use the returned state.
+
+Dispatch budget per epoch: ceil(steps/K) chunk programs + 1 seed-matrix
+program + 1 loss/acc concatenation = ceil(steps/K) + 2
+(tests/test_scan_epoch.py asserts it via utils.count_dispatches).
+
+Composes with every fused fast path (tree/block/padded sampling,
+tree_dense / merge_dense models, seed_labels_only); the per-epoch
+padded-table reseed runs between epochs like the plain loader
+(`NodeLoader._begin_epoch`), and `_fused_args()` is re-fetched each epoch
+so the chunks see the fresh table. On CPU the same programs run
+unchanged (donation is a no-op there); only the dispatch-tax WIN
+disappears, not correctness.
+
+Usage:
+    loader = NeighborLoader(ds, fanouts, idx, batch_size=B, shuffle=True,
+                            drop_last=True, ...)
+    trainer = ScanTrainer(loader, model, tx, num_classes, chunk_size=32)
+    state, losses, accs = trainer.run_epoch(state)   # arrays stay on device
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..utils.trace import record_dispatch
+from .node_loader import NodeLoader
+from .pipeline import _RECOMPUTE_MSG, FusedEpochTrainer
+
+
+class ScanTrainer(FusedEpochTrainer):
+  """Executes an epoch as ~ceil(steps/K) scanned-chunk dispatches.
+
+  Args:
+    loader: a homogeneous NeighborLoader on the fused sampler path with
+      device-resident features and labels (same scope as
+      OverlappedTrainer).
+    chunk_size: K, the static number of steps per scanned dispatch. The
+      tail chunk (steps % K) compiles once more at its own length; pick
+      K to divide the epoch when compile count matters.
+    perm_seed: base seed for the ON-DEVICE epoch permutation (default:
+      the loader's seed). Folded with the epoch index, so every epoch
+      shuffles differently yet replayably.
+  """
+
+  _NAME = 'ScanTrainer'
+
+  def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
+               chunk_size: int = 32,
+               seed_labels_only: Optional[bool] = None,
+               perm_seed: Optional[int] = None):
+    import jax
+    super().__init__(loader, model, tx, num_classes, seed_labels_only)
+    if chunk_size < 1:
+      raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
+    self.chunk_size = int(chunk_size)
+    self._shuffle = loader._batcher.shuffle
+    self._drop_last = loader._batcher.drop_last
+    if perm_seed is None:
+      perm_seed = loader._batcher.seed or 0
+    # tag the perm stream off fold_in(2**32 - 1): the sampler's step
+    # keys are fold_in(PRNGKey(seed), count >= 1) on the SAME default
+    # seed, and epoch e's permutation must not reuse step e's random
+    # words; the tag sits where no host step counter can ever land
+    self._perm_key = jax.random.fold_in(jax.random.PRNGKey(perm_seed),
+                                        0xFFFFFFFF)
+    self._epochs = 0        # folds into the perm key: fresh shuffle/epoch
+    self._seeds_dev = None  # input seeds, uploaded once
+    self._seed_fn = self._build_seed_fn()
+    self._chunk_fn = self._build_chunk_fn()
+    self._concat_fn = self._build_concat_fn()
+
+  # ------------------------------------------------------------- programs
+
+  def _build_seed_fn(self):
+    """ONE program for the epoch prologue: permutation draw + seed
+    gather + [steps, B] reshape + ragged-tail validity mask."""
+    import jax
+    import jax.numpy as jnp
+    batch = self._batch_size
+    shuffle = self._shuffle
+
+    def epoch_seeds(seeds, key, steps):
+      n = seeds.shape[0]
+      order = (jax.random.permutation(key, n) if shuffle
+               else jnp.arange(n, dtype=jnp.int32))
+      total = steps * batch
+      if total <= n:       # drop_last: the permutation's prefix
+        order = order[:total]
+        mask = jnp.ones((total,), bool)
+      else:                # ragged tail, masked invalid
+        order = jnp.concatenate(
+            [order, jnp.zeros((total - n,), order.dtype)])
+        mask = jnp.arange(total) < n
+      # pad slots carry node id 0 — the HOST loop's np.zeros padding —
+      # so a scanned batch is byte-identical to sample_from_nodes' input
+      seed_mat = jnp.where(mask, seeds[order], 0).reshape(steps, batch)
+      return seed_mat, mask.reshape(steps, batch)
+
+    return jax.jit(epoch_seeds, static_argnums=(2,))
+
+  def _build_chunk_fn(self):
+    """The scanned K-step program. Chunk position enters as a DEVICE
+    scalar (dynamic_slice start), so every full chunk reuses one
+    executable; only the tail length retraces. State and the overflow
+    carry are donated — HBM stays flat across chunk dispatches."""
+    import jax
+    from jax import lax
+    sample_collate = self._sample_collate
+    train_step = self._train_step   # jit-of-jit: inlined into the scan
+
+    def scan_epoch_chunk(state, ovf, fargs, feats, id2i, labels,
+                         seed_mat, mask_mat, base_key, count0, start, k):
+      seeds_k = lax.dynamic_slice_in_dim(seed_mat, start, k, axis=0)
+      masks_k = lax.dynamic_slice_in_dim(mask_mat, start, k, axis=0)
+      # the sampler's fold_in stream: global step g -> count0 + g
+      counts_k = count0 + start + lax.iota(seed_mat.dtype, k)
+
+      def body(carry, xs):
+        state, ovf = carry
+        seeds, smask, count = xs
+        key = jax.random.fold_in(base_key, count)
+        batch, overflow = sample_collate(fargs, feats, id2i, labels,
+                                         seeds, smask, key)
+        state, loss, acc = train_step(state, batch)
+        return (state, ovf | overflow), (loss, acc)
+
+      (state, ovf), (losses, accs) = lax.scan(
+          body, (state, ovf), (seeds_k, masks_k, counts_k))
+      return state, ovf, losses, accs
+
+    return jax.jit(scan_epoch_chunk, static_argnums=(11,),
+                   donate_argnums=(0, 1))
+
+  def _build_concat_fn(self):
+    """One program concatenating the per-chunk [K] loss/acc outputs."""
+    import jax
+    import jax.numpy as jnp
+
+    def epoch_metrics_concat(losses, accs):
+      return jnp.concatenate(losses), jnp.concatenate(accs)
+
+    return jax.jit(epoch_metrics_concat)
+
+  # ----------------------------------------------------------------- epoch
+
+  def _epoch_steps(self) -> int:
+    # the batcher owns the full-batch/ragged-tail arithmetic — one
+    # source of truth keeps the scanned step count equal to the
+    # per-step loop's by construction
+    return len(self.loader._batcher)
+
+  def run_epoch(self, state, max_steps: Optional[int] = None):
+    """One scanned epoch. Returns ``(state, losses, accs)`` with losses
+    and accs [steps]-shaped device arrays — fetch once, after the epoch.
+
+    The input ``state`` is DONATED to the first chunk dispatch and must
+    not be reused; train on the returned state. ``max_steps`` truncates
+    the epoch to exactly that many optimizer updates (the permutation is
+    still drawn for the full epoch, so truncation never changes which
+    seeds later steps would have seen)."""
+    import jax
+    import jax.numpy as jnp
+    guarded, recompute = self.loader._overflow_epoch_start()
+    if recompute:
+      raise ValueError(_RECOMPUTE_MSG)
+    self.loader._begin_epoch()
+    full_steps = self._epoch_steps()
+    steps = full_steps
+    truncated = False
+    if max_steps is not None and max_steps < steps:
+      steps, truncated = max_steps, True
+    if steps <= 0:
+      empty = jnp.zeros((0,), jnp.float32)
+      return state, empty, empty
+
+    if self._seeds_dev is None:
+      self._seeds_dev = jnp.asarray(
+          np.asarray(self.loader.input_seeds, dtype=np.int32))
+    perm_key = jax.random.fold_in(self._perm_key, self._epochs)
+    self._epochs += 1
+    record_dispatch('epoch_seeds')
+    seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
+                                       full_steps)
+
+    # graph arrays re-fetched each epoch: the padded-table reseed in
+    # _begin_epoch must reach the chunks (lazy rebuild in _fused_args)
+    fargs = self._sampler._fused_args()
+    base_key = self._sampler._key
+    count0 = np.int32(self._sampler._call_count + 1)
+    ovf = jnp.zeros((), bool)
+    losses, accs = [], []
+    start = 0
+    while start < steps:
+      k = min(self.chunk_size, steps - start)
+      record_dispatch('scan_chunk')
+      state, ovf, loss_k, acc_k = self._chunk_fn(
+          state, ovf, fargs, self._feats, self._id2i, self._labels,
+          seed_mat, mask_mat, base_key, count0, np.int32(start), k)
+      losses.append(loss_k)
+      accs.append(acc_k)
+      start += k
+    # keep the host fold_in stream aligned with what the device consumed
+    # (checkpoint/resume and any later per-step sampling continue it)
+    self._sampler._call_count += steps
+
+    if len(losses) > 1:
+      record_dispatch('metrics_concat')
+      losses, accs = self._concat_fn(losses, accs)
+    else:
+      losses, accs = losses[0], accs[0]
+
+    if guarded:
+      # same contract as OverlappedTrainer: natural epoch end applies
+      # overflow_policy; a max_steps break leaves the device-accumulated
+      # flag to loader.check_overflow()
+      self.loader._ovf_accum = ovf
+      if not truncated:
+        self.loader._finish_epoch_overflow()
+    return state, losses, accs

@@ -918,7 +918,9 @@ class NeighborSampler(BaseSampler):
     if key is None:
       key = self._next_key()
     if self.fused:
+      from ..utils.trace import record_dispatch
       fn = self._homo_fn(cap, fanouts)
+      record_dispatch('sample')
       res = fn(*self._fused_args(), jnp.asarray(padded), jnp.asarray(mask),
                key)
     else:

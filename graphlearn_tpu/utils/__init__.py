@@ -9,7 +9,8 @@ from .singleton import Singleton
 from .tensor import convert_to_array, id2idx, squeeze_dict
 from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, csr_to_csc, ind2ptr,
                    ptr2ind)
-from .trace import (annotate, device_op_ms, device_program_ms,
-                    maybe_start_trace, profile_trace, step_annotation,
-                    stop_trace)
+from .trace import (DispatchCounter, annotate, count_dispatches,
+                    device_op_ms, device_program_ms, maybe_start_trace,
+                    profile_trace, record_dispatch, step_annotation,
+                    stop_trace, wrap_dispatch)
 from .units import format_size, parse_size

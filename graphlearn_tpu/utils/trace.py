@@ -14,8 +14,9 @@ or env-driven: set GLT_PROFILE_DIR and call maybe_start_trace() /
 stop_trace() around the region of interest (bench.py honors it).
 """
 import contextlib
+import functools
 import os
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 
 @contextlib.contextmanager
@@ -125,6 +126,77 @@ def device_op_ms(trace_dir: str, top: int = 0, steps: int = 1,
   if top:
     out = dict(sorted(out.items(), key=lambda kv: -kv[1][0])[:top])
   return out
+
+
+# ---------------------------------------------------------------- dispatch
+# Dispatch counting: on this rig wall-clock epoch time scales with the
+# NUMBER of program dispatches, not device ms (PERF.md 'Timing on the
+# axon tunnel'), so the loaders/trainers instrument their dispatch sites
+# and tests/bench.py assert & report dispatches/epoch. The counter is a
+# host-side convention — every hot-path program launch in this package
+# calls record_dispatch() right before dispatching — which makes it
+# exact for the instrumented paths and free (one None check) otherwise.
+
+
+class DispatchCounter:
+  """Per-site XLA program launch counts (see count_dispatches)."""
+
+  def __init__(self):
+    self.counts = {}
+
+  @property
+  def total(self) -> int:
+    return sum(self.counts.values())
+
+  def record(self, name: str = 'program'):
+    self.counts[name] = self.counts.get(name, 0) + 1
+
+  def __repr__(self):
+    return f'DispatchCounter(total={self.total}, counts={self.counts})'
+
+
+_dispatch_counter: Optional[DispatchCounter] = None
+
+
+@contextlib.contextmanager
+def count_dispatches() -> Iterator[DispatchCounter]:
+  """Count instrumented program dispatches in the enclosed region.
+
+  Yields the active DispatchCounter; read ``.total`` / ``.counts`` after
+  the block. Nesting restores the outer counter on exit (the inner
+  region's dispatches are NOT added to the outer count — each counter
+  owns its own region).
+  """
+  global _dispatch_counter
+  prev, _dispatch_counter = _dispatch_counter, DispatchCounter()
+  try:
+    yield _dispatch_counter
+  finally:
+    _dispatch_counter = prev
+
+
+def record_dispatch(name: str = 'program'):
+  """Count one program dispatch under ``name`` (no-op when no
+  count_dispatches() region is active). Call at the dispatch SITE, just
+  before launching a jitted program — never inside traced code, where it
+  would fire once per trace instead of once per call."""
+  if _dispatch_counter is not None:
+    _dispatch_counter.record(name)
+
+
+def wrap_dispatch(fn: Callable, name: Optional[str] = None) -> Callable:
+  """Counting wrapper for a jitted callable: each call records one
+  dispatch under ``name`` (default: the function's name). For code
+  outside this package (bench loops, tests) whose dispatch sites the
+  built-in instrumentation doesn't cover."""
+  label = name or getattr(fn, '__name__', 'program')
+
+  @functools.wraps(fn)
+  def wrapper(*args, **kwargs):
+    record_dispatch(label)
+    return fn(*args, **kwargs)
+
+  return wrapper
 
 
 _active = False
