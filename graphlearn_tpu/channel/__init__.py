@@ -1,5 +1,5 @@
 from .base import (ChannelBase, QueueTimeoutError, SampleMessage,
                    deserialize_message, serialize_message)
 from .mp_channel import MpChannel
-from .remote_channel import RemoteReceivingChannel
-from .shm_channel import ShmChannel
+from .remote_channel import PeerDeadError, RemoteReceivingChannel
+from .shm_channel import ShmChannel, live_channel_count

@@ -10,10 +10,22 @@ cudaHostRegister equivalent to apply.
 """
 import ctypes
 import threading
+import weakref
 from typing import Optional
 
 from .base import (ChannelBase, QueueTimeoutError, SampleMessage,
                    deserialize_message, serialize_message)
+
+# Census of ShmChannels open in THIS process (weak — a collected channel
+# drops out even if close() was never called). The shutdown-leak
+# regression tests assert this returns to baseline after
+# create/kill/destroy cycles; see DistServer.destroy_sampling_producer.
+_live_channels: 'weakref.WeakSet' = weakref.WeakSet()
+
+
+def live_channel_count() -> int:
+  """Number of open (not yet close()d) ShmChannels in this process."""
+  return sum(1 for c in _live_channels if c._q)
 
 
 class ShmChannel(ChannelBase):
@@ -41,12 +53,17 @@ class ShmChannel(ChannelBase):
     # dequeue buffer for a different block. Serialize the pair per process
     # (each process re-attaching via __reduce__ builds its own lock).
     self._recv_lock = threading.Lock()
+    self._received = 0   # messages recv'd in THIS process (diagnostics)
+    _live_channels.add(self)
 
   @property
   def shmid(self) -> int:
     return self._lib.shmq_id(self._q)
 
   def send(self, msg: SampleMessage):
+    from ..utils.faults import fault_point
+    if fault_point('channel.shm.send') == 'drop':
+      return   # injected message loss: consumers must survive a gap
     buf = serialize_message(msg)
     rc = self._lib.shmq_enqueue(self._q, buf, len(buf))
     if rc != 0:
@@ -54,20 +71,28 @@ class ShmChannel(ChannelBase):
           f'message of {len(buf)} bytes exceeds ring capacity '
           f'{self.shm_size}')
 
+  def _timeout(self, timeout_ms: int) -> QueueTimeoutError:
+    return QueueTimeoutError(
+        f'shm channel recv timed out after {timeout_ms}ms '
+        f'(shmid={self.shmid}, ring={self.shm_size} bytes, '
+        f'received_so_far={self._received} in this process) — producers '
+        'sent nothing in the window; check producer worker health')
+
   def recv(self, timeout_ms: int = -1) -> SampleMessage:
     with self._recv_lock:
       size = self._lib.shmq_next_size(self._q, timeout_ms)
       if size == -1:
-        raise QueueTimeoutError('shm channel recv timeout')
+        raise self._timeout(timeout_ms)
       if size == -2:
         raise StopIteration('channel finished')
       buf = ctypes.create_string_buffer(size)
       got = self._lib.shmq_dequeue(self._q, buf, size, timeout_ms)
       if got == -1:
-        raise QueueTimeoutError('shm channel recv timeout')
+        raise self._timeout(timeout_ms)
       if got == -2:
         raise StopIteration('channel finished')
       assert got == size, (got, size)
+      self._received += 1
     return deserialize_message(bytes(buf))
 
   def empty(self) -> bool:

@@ -22,5 +22,7 @@ from .dist_client import (async_request_server, init_client,
                           request_server, shutdown_client)
 from .event_loop import ConcurrentEventLoop
 from .message import message_to_data, output_to_message
+from .resilience import (DEFAULT_RETRY_POLICY, NO_RETRY, DeadlineExceeded,
+                         Heartbeat, RetryPolicy, ServerDeadError)
 from .rpc import (Barrier, RpcCalleeBase, RpcClient,
                   RpcDataPartitionRouter, RpcServer, get_free_port)

@@ -58,8 +58,10 @@ def shutdown_client():
     if ctx is not None and ctx.rank == 0:
       for rank in _client.targets:
         try:
-          _client.request_sync(rank, 'exit')
-        except (RuntimeError, ConnectionError, OSError):
+          # DistServer.exit is idempotent, so a lost response may be
+          # retried (with backoff) instead of leaving the server up
+          _client.request_sync(rank, 'exit', idempotent=True)
+        except (RuntimeError, ConnectionError, OSError, TimeoutError):
           pass
   finally:
     _client.close()

@@ -187,7 +187,8 @@ class MpDistNeighborLoader:
                batch_size: int = 64, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                collect_features: bool = True, num_workers: int = 2,
-               channel_size: int = 1 << 26, seed: Optional[int] = None):
+               channel_size: int = 1 << 26, seed: Optional[int] = None,
+               max_worker_restarts: int = 2):
     from ..sampler import SamplingConfig, SamplingType
     # hetero seeds: ('paper', ids) — workers sample the typed engine and
     # stream HeteroData messages (message.hetero_output_to_message)
@@ -199,10 +200,11 @@ class MpDistNeighborLoader:
     self._setup(data,
                 NodeSamplerInput(np.asarray(input_nodes).reshape(-1),
                                  input_type=input_type),
-                config, channel_size, num_workers, seed)
+                config, channel_size, num_workers, seed,
+                max_worker_restarts=max_worker_restarts)
 
   def _setup(self, data, sampler_input, config, channel_size, num_workers,
-             seed):
+             seed, max_worker_restarts: int = 2):
     """Shared producer/channel wiring for the mp loader family."""
     from ..channel import QueueTimeoutError, ShmChannel
     from .dist_sampling_producer import DistMpSamplingProducer
@@ -212,9 +214,14 @@ class MpDistNeighborLoader:
     self.channel = ShmChannel(shm_size=channel_size)
     self.producer = DistMpSamplingProducer(
         data, sampler_input, config, self.channel,
-        num_workers=num_workers, seed=seed)
+        num_workers=num_workers, seed=seed,
+        max_worker_restarts=max_worker_restarts)
     self.producer.init()
     self._expected = self.producer.num_expected()
+    # recv window between producer health checks: short enough that a
+    # crashed worker is detected (and restarted) promptly, long enough
+    # that the checks stay off the hot path
+    self.health_check_interval_ms = 5000
 
   def __len__(self):
     return self._expected
@@ -224,10 +231,12 @@ class MpDistNeighborLoader:
     received = 0
     while received < self._expected:
       try:
-        msg = self.channel.recv(timeout_ms=60000)
+        msg = self.channel.recv(timeout_ms=self.health_check_interval_ms)
       except self._timeout_error:
-        self.producer.check_worker_health()   # crashed worker -> raise,
-        # don't spin on an empty channel forever
+        # crashed worker -> restart + bit-identical replay (raises only
+        # once the producer's restart budget is exhausted), rather than
+        # spinning on an empty channel forever
+        self.producer.check_worker_health()
         if self.producer.is_all_sampling_completed() and \
             self.channel.empty():
           break
@@ -273,7 +282,23 @@ class _RemoteLoaderBase:
   """Shared remote (server-client) machinery: create one producer per
   server from a per-server sampler-input split, pull batches through
   the RemoteReceivingChannel, restart producers per epoch (reference:
-  dist_loader.py:155-195 + dist_neighbor_loader.py remote branch)."""
+  dist_loader.py:155-195 + dist_neighbor_loader.py remote branch).
+
+  Resilience (docs/failure_model.md): a Heartbeat thread per server
+  detects death in ~heartbeat_interval * heartbeat_miss seconds; a dead
+  server's UNACKED seeds — its seed share minus the seeds of batches
+  this loader already received (each batch message carries its seed ids
+  in 'batch') — are redistributed across the surviving servers as fresh
+  producers, so the epoch completes with every seed delivered exactly
+  once. The server's worker_key idempotent-producer mechanism makes the
+  re-requests safe. Degradations are counted in utils/trace.py
+  ('resilience.failover', 'resilience.server_dead').
+  """
+
+  #: Node loaders ack received seeds from each batch's 'batch' ids and
+  #: can therefore fail over; link batches carry only local indices, so
+  #: the link loader degrades to a hard error on server death.
+  supports_failover = True
 
   def _setup_remote(self, config, per_server_inputs, worker_options):
     import dataclasses
@@ -281,8 +306,11 @@ class _RemoteLoaderBase:
     from ..channel import RemoteReceivingChannel
     from . import dist_client
     from .message import message_to_data
+    from .resilience import Heartbeat
     self._message_to_data = message_to_data
     opts = worker_options
+    self._opts = opts
+    self._config = config
     self.producer_ids = []
     self._expected = 0
     for i, (rank, part) in enumerate(zip(self.server_ranks,
@@ -300,12 +328,47 @@ class _RemoteLoaderBase:
       self.producer_ids.append(pid)
       # the producer's own count: its mp workers split the seed share and
       # each rounds up, so ceil(n/batch_size) would undercount here
-      self._expected += dist_client.request_server(
-          rank, 'producer_num_expected', pid)
+      exp = dist_client.request_server(
+          rank, 'producer_num_expected', pid, idempotent=True)
+      self._pair_expected = getattr(self, '_pair_expected', {})
+      self._pair_expected[(rank, pid)] = exp
+      self._expected += exp
     self.channel = RemoteReceivingChannel(
         self.server_ranks, self.producer_ids,
         prefetch_size=(opts.prefetch_size if opts else 4))
     self._dist_client = dist_client
+    # -- resilience state ---------------------------------------------------
+    # per-(rank, pid) seed shares for failover accounting (None when the
+    # input carries no ackable seeds, e.g. link mode)
+    self._pair_parts = {}
+    for rank, pid, part in zip(self.server_ranks, self.producer_ids,
+                               per_server_inputs):
+      seeds = getattr(part, 'node', part if not hasattr(part, 'row')
+                      else None)
+      self._pair_parts[(rank, pid)] = (
+          np.asarray(seeds).reshape(-1) if seeds is not None else None)
+    self._dead_ranks = {}        # rank -> cause, sticky across epochs
+    self._pair_batches = {}      # (rank, pid) -> batches received
+    self._live_pairs = set()     # this epoch's pulling (rank, pid)s
+    self._fo_producers = []      # this epoch's replacement (rank, pid)s
+    self._fo_seq = 0
+    self._epoch = 0
+    self._heartbeat_miss = opts.heartbeat_miss if opts else 3
+    self._heartbeat_interval = opts.heartbeat_interval if opts else 1.0
+    self._failover_enabled = (opts.failover if opts else True) and \
+        self.supports_failover
+    self._idle_budget = opts.rpc_timeout if opts else 180.0
+    probe_timeout = max(self._heartbeat_interval, 2.0)
+
+    def probe(rank):
+      from .resilience import NO_RETRY
+      dist_client.request_server(rank, 'heartbeat',
+                                 timeout=probe_timeout,
+                                 idempotent=True, retry_policy=NO_RETRY)
+
+    self._heartbeat = Heartbeat(
+        self.server_ranks, probe, interval=self._heartbeat_interval,
+        miss_threshold=self._heartbeat_miss)
 
   def _resolve_ranks(self, worker_options):
     opts = worker_options
@@ -318,26 +381,228 @@ class _RemoteLoaderBase:
   def __len__(self):
     return self._expected
 
+  # -- failover machinery ---------------------------------------------------
+
+  def _ack(self, rank, pid, msg):
+    """Record which seeds a received batch covered (homo: 'batch' ids;
+    hetero: 'batch.<input_type>'). Unackable messages are ignored —
+    failover then treats their seeds as undelivered (safe: duplicates
+    are impossible, the pair's producer is abandoned before replay)."""
+    self._pair_batches[(rank, pid)] = \
+        self._pair_batches.get((rank, pid), 0) + 1
+    acked = self._acked.get((rank, pid))
+    if acked is None:
+      acked = self._acked[(rank, pid)] = set()
+    bs = msg.get('#META.batch_size')
+    ids = msg.get('batch')
+    if ids is None and '#META.input_type' in msg:
+      t = bytes(np.asarray(msg['#META.input_type'])).decode()
+      ids = msg.get(f'batch.{t}')
+    if ids is None:
+      return
+    ids = np.asarray(ids).reshape(-1)
+    if bs is not None:
+      ids = ids[:int(np.asarray(bs).reshape(-1)[0])]
+    acked.update(int(i) for i in ids)
+
+  def _handle_dead_pair(self, rank, pid, cause):
+    """Declare (rank, pid) dead and redistribute its unacked seeds to
+    surviving servers. Returns buffered messages that were drained while
+    abandoning the pair (already acked; caller yields them). Idempotent
+    per pair per epoch."""
+    from ..utils import trace
+    if (rank, pid) in self._handled_pairs:
+      return []
+    # feasibility FIRST, before any state mutation: when this loader
+    # cannot fail over, the rank must not be marked sticky-dead (a
+    # transient blip would then poison every later epoch) and buffered
+    # batches must not be drained onto the raise path
+    part = self._pair_parts.get((rank, pid))
+    if not self.supports_failover or part is None:
+      raise RuntimeError(
+          f'sampling server rank {rank} died mid-epoch ({cause}) and '
+          'this loader cannot fail over: its batches carry no seed '
+          'provenance to ack (link mode) — restart the epoch')
+    if not self._failover_enabled:
+      raise RuntimeError(
+          f'sampling server rank {rank} died mid-epoch ({cause}) and '
+          'failover is disabled (RemoteDistSamplingWorkerOptions'
+          '.failover=False)')
+    self._handled_pairs.add((rank, pid))
+    self._live_pairs.discard((rank, pid))
+    self._dead_ranks[rank] = cause
+    self._heartbeat.mark_dead(rank, cause)
+    self.channel.abandon(rank, pid)
+    # ack everything already buffered from ANY pair before computing the
+    # unacked set — in-flight batches of the dying server must not be
+    # re-requested (they were delivered, just not consumed yet)
+    buffered = self.channel.drain_now()
+    for r2, p2, m in buffered:
+      self._ack(r2, p2, m)
+    acked = self._acked.get((rank, pid), set())
+    unacked = part[~np.isin(part, np.fromiter(acked, dtype=part.dtype,
+                                              count=len(acked)))] \
+        if len(acked) else part
+    survivors = [r for r in self.server_ranks
+                 if r not in self._dead_ranks]
+    if not survivors:
+      raise RuntimeError(
+          f'all sampling servers dead (last: rank {rank}: {cause}) — '
+          'cannot complete the epoch')
+    trace.counter_inc('resilience.failover')
+    trace.counter_inc('resilience.failover_seeds', int(unacked.shape[0]))
+    import logging
+    logging.getLogger('graphlearn_tpu.loader').warning(
+        'server rank %d dead (%s): redistributing %d unacked seeds '
+        'across surviving servers %s', rank, cause, unacked.shape[0],
+        survivors)
+    if unacked.shape[0] == 0:
+      return buffered
+    import dataclasses
+    from ..sampler import NodeSamplerInput as NSI
+    new_expected = 0
+    splits = np.array_split(unacked, len(survivors))
+    for r2, sub in zip(survivors, splits):
+      if sub.shape[0] == 0:
+        continue
+      self._fo_seq += 1
+      base_key = (self._opts.worker_key
+                  if self._opts and self._opts.worker_key else 'fo')
+      key = (f'{base_key}/fo/e{self._epoch}/'
+             f'd{rank}/s{r2}/{self._fo_seq}')
+      part2 = (NSI(sub, self.input_type)
+               if getattr(self, 'input_type', None) is not None else sub)
+      cfg2 = dataclasses.replace(
+          self._config,
+          seed=(self._config.seed or 0) * 7919 + 104729 + self._fo_seq)
+      # worker_key makes the create re-request-safe, so it may retry —
+      # a transient hiccup on the SURVIVOR must not abort the very
+      # failover meant to save the epoch. start_new_epoch_sampling has
+      # no such dedup (a retried start double-produces), so it stays
+      # single-attempt.
+      pid2 = self._dist_client.request_server(
+          r2, 'create_sampling_producer', part2, cfg2,
+          self._opts.num_workers if self._opts else 1, worker_key=key,
+          idempotent=True)
+      repl_expected = self._dist_client.request_server(
+          r2, 'producer_num_expected', pid2, idempotent=True)
+      self._dist_client.request_server(r2, 'start_new_epoch_sampling',
+                                       pid2)
+      self._pair_parts[(r2, pid2)] = sub
+      self._pair_expected[(r2, pid2)] = repl_expected
+      self._fo_producers.append((r2, pid2))
+      self._live_pairs.add((r2, pid2))
+      self.channel.add_producer(r2, pid2)
+      new_expected += repl_expected
+    # keep len(self) truthful mid-epoch: this epoch now delivers the
+    # dead pair's already-received batches + the replacements' counts
+    # instead of the dead pair's original expectation (re-chunking can
+    # shift partial-batch counts when bs does not divide the shares)
+    dead_expected = self._pair_expected.get((rank, pid))
+    if dead_expected is not None:
+      delivered = self._pair_batches.get((rank, pid), 0)
+      self._expected += new_expected - (dead_expected - delivered)
+    return buffered
+
   def __iter__(self):
+    import time as _time
+
+    from ..channel import QueueTimeoutError
+    from ..channel.remote_channel import PeerDeadError
     # Ordering matters: kill any previous epoch's pullers BEFORE
     # restarting the server producers (a stale puller would consume
     # new-epoch messages into its dead queue), and only then start the
     # new pullers.
     self.channel.stop(join=True)
+    self._epoch += 1
+    self._acked = {}
+    self._pair_batches = {}
+    self._handled_pairs = set()
+    # failover producers are per-epoch: release last epoch's now (and
+    # drop their seed-share records — a stale share must never be
+    # redistributed into a later epoch)
+    for rank, pid in self._fo_producers:
+      self._pair_parts.pop((rank, pid), None)
+      self._pair_expected.pop((rank, pid), None)
+      try:
+        self._dist_client.request_server(rank,
+                                         'destroy_sampling_producer', pid)
+      except (RuntimeError, ConnectionError, OSError):
+        pass
+    self._fo_producers = []
+    # restore the undegraded expectation; this epoch's failovers (if
+    # any) re-adjust it as they happen
+    self._expected = sum(
+        self._pair_expected.get(p, 0)
+        for p in zip(self.server_ranks, self.producer_ids))
+    started, start_dead = [], []
     for rank, pid in zip(self.server_ranks, self.producer_ids):
-      self._dist_client.request_server(rank, 'start_new_epoch_sampling',
-                                       pid)
-    self.channel.start()
+      if rank in self._dead_ranks:
+        start_dead.append((rank, pid))
+        continue
+      try:
+        self._dist_client.request_server(rank, 'start_new_epoch_sampling',
+                                         pid)
+        started.append((rank, pid))
+      except (ConnectionError, TimeoutError, OSError) as e:
+        if not (self._failover_enabled and self.supports_failover):
+          # no recovery path: surface the failure without sticky-marking
+          # the rank, so a recovered server works on the next attempt
+          raise
+        start_dead.append((rank, pid))
+        self._dead_ranks[rank] = repr(e)
+    if not started:
+      raise RuntimeError('no live sampling server to start the epoch: '
+                         f'dead={self._dead_ranks}')
+    self._live_pairs = set(started)
+    self.channel.start_pairs(started)
+    self._heartbeat.start()
+    # ranks that died in an earlier epoch (or refused the epoch start):
+    # their whole seed share is unacked — fail it over immediately
+    for rank, pid in start_dead:
+      for r2, p2, m in self._handle_dead_pair(
+          rank, pid, self._dead_ranks.get(rank, 'dead at epoch start')):
+        yield self._message_to_data(m)
+    idle_since = _time.monotonic()
     while True:
       try:
-        msg = self.channel.recv(timeout_ms=60000)
+        rank, pid, msg = self.channel.recv_with_meta(timeout_ms=5000)
       except StopIteration:
         return
+      except PeerDeadError as e:
+        for r2, p2, m in self._handle_dead_pair(e.rank, e.producer_id,
+                                                e.cause):
+          yield self._message_to_data(m)
+        continue
+      except QueueTimeoutError:
+        # quiet window: consult liveness before waiting further — a
+        # partitioned/hung server never RSTs, the heartbeat is the only
+        # signal (detection in seconds vs the 180 s socket timeout)
+        handled = False
+        for rank, cause in self._heartbeat.dead_ranks().items():
+          for (r2, p2) in [pr for pr in list(self._live_pairs)
+                           if pr[0] == rank and
+                           pr not in self._handled_pairs]:
+            for r3, p3, m in self._handle_dead_pair(r2, p2, cause):
+              yield self._message_to_data(m)
+            handled = True
+        if handled:
+          idle_since = _time.monotonic()
+          continue
+        if _time.monotonic() - idle_since > self._idle_budget:
+          raise
+        continue
+      idle_since = _time.monotonic()
+      self._ack(rank, pid, msg)
       yield self._message_to_data(msg)
 
   def shutdown(self):
+    self._heartbeat.stop()
     self.channel.stop()
-    for rank, pid in zip(self.server_ranks, self.producer_ids):
+    for rank, pid in (list(zip(self.server_ranks, self.producer_ids)) +
+                      list(self._fo_producers)):
+      if rank in self._dead_ranks:
+        continue
       try:
         self._dist_client.request_server(rank,
                                          'destroy_sampling_producer', pid)
@@ -363,6 +628,9 @@ class RemoteDistNeighborLoader(_RemoteLoaderBase):
     # typed NodeSamplerInputs so the tuple convention (type FIRST)
     # never hits CastMixin's positional cast
     input_type, input_nodes = _split_input_type(input_nodes)
+    # stored for failover: replacement producers must re-ship TYPED
+    # seeds, or the server-side producer rejects them for hetero graphs
+    self.input_type = input_type
     config = SamplingConfig(
         SamplingType.NODE, _norm_num_neighbors(num_neighbors),
         batch_size, shuffle, drop_last, with_edge, collect_features,
@@ -380,6 +648,10 @@ class RemoteDistLinkNeighborLoader(_RemoteLoaderBase):
   sampling servers, whose mp workers draw negatives + run the (typed)
   link engine; batches stream back with edge_label metadata. Hetero
   seed edges as ((src_t, rel, dst_t), [2, E])."""
+
+  # link batches expose only batch-local seed indices — no global edge
+  # ids to ack — so a dead server is a hard error here, not a failover
+  supports_failover = False
 
   def __init__(self, num_neighbors, edge_label_index, edge_label=None,
                neg_sampling=None, batch_size: int = 64,

@@ -41,12 +41,26 @@ class MpDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
 @dataclass
 class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
   """Server-side producers streaming to this client
-  (reference: dist_options.py:202-260)."""
+  (reference: dist_options.py:202-260).
+
+  Resilience tunables (docs/failure_model.md): the loader heartbeats
+  every server at ``heartbeat_interval`` seconds and declares one dead
+  after ``heartbeat_miss`` consecutive missed probes (detection latency
+  ~ interval * miss, vs the 180 s socket timeout). With ``failover``
+  on, a dead server's unacknowledged seeds are redistributed across the
+  surviving servers so the epoch still completes (node loaders only —
+  link batches carry no seed provenance to ack). ``rpc_timeout`` doubles
+  as the total-idle budget: an epoch that receives nothing for that
+  long fails with a contextual QueueTimeoutError.
+  """
   server_rank: Optional[Union[int, List[int]]] = None
   buffer_size: Optional[Union[int, str]] = None
   prefetch_size: int = 4
   worker_key: Optional[str] = None
   epochs: int = 1
+  heartbeat_interval: float = 1.0
+  heartbeat_miss: int = 3
+  failover: bool = True
 
 
 AllDistSamplingWorkerOptions = Union[

@@ -99,20 +99,19 @@ class DistRandomPartitioner:
     # rank 0 binds its barrier server concurrently with the other ranks'
     # first arrival — retry refused connections instead of dying (which
     # would strand rank 0 in a 180 s barrier timeout). The phase counter
-    # makes retries idempotent across generations (rpc.Barrier.arrive).
-    import time
+    # makes retries idempotent across generations (rpc.Barrier.arrive),
+    # so the arrival can ride the standard backoff policy.
+    from .resilience import RetryPolicy
     phase = self._phase
     self._phase += 1
-    deadline = time.monotonic() + 60
-    while True:
-      try:
-        self._client.request_sync(0, 'partition_barrier', self.rank,
-                                  phase=phase)
-        return
-      except (ConnectionError, OSError):
-        if time.monotonic() > deadline:
-          raise
-        time.sleep(0.2)
+    # flat 0.2s polls: the attempt budget must outlast the 60s deadline
+    # (exponential growth would exhaust the backoff sum long before the
+    # window rank 0 historically got to bind its barrier server)
+    policy = RetryPolicy(max_attempts=400, base_delay=0.2, max_delay=0.2,
+                         multiplier=1.0, jitter=0.0, total_deadline=60.0)
+    self._client.request_sync(0, 'partition_barrier', self.rank,
+                              phase=phase, idempotent=True,
+                              retry_policy=policy)
 
   # -- typed views ---------------------------------------------------------
 

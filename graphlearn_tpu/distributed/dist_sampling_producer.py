@@ -11,6 +11,7 @@ training process (single-controller model), so host-side producers sample
 on CPU; the fast path for device sampling is the collocated mesh program.
 """
 import multiprocessing as mp
+import threading
 from enum import Enum
 from typing import Optional
 
@@ -28,8 +29,19 @@ class MpCommand(Enum):
 
 
 def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
-                          task_queue, channel, done_counter):
-  """Subprocess body (reference: dist_sampling_producer.py:53-151)."""
+                          task_queue, channel, done_counter,
+                          progress=None, resume_calls: int = 0):
+  """Subprocess body (reference: dist_sampling_producer.py:53-151).
+
+  Self-healing contract: after every batch lands in the channel the
+  worker publishes (batches sent this epoch, sampler call_count) into
+  the shared ``progress`` arrays. A crashed worker is respawned with
+  ``resume_calls`` = its last published call_count and replays its
+  epoch order from the first unsent batch — the sampler's fold_in
+  per-call key stream makes the replayed batches bit-identical to what
+  the dead worker would have produced (batch i's key depends only on
+  (worker seed, call index), never on history).
+  """
   import jax
   try:
     jax.config.update('jax_platforms', 'cpu')
@@ -68,6 +80,10 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
       dataset.graph, cfg.num_neighbors, with_edge=cfg.with_edge,
       with_weight=cfg.with_weight, edge_dir=cfg.edge_dir,
       seed=worker_seed)
+  # restart path: fast-forward the PRNG stream to where the dead worker
+  # left it, so replayed batches reuse the exact per-call keys
+  if resume_calls:
+    sampler._call_count = resume_calls
   from graphlearn_tpu.sampler import (EdgeSamplerInput, NegativeSampling,
                                       SamplingType)
   is_link = cfg.sampling_type == SamplingType.LINK
@@ -82,17 +98,40 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
     n_seeds = rows_.shape[0]
   else:
     n_seeds = seeds.shape[0]
+  from graphlearn_tpu.utils.faults import fault_point
+  import os as _os
+  import queue as _queue
+  parent = _os.getppid()
   while True:
-    cmd, payload = task_queue.get()
+    try:
+      cmd, payload = task_queue.get(timeout=5)
+    except _queue.Empty:
+      # orphan guard: a SIGKILL'd producer process cannot STOP its
+      # workers; when the parent is gone (reparented to init) exit
+      # instead of idling forever as a leaked process
+      if _os.getppid() != parent:
+        return
+      continue
     if cmd == MpCommand.STOP:
       break
-    epoch_seed_order = payload
+    epoch_seed_order, start_batch = payload
     n = n_seeds
     bs = cfg.batch_size
+    batch_no = 0
     for i in range(0, n - (n % bs if cfg.drop_last else 0), bs):
       idx = epoch_seed_order[i:i + bs]
       if idx.shape[0] == 0:
         continue
+      if batch_no < start_batch:
+        # replay fast-forward: these batches already landed in the
+        # channel before the previous incarnation died; the PRNG keys
+        # they consumed are covered by resume_calls, so skipping them
+        # does not shift the remaining batches' key stream
+        batch_no += 1
+        continue
+      # chaos harness site: armed 'exit' here (before the sample/send)
+      # kills the worker at an exact batch index with nothing in flight
+      fault_point('producer.worker.batch')
       if is_link:
         if idx.shape[0] < bs:
           # pad the final short batch cyclically so every batch keeps the
@@ -124,16 +163,31 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
             lab = np.asarray(lab)
             y_d[t] = lab[np.clip(np.asarray(out.node[t]), 0,
                                  len(lab) - 1)]
-        channel.send(hetero_output_to_message(out, x_d, y_d))
-        continue
-      x = y = None
-      if cfg.collect_features and dataset.node_features is not None:
-        x = dataset.node_features.cpu_get(
-            np.maximum(np.asarray(out.node), 0))
-      if dataset.node_labels is not None:
-        labels = np.asarray(dataset.node_labels)
-        y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
-      channel.send(output_to_message(out, x, y))
+        msg = hetero_output_to_message(out, x_d, y_d)
+      else:
+        x = y = None
+        if cfg.collect_features and dataset.node_features is not None:
+          x = dataset.node_features.cpu_get(
+              np.maximum(np.asarray(out.node), 0))
+        if dataset.node_labels is not None:
+          labels = np.asarray(dataset.node_labels)
+          y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
+        msg = output_to_message(out, x, y)
+      channel.send(msg)
+      batch_no += 1
+      if progress is not None:
+        # published AFTER the send. Tradeoff for an UNCONTROLLED crash
+        # landing exactly between send and publish: the replay re-emits
+        # that one batch (a duplicate, which consumers counting toward
+        # expected will take in place of the true final batch) —
+        # publishing first would instead lose the batch outright.
+        # Exact replay is guaranteed when the crash point is before the
+        # send, which is where the chaos harness injects kills
+        # (docs/failure_model.md 'Limits').
+        sent_arr, calls_arr = progress
+        with sent_arr.get_lock():
+          sent_arr[rank] = batch_no
+          calls_arr[rank] = sampler._call_count
     with done_counter.get_lock():
       done_counter.value += 1
 
@@ -144,9 +198,20 @@ class DistMpSamplingProducer:
 
   def __init__(self, dataset, sampler_input,
                sampling_config: SamplingConfig, channel: ChannelBase,
-               num_workers: int = 1, seed: Optional[int] = None):
+               num_workers: int = 1, seed: Optional[int] = None,
+               max_worker_restarts: int = 2):
     self.dataset = dataset
     self.config = sampling_config
+    # self-healing budget: check_worker_health respawns a crashed worker
+    # (replaying its unfinished seed blocks bit-identically) at most
+    # this many times per producer before giving up
+    self.max_worker_restarts = max_worker_restarts
+    self._restarts_used = 0
+    # serializes crash detection + respawn: the server calls
+    # check_worker_health from concurrent RPC handler threads (one per
+    # puller connection), and a double-respawn of the same worker would
+    # replay its seed tail twice
+    self._health_lock = threading.Lock()
     if hasattr(sampler_input, 'row'):     # EdgeSamplerInput (link mode)
       neg = sampler_input.neg_sampling
       self._link_input = dict(
@@ -184,12 +249,41 @@ class DistMpSamplingProducer:
     self._done = None
     self._splits = np.array_split(np.arange(n), num_workers)
 
+  def _worker_seeds(self, w: int):
+    if self._link_input is not None:
+      sl = self._splits[w]
+      li = self._link_input
+      return dict(rows=li['rows'][sl], cols=li['cols'][sl],
+                  label=(li['label'][sl] if li['label'] is not None
+                         else None),
+                  neg_mode=li['neg_mode'],
+                  neg_amount=li['neg_amount'])
+    return self.seeds[self._splits[w]]
+
+  def _spawn_worker(self, w: int, resume_calls: int = 0):
+    q = self._ctx.Queue()
+    p = self._ctx.Process(
+        target=_sampling_worker_loop,
+        args=(w, self._handle, self.config, self._worker_seeds(w), q,
+              self.channel, self._done, (self._sent, self._calls),
+              resume_calls),
+        daemon=True)
+    p.start()
+    self._procs[w] = p
+    self._queues[w] = q
+
   def init(self):
-    ctx = mp.get_context('spawn')
+    ctx = self._ctx = mp.get_context('spawn')
     self._done = ctx.Value('i', 0)
+    # per-worker progress, shared with the subprocesses: batches sent in
+    # the current epoch + the sampler's call_count — everything the
+    # restart path needs to replay a dead worker exactly
+    self._sent = ctx.Array('q', self.num_workers)
+    self._calls = ctx.Array('q', self.num_workers)
+    self._last_orders = [None] * self.num_workers
     g = self.dataset.graph
     nf = self.dataset.node_features
-    handle = dict(
+    self._handle = dict(
         graph_ipc=({et: gr.share_ipc() for et, gr in g.items()}
                    if isinstance(g, dict) else g.share_ipc()),
         feature_ipc=(None if nf is None else
@@ -199,53 +293,81 @@ class DistMpSamplingProducer:
         edge_dir=self.dataset.edge_dir,
         input_type=getattr(self, '_input_type', None))
     # ship host containers; subprocesses rebuild on the CPU backend
+    self._procs = [None] * self.num_workers
+    self._queues = [None] * self.num_workers
     for w in range(self.num_workers):
-      q = ctx.Queue()
-      if self._link_input is not None:
-        sl = self._splits[w]
-        li = self._link_input
-        wseeds = dict(rows=li['rows'][sl], cols=li['cols'][sl],
-                      label=(li['label'][sl] if li['label'] is not None
-                             else None),
-                      neg_mode=li['neg_mode'],
-                      neg_amount=li['neg_amount'])
-      else:
-        wseeds = self.seeds[self._splits[w]]
-      p = ctx.Process(
-          target=_sampling_worker_loop,
-          args=(w, handle, self.config, wseeds, q,
-                self.channel, self._done),
-          daemon=True)
-      p.start()
-      self._procs.append(p)
-      self._queues.append(q)
+      self._spawn_worker(w)
 
   def produce_all(self):
     """Kick one epoch of sampling on all workers
     (reference: :227-240)."""
     with self._done.get_lock():
       self._done.value = 0
+    with self._sent.get_lock():
+      for w in range(self.num_workers):
+        self._sent[w] = 0
     if hasattr(self.channel, 'reset'):
       self.channel.reset()
     for w in range(self.num_workers):
       n = self._splits[w].shape[0]
       order = (self._rng.permutation(n) if self.config.shuffle
                else np.arange(n))
-      self._queues[w].put((MpCommand.SAMPLE_ALL, order))
+      self._last_orders[w] = order
+      self._queues[w].put((MpCommand.SAMPLE_ALL, (order, 0)))
 
   def is_all_sampling_completed(self) -> bool:
     with self._done.get_lock():
       return self._done.value == self.num_workers
 
+  def _expected_for_worker(self, w: int) -> int:
+    n = self._splits[w].shape[0]
+    bs = self.config.batch_size
+    return n // bs if self.config.drop_last else -(-n // bs)
+
   def check_worker_health(self):
-    """Raise if a sampling subprocess died abnormally (failure detection —
-    the reference's mp workers likewise surface nonzero exits,
-    dist_sampling_producer.py worker join handling)."""
-    for p in self._procs:
-      if p.exitcode is not None and p.exitcode != 0:
+    """Detect crashed sampling subprocesses and self-heal.
+
+    A worker with a nonzero exit code is respawned with the sampler
+    PRNG stream fast-forwarded to its last published call_count, and
+    its current epoch order is replayed from the first unsent batch —
+    bit-identical to what the dead worker would have produced (see
+    _sampling_worker_loop). After ``max_worker_restarts`` respawns the
+    producer gives up and raises, so a deterministically-crashing
+    worker cannot restart-loop forever. Thread-safe: concurrent callers
+    (the server's per-connection RPC threads) serialize on a lock, and
+    the post-lock re-read of self._procs sees a sibling's respawn as a
+    healthy worker instead of restarting it twice.
+    """
+    with self._health_lock:
+      self._check_worker_health_locked()
+
+  def _check_worker_health_locked(self):
+    for w in range(len(self._procs)):
+      p = self._procs[w]
+      if p is None or p.exitcode is None or p.exitcode == 0:
+        continue
+      if self._restarts_used >= self.max_worker_restarts:
         raise RuntimeError(
-            f'sampling worker pid={p.pid} died with exit code '
-            f'{p.exitcode}')
+            f'sampling worker {w} (pid={p.pid}) died with exit code '
+            f'{p.exitcode} and the restart budget '
+            f'({self.max_worker_restarts}) is exhausted — giving up')
+      self._restarts_used += 1
+      with self._sent.get_lock():
+        sent = int(self._sent[w])
+        calls = int(self._calls[w])
+      from ..utils import trace
+      trace.counter_inc('resilience.worker_restart')
+      import logging
+      logging.getLogger('graphlearn_tpu.producer').warning(
+          'sampling worker %d (pid=%s) died with exit code %s after %d '
+          'batches; respawning (restart %d/%d) and replaying from batch '
+          '%d', w, p.pid, p.exitcode, sent, self._restarts_used,
+          self.max_worker_restarts, sent)
+      self._spawn_worker(w, resume_calls=calls)
+      order = self._last_orders[w]
+      if order is not None and sent < self._expected_for_worker(w):
+        # mid-epoch death: replay the unfinished tail of its seed order
+        self._queues[w].put((MpCommand.SAMPLE_ALL, (order, sent)))
 
   def num_expected(self) -> int:
     bs = self.config.batch_size
@@ -256,12 +378,19 @@ class DistMpSamplingProducer:
     return total
 
   def shutdown(self):
+    """Idempotent: a second shutdown (epoch teardown racing server exit)
+    is a no-op."""
+    if getattr(self, '_shutdown_done', False):
+      return
+    self._shutdown_done = True
     for q in self._queues:
       try:
         q.put((MpCommand.STOP, None))
       except Exception:
         pass
     for p in self._procs:
+      if p is None:
+        continue
       p.join(timeout=5)
       if p.is_alive():
         import logging

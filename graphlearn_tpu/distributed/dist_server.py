@@ -16,24 +16,66 @@ import numpy as np
 
 from ..channel import QueueTimeoutError, ShmChannel
 from ..sampler import NodeSamplerInput, SamplingConfig
+from ..utils.faults import fault_point
 from .dist_context import _set_server_context, get_context
 from .dist_sampling_producer import DistMpSamplingProducer
 from .rpc import Barrier, RpcServer
 
 
 class DistServer:
-  """Reference: dist_server.py:38-176."""
+  """Reference: dist_server.py:38-176.
 
-  def __init__(self, dataset):
+  ``producer_ttl``: seconds of producer inactivity (no fetch / epoch
+  start) after which a background reaper destroys the producer and
+  releases its ShmChannel. This is the backstop against clients that
+  disconnect mid-stream without calling destroy_sampling_producer — a
+  leaked producer would otherwise hold its shm ring (and worker
+  subprocesses) until server exit. None disables reaping.
+  """
+
+  def __init__(self, dataset, producer_ttl: Optional[float] = None):
     self.dataset = dataset
     self._producers: Dict[int, DistMpSamplingProducer] = {}
     self._buffers: Dict[int, ShmChannel] = {}
+    # per-producer fetch locks: destroy (client call OR idle reaper)
+    # must not close a shm ring while a fetch thread is blocked inside
+    # its native recv — that is a use-after-free on the ring
+    self._fetch_locks: Dict[int, threading.Lock] = {}
     self._expected: Dict[int, int] = {}
     self._received: Dict[int, int] = {}
+    self._last_active: Dict[int, float] = {}
     self._next_id = 0
     self._worker_key_to_id: Dict[str, int] = {}
     self._lock = threading.RLock()
     self._exit = threading.Event()
+    self.producer_ttl = producer_ttl
+    self._reaper: Optional[threading.Thread] = None
+    if producer_ttl is not None:
+      self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+      self._reaper.start()
+
+  def _touch(self, producer_id: int):
+    self._last_active[producer_id] = time.monotonic()
+
+  def _reap_loop(self):
+    interval = min(max(self.producer_ttl / 4.0, 0.05), 30.0)
+    while not self._exit.wait(interval):
+      self.reap_idle_producers()
+
+  def reap_idle_producers(self) -> int:
+    """Destroy producers idle for longer than producer_ttl; returns the
+    number reaped (also callable directly, e.g. from tests)."""
+    if self.producer_ttl is None:
+      return 0
+    now = time.monotonic()
+    with self._lock:
+      stale = [pid for pid, ts in self._last_active.items()
+               if now - ts > self.producer_ttl]
+    for pid in stale:
+      from ..utils import trace
+      trace.counter_inc('resilience.producer_reaped')
+      self.destroy_sampling_producer(pid)
+    return len(stale)
 
   # -- producer lifecycle (reference: dist_server.py:104-147) --------------
 
@@ -41,9 +83,12 @@ class DistServer:
                                num_workers: int = 1,
                                buffer_size: int = 1 << 26,
                                worker_key: Optional[str] = None) -> int:
+    fault_point('server.create_producer')
     with self._lock:
       if worker_key is not None and worker_key in self._worker_key_to_id:
-        return self._worker_key_to_id[worker_key]
+        pid = self._worker_key_to_id[worker_key]
+        self._touch(pid)
+        return pid
       pid = self._next_id
       self._next_id += 1
       buf = ShmChannel(shm_size=buffer_size)
@@ -74,11 +119,26 @@ class DistServer:
       producer.init()
       self._producers[pid] = producer
       self._buffers[pid] = buf
+      self._fetch_locks[pid] = threading.Lock()
       self._expected[pid] = producer.num_expected()
       self._received[pid] = 0
+      self._touch(pid)
       if worker_key is not None:
         self._worker_key_to_id[worker_key] = pid
       return pid
+
+  def _live_producer(self, producer_id: int):
+    """Producer + buffer for an id, or a diagnosable error: after an
+    idle-reap or double-destroy the bare KeyError would reach the
+    client as an inscrutable remote failure."""
+    producer = self._producers.get(producer_id)
+    buf = self._buffers.get(producer_id)
+    if producer is None or buf is None:
+      raise RuntimeError(
+          f'producer {producer_id} unknown on this server — it was '
+          'destroyed or idle-reaped (producer_ttl); recreate the remote '
+          'loader to register a fresh producer')
+    return producer, buf
 
   def producer_num_expected(self, producer_id: int) -> int:
     """Exact number of batches this producer emits per epoch (its mp
@@ -86,12 +146,13 @@ class DistServer:
     this from ceil(n/batch_size) — see DistMpSamplingProducer
     .num_expected)."""
     with self._lock:
+      self._live_producer(producer_id)
       return self._expected[producer_id]
 
   def start_new_epoch_sampling(self, producer_id: int):
-    buf = self._buffers[producer_id]
-    producer = self._producers[producer_id]
     with self._lock:
+      producer, buf = self._live_producer(producer_id)
+      self._touch(producer_id)
       # Drain messages left over from an abandoned previous epoch so they
       # are not served as (and counted against) the new epoch's batches.
       # A still-producing abandoned epoch keeps writing until its seeds
@@ -115,14 +176,28 @@ class DistServer:
                                 timeout_ms: int = 500
                                 ) -> Tuple[Optional[dict], bool]:
     """(message|None, end_of_epoch). Reference: dist_server.py:149-166."""
-    producer = self._producers[producer_id]
-    buf = self._buffers[producer_id]
+    fault_point('server.fetch')
+    # one atomic preamble: existence check, touch, count check, and the
+    # fetch-lock lookup must see a consistent producer state — a racing
+    # destroy between them would otherwise KeyError (opaque remote
+    # error) or resurrect the reaped pid's _last_active entry
     with self._lock:
+      producer, buf = self._live_producer(producer_id)
+      fetch_lock = self._fetch_locks[producer_id]
+      self._touch(producer_id)
       if self._received[producer_id] >= self._expected[producer_id]:
         return None, True
     try:
-      msg = buf.recv(timeout_ms=timeout_ms)
+      with fetch_lock:
+        if producer_id not in self._buffers:   # destroyed while waiting
+          return None, True
+        msg = buf.recv(timeout_ms=timeout_ms)
     except QueueTimeoutError:
+      # nothing buffered: either the epoch is done, or a producer worker
+      # crashed mid-epoch — self-heal (restart + replay, bounded by the
+      # producer's restart budget) so the client's stream resumes
+      # instead of polling an empty ring forever
+      producer.check_worker_health()
       done = (producer.is_all_sampling_completed() and buf.empty())
       return None, done
     except StopIteration:
@@ -133,18 +208,42 @@ class DistServer:
     return msg, end
 
   def destroy_sampling_producer(self, producer_id: int):
+    """Idempotent: destroying an unknown / already-destroyed producer is
+    a no-op (a client may retry destroy after a lost response, and the
+    idle reaper may have won the race). Always releases the producer's
+    ShmChannel — the shm ring must not outlive the producer, or
+    create/destroy churn across epochs leaks shared memory."""
     with self._lock:
       producer = self._producers.pop(producer_id, None)
       buf = self._buffers.pop(producer_id, None)
       self._expected.pop(producer_id, None)
       self._received.pop(producer_id, None)
+      self._last_active.pop(producer_id, None)
+      fetch_lock = self._fetch_locks.pop(producer_id, None)
       for k, v in list(self._worker_key_to_id.items()):
         if v == producer_id:
           del self._worker_key_to_id[k]
     if producer:
       producer.shutdown()
     if buf:
-      buf.close()
+      if fetch_lock is not None:
+        # wait out any fetch blocked in the ring's native recv (bounded
+        # by the fetch poll timeout) before freeing the shared memory
+        with fetch_lock:
+          buf.close()
+      else:
+        buf.close()
+    return True
+
+  def heartbeat(self) -> dict:
+    """Cheap liveness probe (resilience.Heartbeat polls this): answers
+    while the RPC loop is alive. Deliberately LOCK-FREE — self._lock is
+    held across slow operations (producer.init subprocess spawning,
+    epoch-start ring drains), and a probe blocked behind one of those
+    would make a busy-but-healthy server miss its liveness deadline and
+    get failed over for no reason. len() is atomic under the GIL."""
+    return dict(ok=True, time=time.time(),
+                n_producers=len(self._producers))
 
   # -- misc (reference: dist_server.py:60-102) -----------------------------
 
@@ -160,6 +259,9 @@ class DistServer:
                 edge_dir=self.dataset.edge_dir)
 
   def exit(self):
+    """Idempotent shutdown: destroys every producer (releasing all shm)
+    and signals wait_for_exit; a second exit (client retry, multi-client
+    fan-out) is a no-op."""
     for pid in list(self._producers):
       self.destroy_sampling_producer(pid)
     self._exit.set()
@@ -179,13 +281,20 @@ def get_server() -> Optional[DistServer]:
 
 def init_server(num_servers: int, num_clients: int, server_rank: int,
                 dataset, master_addr: str = '127.0.0.1',
-                server_client_master_port: int = 0) -> Tuple[str, int]:
+                server_client_master_port: int = 0,
+                producer_ttl: Optional[float] = None) -> Tuple[str, int]:
   """Start this server's RPC endpoint (reference: dist_server.py:180-212).
   Returns (host, port) — hand these to clients (the reference's tensorpipe
-  rendezvous becomes explicit address exchange)."""
+  rendezvous becomes explicit address exchange). ``producer_ttl`` bounds
+  how long a producer abandoned by a disconnected client holds its shm
+  ring (docs/failure_model.md). Off by default: a live client that
+  pauses between epochs (eval, checkpointing) longer than the ttl would
+  otherwise lose its producer; arm it when clients are expected to
+  vanish without calling destroy, and keep it far above the longest
+  legitimate between-epoch pause."""
   global _server, _rpc_server
   _set_server_context(num_servers, num_clients, server_rank)
-  _server = DistServer(dataset)
+  _server = DistServer(dataset, producer_ttl=producer_ttl)
   s = _server
   barrier = Barrier(num_clients)
   # handlers registered at construction: the server accepts connections
@@ -200,6 +309,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
           'fetch_one_sampled_message': s.fetch_one_sampled_message,
           'destroy_sampling_producer': s.destroy_sampling_producer,
           'get_dataset_meta': s.get_dataset_meta,
+          'heartbeat': s.heartbeat,
           'exit': s.exit,
           'client_barrier': barrier.arrive,
       })

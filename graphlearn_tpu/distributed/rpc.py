@@ -137,8 +137,12 @@ class RpcServer:
             client_nonce = _recv_exact(sock, 32)
             sock.sendall(_hmac_of(outer._secret, client_nonce,
                                   role=b'server'))
+          from ..utils.faults import fault_point
           while True:
             req = _recv_frame(sock)
+            # armed 'delay' simulates a hung server (liveness-test
+            # territory); 'raise' tears the connection down mid-stream
+            fault_point('rpc.server.dispatch')
             try:
               fn = outer._handlers[req['func']]
               result = fn(*req.get('args', ()), **req.get('kwargs', {}))
@@ -190,12 +194,19 @@ class RpcClient:
   def targets(self) -> List[int]:
     return sorted(self._addrs)
 
-  def _conn(self, rank: int) -> socket.socket:
+  def _conn(self, rank: int,
+            connect_timeout: Optional[float] = None) -> socket.socket:
     conns = getattr(self._local, 'conns', None)
     if conns is None:
       conns = self._local.conns = {}
     if rank not in conns:
-      s = socket.create_connection(self._addrs[rank], timeout=180)
+      # the caller's per-request timeout must bound the CONNECT too: a
+      # blackholed peer (partition, no RST) would otherwise stall every
+      # reconnecting probe for the full 180 s default, defeating the
+      # heartbeat's seconds-scale detection promise
+      s = socket.create_connection(self._addrs[rank],
+                                   timeout=connect_timeout or 180)
+      s.settimeout(180)   # per-request timeouts are applied in _attempt
       s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
       if self._secret is not None:
         # answer the server's HMAC challenge, then verify the server's
@@ -203,8 +214,11 @@ class RpcClient:
         # module trust model). Short timeout on the nonce read: a
         # secret-less server sends no challenge, and without this the
         # config mismatch would hang for the full 180 s socket timeout
-        # with a generic error.
-        s.settimeout(10)
+        # with a generic error. The caller's connect budget bounds the
+        # handshake too — a heartbeat probe must not wait 10 s on a
+        # wedged-but-accepting peer.
+        s.settimeout(min(10, connect_timeout) if connect_timeout
+                     else 10)
         try:
           nonce = _recv_exact(s, 32)
           my_nonce = _secrets.token_bytes(32)
@@ -241,40 +255,63 @@ class RpcClient:
       except OSError:
         pass
 
+  def _attempt(self, rank: int, func: str, args, kwargs,
+               timeout: Optional[float]):
+    """One request/response round trip on the pooled connection."""
+    from ..utils.faults import fault_point
+    try:
+      fault_point('rpc.client.request')
+      sock = self._conn(rank, connect_timeout=timeout)
+      if timeout is not None:
+        sock.settimeout(timeout)
+      _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs})
+      resp = _recv_frame(sock)
+      fault_point('rpc.client.response')
+      if timeout is not None:
+        sock.settimeout(180)
+    except socket.timeout as e:
+      # normalize to TimeoutError so retry_on and callers see one type
+      self._drop_conn(rank)
+      raise TimeoutError(
+          f'rpc to rank {rank} func {func!r} timed out after '
+          f'{timeout}s') from e
+    except (ConnectionError, EOFError, OSError):
+      # a broken pooled connection must not poison the next attempt
+      self._drop_conn(rank)
+      raise
+    if not resp['ok']:
+      raise RuntimeError(
+          f'remote error from rank {rank}: {resp["error"]}')
+    return resp['result']
+
   def request_sync(self, rank: int, func: str, *args,
-                   timeout: Optional[float] = None, retries: int = 0,
-                   **kwargs):
+                   timeout: Optional[float] = None,
+                   idempotent: bool = False,
+                   retry_policy=None, **kwargs):
     """reference: rpc_request / _rpc_call sync path (rpc.py:422-447).
 
     ``timeout`` bounds each attempt (socket-level, seconds; the reference
-    wraps every RPC in rpc_timeout, rpc.py:92-117); ``retries`` re-sends
-    on connection failure/timeout over a FRESH connection. Retries are
-    only safe for idempotent callees.
+    wraps every RPC in rpc_timeout, rpc.py:92-117). Failed attempts are
+    retried — with exponential backoff + jitter under ``retry_policy``
+    (default resilience.DEFAULT_RETRY_POLICY) — ONLY when the caller
+    declares the callee ``idempotent=True``: a retry after a lost
+    response re-executes the remote side effect, so non-idempotent
+    calls get exactly one attempt and surface the first error.
     """
-    last_err = None
-    for attempt in range(retries + 1):
-      try:
-        sock = self._conn(rank)
-        if timeout is not None:
-          sock.settimeout(timeout)
-        _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs})
-        resp = _recv_frame(sock)
-        if timeout is not None:
-          sock.settimeout(180)
-        if not resp['ok']:
-          raise RuntimeError(
-              f'remote error from rank {rank}: {resp["error"]}')
-        return resp['result']
-      except (ConnectionError, EOFError, socket.timeout, OSError) as e:
-        last_err = e
-        self._drop_conn(rank)
-        if attempt >= retries:
-          raise TimeoutError(
-              f'rpc to rank {rank} func {func!r} failed after '
-              f'{attempt + 1} attempt(s): {e}') from e
-        logger.warning('rpc to rank %d func %r failed (%s); retrying '
-                       '(%d/%d)', rank, func, e, attempt + 1, retries)
-    raise last_err  # unreachable
+    from .resilience import DEFAULT_RETRY_POLICY, NO_RETRY
+    if retry_policy is not None and not idempotent:
+      raise ValueError(
+          f'retry_policy passed for rpc {func!r} without idempotent=True '
+          '— retrying a non-idempotent call can duplicate its side '
+          'effect; declare the callee idempotent to opt into retry')
+    policy = (retry_policy or DEFAULT_RETRY_POLICY) if idempotent \
+        else NO_RETRY
+    if timeout is None:
+      timeout = policy.per_attempt_timeout
+    return policy.run(
+        self._attempt, rank, func, args, kwargs, timeout,
+        retry_on=(ConnectionError, TimeoutError, OSError, EOFError),
+        describe=f'rpc to rank {rank} func {func!r}')
 
   def request_async(self, rank: int, func: str, *args, **kwargs) -> Future:
     """reference: rpc_request_async (rpc.py:422-447)"""

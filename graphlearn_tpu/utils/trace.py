@@ -199,6 +199,46 @@ def wrap_dispatch(fn: Callable, name: Optional[str] = None) -> Callable:
   return wrapper
 
 
+# ---------------------------------------------------------------- counters
+# Named event counters: the resilience layer (distributed/resilience.py)
+# reports degradation events here — retries, failovers, worker restarts,
+# injected faults — so a degraded-but-completed epoch is visible without
+# log scraping. Process-local; increments come from many threads at once
+# (heartbeat probes, pullers, RPC handler threads), and a dict
+# read-modify-write can interleave at bytecode boundaries, so a lock
+# guards the add. Read with counters()/counter_get, zero with
+# reset_counters().
+import threading as _threading
+
+_counters: dict = {}
+_counters_lock = _threading.Lock()
+
+
+def counter_inc(name: str, n: int = 1):
+  """Add ``n`` to the named event counter (creating it at 0)."""
+  with _counters_lock:
+    _counters[name] = _counters.get(name, 0) + n
+
+
+def counter_get(name: str) -> int:
+  with _counters_lock:
+    return _counters.get(name, 0)
+
+
+def counters(prefix: str = '') -> dict:
+  """Snapshot of counters, optionally filtered by name prefix."""
+  with _counters_lock:
+    return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = ''):
+  """Zero counters matching ``prefix`` (all by default)."""
+  with _counters_lock:
+    for k in list(_counters):
+      if k.startswith(prefix):
+        del _counters[k]
+
+
 _active = False
 
 

@@ -30,8 +30,70 @@ try:
 except AttributeError:
   pass
 
+import signal
+
 import numpy as np
 import pytest
+
+# ---------------------------------------------------------- per-test alarm
+# A deadlocked distributed test (hung channel recv, stuck barrier, dead
+# subprocess join) must fail fast with a diagnosable error instead of
+# eating the whole tier-1 suite budget. pytest-timeout is not in the
+# image, so this is the conftest-level equivalent: a SIGALRM fires after
+# GLT_TEST_TIMEOUT seconds (default 300) and raises in the test's main
+# thread. Override per test with @pytest.mark.timeout(seconds).
+# Posix-only and main-thread-only — exactly where pytest runs test code.
+
+_DEFAULT_TIMEOUT = int(os.environ.get('GLT_TEST_TIMEOUT', '300'))
+
+
+class TestDeadlineError(Exception):
+  """Raised in-test when the per-test alarm fires."""
+
+
+def pytest_configure(config):
+  config.addinivalue_line(
+      'markers', 'timeout(seconds): override the per-test alarm '
+      f'(default GLT_TEST_TIMEOUT={_DEFAULT_TIMEOUT}s)')
+
+
+def _alarm_wrapper(item, nursery):
+  """Arm SIGALRM around one test phase; a hang in fixture setup or
+  teardown must fail fast too, not just one in the test body."""
+  marker = item.get_closest_marker('timeout')
+  seconds = int(marker.args[0]) if marker and marker.args \
+      else _DEFAULT_TIMEOUT
+  if seconds <= 0 or not hasattr(signal, 'SIGALRM'):
+    return (yield)
+
+  def on_alarm(signum, frame):
+    raise TestDeadlineError(
+        f'test {nursery} exceeded the {seconds}s per-test alarm '
+        '(GLT_TEST_TIMEOUT / @pytest.mark.timeout) — likely a deadlock '
+        'in a distributed path; see the traceback for where it hung')
+
+  prev = signal.signal(signal.SIGALRM, on_alarm)
+  signal.alarm(seconds)
+  try:
+    return (yield)
+  finally:
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, prev)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_setup(item):
+  return (yield from _alarm_wrapper(item, 'setup'))
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+  return (yield from _alarm_wrapper(item, 'call'))
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_teardown(item):
+  return (yield from _alarm_wrapper(item, 'teardown'))
 
 
 @pytest.fixture

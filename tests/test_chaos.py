@@ -1,0 +1,463 @@
+"""Chaos suite: kill servers / workers / connections at armed fault
+sites and assert epochs still complete with the right batches (ISSUE 2
+acceptance). The deterministic fault harness is utils/faults.py; faults
+cross process boundaries via the GLT_FAULTS env var (spawned servers and
+sampling workers inherit and parse it at import).
+
+tier-1 runs the acceptance scenarios (SIGKILL a sampling server
+mid-epoch; kill a producer worker and replay bit-identically); the
+`slow`-marked variants extend them (multi-kill, repeated churn)."""
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.utils import faults, trace
+
+N = 40
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  faults.disarm()
+  trace.reset_counters()
+  yield
+  faults.disarm()
+  trace.reset_counters()
+
+
+def make_dataset(n=N):
+  rows = np.concatenate([np.arange(n), np.arange(n)])
+  cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n])
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), graph_mode='CPU', num_nodes=n)
+  feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  ds.init_node_features(feat)
+  ds.init_node_labels(np.arange(n) % 3)
+  return ds
+
+
+# ------------------------------------------------- server SIGKILL failover
+
+
+def _chaos_server_main(rank, q, ready, faults_spec=None):
+  import jax
+  try:
+    jax.config.update('jax_platforms', 'cpu')
+  except RuntimeError:
+    pass
+  import graphlearn_tpu as glt_mod
+  if faults_spec:
+    # arm per-server faults — e.g. a fetch delay that throttles THIS
+    # server so a kill is guaranteed to land while it still holds
+    # undelivered batches. Armed via the registry (not GLT_FAULTS): the
+    # spawn re-import of this test module already imported glt (and
+    # parsed the env) before this function body runs.
+    from graphlearn_tpu.utils import faults as faults_mod
+    faults_mod._parse_env(faults_spec)
+  host, port = glt_mod.distributed.init_server(
+      num_servers=2, num_clients=1, server_rank=rank,
+      dataset=make_dataset())
+  q.put((rank, host, port))
+  ready.wait(timeout=180)
+  glt_mod.distributed.wait_and_shutdown_server(timeout=300)
+
+
+def test_sigkill_server_mid_epoch_failover():
+  """Acceptance: 2 sampling servers, SIGKILL one mid-epoch — the remote
+  loader detects the death (TCP reset / heartbeat), redistributes the
+  victim's unacked seeds to the survivor, and completes the epoch with
+  the exact expected batch count and full seed coverage. A second epoch
+  then runs against the degraded cluster (dead rank failed over at
+  epoch start)."""
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  ready = ctx.Event()
+  # rank 1 (the victim) serves each fetch ~0.3s slower than its probe
+  # budget allows, so when the kill lands it is guaranteed to still
+  # hold undelivered batches (otherwise the tiny epoch could fully
+  # prefetch before the signal and no failover would be exercised)
+  servers = [ctx.Process(target=_chaos_server_main,
+                         args=(r, q, ready,
+                               'server.fetch:delay:delay=0.3'
+                               if r == 1 else None))
+             for r in range(2)]
+  try:
+    for s in servers:
+      s.start()
+    addrs_by_rank = {}
+    for _ in range(2):
+      r, host, port = q.get(timeout=180)
+      addrs_by_rank[r] = (host, port)
+    ready.set()
+    glt.distributed.init_client(
+        num_servers=2, num_clients=1, client_rank=0,
+        server_addrs=[addrs_by_rank[0], addrs_by_rank[1]])
+    opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+        server_rank=[0, 1], num_workers=1, prefetch_size=2,
+        heartbeat_interval=0.5, heartbeat_miss=3)
+    loader = glt.distributed.RemoteDistNeighborLoader(
+        [2, 2], np.arange(N), batch_size=4, collect_features=True,
+        worker_options=opts, seed=0)
+    expected = len(loader)
+    assert expected == 10          # 2 servers x 20 seeds / bs 4
+
+    # epoch 1: kill rank 1 after a few delivered batches
+    count, seen = 0, []
+    t0 = time.monotonic()
+    for batch in loader:
+      count += 1
+      seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+      if count == 3:
+        os.kill(servers[1].pid, signal.SIGKILL)
+    elapsed = time.monotonic() - t0
+    assert count == expected, f'{count} != {expected}'
+    assert sorted(seen) == list(range(N))     # every seed exactly once
+    assert trace.counter_get('resilience.failover') >= 1
+    # within the retry/deadline budget, not the 180 s socket timeout
+    assert elapsed < 120, f'epoch took {elapsed:.0f}s'
+
+    # epoch 2 on the degraded cluster: dead rank's full share fails
+    # over at epoch start, batch count and coverage still exact
+    count, seen = 0, []
+    for batch in loader:
+      count += 1
+      seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+    assert count == expected
+    assert sorted(seen) == list(range(N))
+
+    loader.shutdown()
+    glt.distributed.shutdown_client()
+  finally:
+    for s in servers:
+      if s.is_alive():
+        s.terminate()
+      s.join(timeout=30)
+
+
+# --------------------------------------------- injected fetch-path failover
+
+
+def _start_inproc_server(dataset, secret=None):
+  """A DistServer + RpcServer wired up in THIS process (no spawn): fast,
+  and fault sites can be armed in-process deterministically."""
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  from graphlearn_tpu.distributed.rpc import RpcServer
+  s = DistServer(dataset)
+  rpc = RpcServer(handlers={
+      'create_sampling_producer': s.create_sampling_producer,
+      'producer_num_expected': s.producer_num_expected,
+      'start_new_epoch_sampling': s.start_new_epoch_sampling,
+      'fetch_one_sampled_message': s.fetch_one_sampled_message,
+      'destroy_sampling_producer': s.destroy_sampling_producer,
+      'get_dataset_meta': s.get_dataset_meta,
+      'heartbeat': s.heartbeat,
+      'exit': s.exit,
+  })
+  return s, rpc
+
+
+def test_injected_fetch_failure_triggers_failover():
+  """The channel.remote.fetch fault site stands in for a dropped
+  connection: one fetch raises, the (server, producer) pair is declared
+  dead, and the loader completes the epoch by failing the pair's
+  unacked seeds over to the surviving server — no real process dies."""
+  from graphlearn_tpu.distributed import dist_client
+  ds = make_dataset()
+  pairs = [_start_inproc_server(ds) for _ in range(2)]
+  try:
+    dist_client.init_client(
+        num_servers=2, num_clients=1, client_rank=0,
+        server_addrs=[(rpc.host, rpc.port) for _, rpc in pairs])
+    opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+        server_rank=[0, 1], num_workers=1, prefetch_size=2,
+        heartbeat_interval=0.5)
+    loader = glt.distributed.RemoteDistNeighborLoader(
+        [2, 2], np.arange(N), batch_size=4, collect_features=True,
+        worker_options=opts, seed=0)
+    expected = len(loader)
+    # fail the 5th fetch, once — mid-epoch, after some batches landed
+    faults.arm('channel.remote.fetch', 'raise', exc=ConnectionError,
+               after=4, times=1)
+    count, seen = 0, []
+    for batch in loader:
+      count += 1
+      seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+    assert count == expected
+    assert sorted(seen) == list(range(N))
+    assert trace.counter_get('fault.channel.remote.fetch') == 1
+    assert trace.counter_get('resilience.failover') == 1
+    loader.shutdown()
+  finally:
+    faults.disarm()
+    dist_client._client.close()
+    dist_client._client = None
+    for s, rpc in pairs:
+      s.exit()
+      rpc.shutdown()
+
+
+def make_hetero_dataset():
+  ub = np.array([[0, 0, 1, 2, 2, 3, 4, 5], [0, 1, 2, 3, 0, 1, 2, 3]])
+  UB, BU = ('user', 'buys', 'item'), ('item', 'rev_buys', 'user')
+  ds = glt.data.Dataset(edge_dir='out')
+  ds.init_graph({UB: ub, BU: ub[::-1].copy()}, graph_mode='CPU',
+                num_nodes={UB: 6, BU: 4})
+  ds.init_node_features(
+      {'user': np.arange(6, dtype=np.float32)[:, None] *
+       np.ones((1, 3), np.float32),
+       'item': 100.0 + np.arange(4, dtype=np.float32)[:, None] *
+       np.ones((1, 3), np.float32)})
+  ds.init_node_labels({'user': np.arange(6) % 2})
+  return ds
+
+
+def test_injected_fetch_failure_failover_hetero():
+  """Failover for TYPED seeds: the replacement producers must re-ship
+  NodeSamplerInputs with the input type, or the surviving server's
+  typed-graph contract rejects them."""
+  from graphlearn_tpu.distributed import dist_client
+  ds = make_hetero_dataset()
+  pairs = [_start_inproc_server(ds) for _ in range(2)]
+  try:
+    dist_client.init_client(
+        num_servers=2, num_clients=1, client_rank=0,
+        server_addrs=[(rpc.host, rpc.port) for _, rpc in pairs])
+    opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+        server_rank=[0, 1], num_workers=1, prefetch_size=2,
+        heartbeat_interval=0.5)
+    loader = glt.distributed.RemoteDistNeighborLoader(
+        {('user', 'buys', 'item'): [2, 2],
+         ('item', 'rev_buys', 'user'): [2, 2]},
+        ('user', np.arange(6)), batch_size=2, collect_features=True,
+        worker_options=opts, seed=0)
+    faults.arm('channel.remote.fetch', 'raise', exc=ConnectionError,
+               after=2, times=1)
+    seen = []
+    for batch in loader:
+      seen.extend(
+          np.asarray(batch.batch['user'])[:batch.batch_size].tolist())
+    assert sorted(seen) == list(range(6))
+    assert trace.counter_get('resilience.failover') == 1
+    loader.shutdown()
+  finally:
+    faults.disarm()
+    dist_client._client.close()
+    dist_client._client = None
+    for s, rpc in pairs:
+      s.exit()
+      rpc.shutdown()
+
+
+# ------------------------------------------- producer worker kill + replay
+
+
+def _epoch_fingerprint(loader):
+  """{sorted seed tuple -> canonical batch bytes} for one epoch.
+
+  Batches arrive in nondeterministic interleave across workers, so the
+  bit-identical comparison keys each batch by its seed set and compares
+  the full array content."""
+  out = {}
+  for batch in loader:
+    bs = batch.batch_size
+    key = tuple(sorted(np.asarray(batch.batch)[:bs].tolist()))
+    blob = b''.join(
+        np.ascontiguousarray(np.asarray(a)).tobytes()
+        for a in (batch.node, batch.edge_index, batch.edge_mask,
+                  batch.x, batch.y, batch.batch)
+        if a is not None)
+    assert key not in out, f'duplicate batch for seeds {key}'
+    out[key] = blob
+  return out
+
+
+def test_worker_kill_bit_identical_replay(monkeypatch):
+  """Acceptance: kill a producer worker mid-epoch; the producer
+  respawns it with the PRNG stream fast-forwarded and replays the
+  unfinished seed blocks — the epoch's batches are bit-identical to an
+  undisturbed run (shuffle=False)."""
+  ds = make_dataset()
+  loader = glt.distributed.MpDistNeighborLoader(
+      ds, [2, 2], np.arange(N), batch_size=4, shuffle=False,
+      num_workers=2, seed=0)
+  try:
+    reference = _epoch_fingerprint(loader)
+    assert len(reference) == len(loader) == 10
+  finally:
+    loader.shutdown()
+
+  # arm the worker-kill via env: sampling workers are spawned processes
+  # and parse GLT_FAULTS at import. after=3 → each worker incarnation
+  # dies at its 4th *attempted* batch; the respawned worker starts at
+  # batch 3, never accrues 4 site hits, and finishes the epoch.
+  monkeypatch.setenv(
+      'GLT_FAULTS', 'producer.worker.batch:exit:after=3,times=1,code=17')
+  loader = glt.distributed.MpDistNeighborLoader(
+      ds, [2, 2], np.arange(N), batch_size=4, shuffle=False,
+      num_workers=2, seed=0, max_worker_restarts=4)
+  loader.health_check_interval_ms = 500
+  try:
+    replayed = _epoch_fingerprint(loader)
+    assert trace.counter_get('resilience.worker_restart') >= 1
+    assert replayed.keys() == reference.keys()
+    for key in reference:
+      assert replayed[key] == reference[key], \
+          f'batch for seeds {key} diverged after replay'
+  finally:
+    loader.shutdown()
+
+
+def test_worker_giveup_after_restart_budget(monkeypatch):
+  """Satellite: a deterministically-crashing worker exhausts the
+  restart budget and surfaces a RuntimeError instead of restart-looping
+  forever."""
+  ds = make_dataset(16)
+  # after=1 → every incarnation dies at its 2nd attempted batch, so the
+  # worker can never finish its 4 batches and the budget runs out
+  monkeypatch.setenv('GLT_FAULTS',
+                     'producer.worker.batch:exit:after=1,code=23')
+  loader = glt.distributed.MpDistNeighborLoader(
+      ds, [2], np.arange(16), batch_size=4, shuffle=False,
+      num_workers=1, seed=0, max_worker_restarts=1)
+  loader.health_check_interval_ms = 500
+  try:
+    with pytest.raises(RuntimeError, match='restart budget'):
+      list(loader)
+    assert trace.counter_get('resilience.worker_restart') == 1
+  finally:
+    loader.shutdown()
+
+
+def test_worker_restart_and_replay_completes_epoch(monkeypatch):
+  """Satellite restart-and-replay, server-side flavor: the crash hits a
+  producer owned by a DistServer and the self-heal happens inside
+  fetch_one_sampled_message's timeout path."""
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  from graphlearn_tpu.sampler import SamplingConfig, SamplingType
+  monkeypatch.setenv(
+      'GLT_FAULTS', 'producer.worker.batch:exit:after=2,times=1,code=19')
+  ds = make_dataset(16)
+  server = DistServer(ds)
+  try:
+    cfg = SamplingConfig(SamplingType.NODE, [2], 4, False, False, False,
+                         True, False, False, 'out', 0)
+    pid = server.create_sampling_producer(np.arange(16), cfg,
+                                          num_workers=1)
+    server.start_new_epoch_sampling(pid)
+    got, deadline = 0, time.monotonic() + 120
+    while time.monotonic() < deadline:
+      msg, end = server.fetch_one_sampled_message(pid, timeout_ms=500)
+      if msg is not None:
+        got += 1
+      if end:
+        break
+    assert got == server.producer_num_expected(pid) == 4
+    assert trace.counter_get('resilience.worker_restart') == 1
+  finally:
+    server.exit()
+
+
+# ----------------------------------------------------- degraded delivery
+
+
+def test_dropped_message_degrades_without_hanging(monkeypatch):
+  """A lost channel message (channel.shm.send armed 'drop' in the
+  worker) must not hang the epoch: the loader drains what arrived and
+  terminates when the producers report completion."""
+  ds = make_dataset(16)
+  monkeypatch.setenv('GLT_FAULTS', 'channel.shm.send:drop:times=1')
+  loader = glt.distributed.MpDistNeighborLoader(
+      ds, [2], np.arange(16), batch_size=4, shuffle=False,
+      num_workers=1, seed=0)
+  loader.health_check_interval_ms = 500
+  try:
+    t0 = time.monotonic()
+    batches = list(loader)
+    assert len(batches) == len(loader) - 1     # one message lost
+    assert time.monotonic() - t0 < 60
+  finally:
+    loader.shutdown()
+
+
+# ------------------------------------------------------- slow variants
+
+
+@pytest.mark.slow
+def test_sigkill_repeated_epochs_slow():
+  """Extended chaos: several epochs of create/kill/failover churn on a
+  2-server cluster (the tier-1 variant kills once; this loops)."""
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  ready = ctx.Event()
+  servers = [ctx.Process(target=_chaos_server_main,
+                         args=(r, q, ready,
+                               'server.fetch:delay:delay=0.3'
+                               if r == 1 else None))
+             for r in range(2)]
+  try:
+    for s in servers:
+      s.start()
+    addrs_by_rank = {}
+    for _ in range(2):
+      r, host, port = q.get(timeout=180)
+      addrs_by_rank[r] = (host, port)
+    ready.set()
+    glt.distributed.init_client(
+        num_servers=2, num_clients=1, client_rank=0,
+        server_addrs=[addrs_by_rank[0], addrs_by_rank[1]])
+    opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+        server_rank=[0, 1], num_workers=1, prefetch_size=2,
+        heartbeat_interval=0.5)
+    loader = glt.distributed.RemoteDistNeighborLoader(
+        [2, 2], np.arange(N), batch_size=4, collect_features=True,
+        worker_options=opts, seed=0)
+    killed = False
+    for epoch in range(4):
+      count, seen = 0, []
+      for batch in loader:
+        count += 1
+        seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+        if epoch == 1 and count == 2 and not killed:
+          os.kill(servers[1].pid, signal.SIGKILL)
+          killed = True
+      assert count == len(loader)
+      assert sorted(seen) == list(range(N))
+    loader.shutdown()
+    glt.distributed.shutdown_client()
+  finally:
+    for s in servers:
+      if s.is_alive():
+        s.terminate()
+      s.join(timeout=30)
+
+
+@pytest.mark.slow
+def test_shm_churn_many_cycles_slow():
+  """Extended shutdown-leak regression: many create/kill/destroy cycles
+  keep shm usage flat (tier-1 runs the 3-cycle variant in
+  test_resilience.py)."""
+  from graphlearn_tpu.channel import live_channel_count
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  from graphlearn_tpu.sampler import SamplingConfig, SamplingType
+  ds = make_dataset(16)
+  server = DistServer(ds)
+  cfg = SamplingConfig(SamplingType.NODE, [2], 4, False, False, False,
+                       False, False, False, 'out', 0)
+  base = live_channel_count()
+  try:
+    for i in range(8):
+      pid = server.create_sampling_producer(np.arange(16), cfg,
+                                            num_workers=1)
+      server.start_new_epoch_sampling(pid)
+      if i % 2 == 0:   # sometimes kill the worker before destroying
+        server._producers[pid]._procs[0].terminate()
+      server.destroy_sampling_producer(pid)
+      assert live_channel_count() == base
+  finally:
+    server.exit()

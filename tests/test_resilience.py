@@ -1,0 +1,491 @@
+"""Unit tests for the resilience layer: RetryPolicy backoff/budgets, the
+fault-injection registry, Heartbeat liveness, rpc idempotent-retry
+semantics, contextual channel timeouts, and shutdown/shm-release
+invariants (ISSUE 2 satellites)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.utils import faults, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+  faults.disarm()
+  trace.reset_counters()
+  yield
+  faults.disarm()
+  trace.reset_counters()
+
+
+# ---------------------------------------------------------------- RetryPolicy
+
+
+def test_retry_policy_backoff_schedule_deterministic():
+  from graphlearn_tpu.distributed import RetryPolicy
+  p = RetryPolicy(max_attempts=4, base_delay=0.1, max_delay=0.3,
+                  multiplier=2.0, jitter=0.5, seed=7)
+  d1, d2 = list(p.delays()), list(p.delays())
+  assert d1 == d2                      # deterministic jitter
+  assert len(d1) == 3                  # one delay per retry
+  # exponential growth capped at max_delay, jitter only shrinks
+  caps = [0.1, 0.2, 0.3]
+  for d, cap in zip(d1, caps):
+    assert cap * 0.5 <= d <= cap
+
+
+def test_retry_policy_retries_then_succeeds():
+  from graphlearn_tpu.distributed import RetryPolicy
+  calls = []
+
+  def flaky():
+    calls.append(1)
+    if len(calls) < 3:
+      raise ConnectionError('transient')
+    return 'ok'
+
+  p = RetryPolicy(max_attempts=4, base_delay=0.01, total_deadline=10)
+  assert p.run(flaky) == 'ok'
+  assert len(calls) == 3
+  assert trace.counter_get('resilience.retry') == 2
+
+
+def test_retry_policy_exhausts_attempts():
+  from graphlearn_tpu.distributed import DeadlineExceeded, RetryPolicy
+  p = RetryPolicy(max_attempts=3, base_delay=0.005, total_deadline=10)
+  calls = []
+
+  def always_fail():
+    calls.append(1)
+    raise TimeoutError('nope')
+
+  with pytest.raises(DeadlineExceeded, match='after 3 attempt'):
+    p.run(always_fail)
+  assert len(calls) == 3
+
+
+def test_retry_policy_total_deadline_stops_early():
+  from graphlearn_tpu.distributed import DeadlineExceeded, RetryPolicy
+  # huge attempt budget, tiny wall budget: the deadline must win and the
+  # policy must never sleep past it
+  p = RetryPolicy(max_attempts=100, base_delay=0.2, multiplier=1.0,
+                  jitter=0.0, total_deadline=0.5)
+  t0 = time.monotonic()
+  with pytest.raises(DeadlineExceeded):
+    p.run(lambda: (_ for _ in ()).throw(ConnectionError('x')))
+  assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_policy_non_retryable_error_propagates():
+  from graphlearn_tpu.distributed import RetryPolicy
+  calls = []
+
+  def boom():
+    calls.append(1)
+    raise ValueError('logic bug')
+
+  with pytest.raises(ValueError):
+    RetryPolicy(max_attempts=5, base_delay=0.01).run(boom)
+  assert len(calls) == 1   # no retry on non-network errors
+
+
+# ---------------------------------------------------------------- faults
+
+
+def test_fault_point_disarmed_is_noop_no_dispatch(monkeypatch):
+  """Acceptance: fault_point is zero-overhead when disarmed — the slow
+  handler is never even dispatched (checked by making it explode)."""
+  monkeypatch.setattr(faults, '_fire',
+                      lambda name: (_ for _ in ()).throw(
+                          AssertionError('dispatched while disarmed')))
+  assert not faults.armed()
+  for _ in range(1000):
+    assert faults.fault_point('anything') is None
+  assert trace.counters('fault.') == {}
+
+
+def test_fault_point_raise_delay_drop_and_counters():
+  with faults.injected('site.a', 'raise', times=2):
+    with pytest.raises(faults.FaultError):
+      faults.fault_point('site.a')
+    with pytest.raises(faults.FaultError):
+      faults.fault_point('site.a')
+    assert faults.fault_point('site.a') is None   # times exhausted
+  assert trace.counter_get('fault.site.a') == 2
+  with faults.injected('site.b', 'drop', after=1):
+    assert faults.fault_point('site.b') is None   # skipped (after=1)
+    assert faults.fault_point('site.b') == 'drop'
+  with faults.injected('site.c', 'delay', delay=0.05, times=1):
+    t0 = time.monotonic()
+    faults.fault_point('site.c')
+    assert time.monotonic() - t0 >= 0.05
+  # custom exception type
+  with faults.injected('site.d', 'raise', exc=ConnectionError):
+    with pytest.raises(ConnectionError):
+      faults.fault_point('site.d')
+
+
+def test_fault_env_spec_roundtrip():
+  faults._parse_env('x.y:exit:after=3,times=1,code=17;p.q:raise')
+  try:
+    f = faults.armed()['x.y']
+    assert (f.kind, f.after, f.times, f.code) == ('exit', 3, 1, 17)
+    assert faults.armed()['p.q'].kind == 'raise'
+  finally:
+    faults.disarm()
+  with pytest.raises(ValueError):
+    faults._parse_env('bad:raise:exc=NotAnException')
+
+
+# ---------------------------------------------------------------- Heartbeat
+
+
+def test_heartbeat_declares_dead_after_misses():
+  from graphlearn_tpu.distributed import Heartbeat
+  healthy = threading.Event()
+  healthy.set()
+  deaths = []
+
+  def probe(rank):
+    if not healthy.is_set():
+      raise ConnectionError('down')
+
+  hb = Heartbeat([0], probe, interval=0.05, miss_threshold=3,
+                 on_dead=lambda r, c: deaths.append(r))
+  hb.start()
+  try:
+    time.sleep(0.3)
+    assert not hb.dead_ranks()
+    healthy.clear()
+    # wait on the on_dead callback — the LAST step of the death path —
+    # so the dict/counter asserts below cannot race the probe thread
+    deadline = time.monotonic() + 10
+    while not deaths and time.monotonic() < deadline:
+      time.sleep(0.02)
+    assert hb.is_dead(0)           # ~interval * miss_threshold, not 180 s
+    assert deaths == [0]
+    assert trace.counter_get('resilience.server_dead') == 1
+  finally:
+    hb.stop()
+
+
+def test_heartbeat_probe_fault_site():
+  """The heartbeat.probe fault site starves the tracker: with every
+  probe failing by injection, the rank is declared dead even though no
+  real server is involved."""
+  from graphlearn_tpu.distributed import Heartbeat
+  faults.arm('heartbeat.probe', 'raise', exc=ConnectionError)
+  hb = Heartbeat([3], lambda rank: None, interval=0.05,
+                 miss_threshold=2)
+  hb.start()
+  try:
+    deadline = time.monotonic() + 5
+    while not hb.is_dead(3) and time.monotonic() < deadline:
+      time.sleep(0.02)
+    assert hb.is_dead(3)
+    assert trace.counter_get('fault.heartbeat.probe') >= 2
+  finally:
+    hb.stop()
+
+
+def test_heartbeat_mark_dead_external():
+  from graphlearn_tpu.distributed import Heartbeat
+  hb = Heartbeat([0, 1], lambda r: None, interval=10)
+  hb.mark_dead(1, 'hard rpc failure')
+  assert hb.dead_ranks() == {1: 'hard rpc failure'}
+  hb.mark_dead(1, 'again')   # idempotent, counted once
+  assert trace.counter_get('resilience.server_dead') == 1
+
+
+# ---------------------------------------------------------------- rpc retry
+
+
+def test_rpc_idempotent_retry_with_injected_fault():
+  from graphlearn_tpu.distributed import RetryPolicy, RpcClient, RpcServer
+  server = RpcServer()
+  calls = []
+  server.register('get', lambda: calls.append(1) or 42)
+  client = RpcClient()
+  client.add_target(0, server.host, server.port)
+  try:
+    # one injected send failure: the idempotent call retries (with
+    # backoff) over a fresh connection and succeeds
+    faults.arm('rpc.client.request', 'raise', exc=ConnectionError,
+               times=1)
+    policy = RetryPolicy(max_attempts=3, base_delay=0.01,
+                         total_deadline=10)
+    assert client.request_sync(0, 'get', idempotent=True,
+                               retry_policy=policy) == 42
+    assert trace.counter_get('fault.rpc.client.request') == 1
+    assert trace.counter_get('resilience.retry') == 1
+  finally:
+    client.close()
+    server.shutdown()
+
+
+def test_rpc_non_idempotent_never_retries():
+  from graphlearn_tpu.distributed import RetryPolicy, RpcClient, RpcServer
+  server = RpcServer()
+  calls = []
+  server.register('incr', lambda: calls.append(1) or len(calls))
+  client = RpcClient()
+  client.add_target(0, server.host, server.port)
+  try:
+    faults.arm('rpc.client.request', 'raise', exc=ConnectionError,
+               times=1)
+    # single attempt, and the ORIGINAL exception class surfaces (a
+    # wrapped TimeoutError would mislead class-branching callers)
+    with pytest.raises(ConnectionError):
+      client.request_sync(0, 'incr')
+    assert calls == []            # the side effect never ran twice (or
+    faults.disarm()               # at all: the fault hit before send)
+    assert client.request_sync(0, 'incr') == 1
+    # retry_policy without idempotent=True is a caller bug
+    with pytest.raises(ValueError, match='idempotent'):
+      client.request_sync(0, 'incr', retry_policy=RetryPolicy())
+  finally:
+    client.close()
+    server.shutdown()
+
+
+def test_rpc_response_fault_site_retries_idempotent():
+  from graphlearn_tpu.distributed import RetryPolicy, RpcClient, RpcServer
+  server = RpcServer()
+  server.register('get', lambda: 'payload')
+  client = RpcClient()
+  client.add_target(0, server.host, server.port)
+  try:
+    faults.arm('rpc.client.response', 'raise', exc=ConnectionError,
+               times=1)
+    assert client.request_sync(
+        0, 'get', idempotent=True,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay=0.01,
+                                 total_deadline=10)) == 'payload'
+    assert trace.counter_get('fault.rpc.client.response') == 1
+  finally:
+    client.close()
+    server.shutdown()
+
+
+def test_rpc_server_hang_detected_by_heartbeat():
+  """A hung (not dead) server: the rpc.server.dispatch fault delays every
+  dispatch far past the probe timeout, so probes time out and the
+  liveness tracker declares the rank dead in seconds."""
+  from graphlearn_tpu.distributed import Heartbeat, NO_RETRY, RpcClient, \
+      RpcServer
+  server = RpcServer()
+  server.register('heartbeat', lambda: {'ok': True})
+  client = RpcClient()
+  client.add_target(0, server.host, server.port)
+  try:
+    assert client.request_sync(0, 'heartbeat', idempotent=True,
+                               retry_policy=NO_RETRY)['ok']
+    faults.arm('rpc.server.dispatch', 'delay', delay=30.0)
+
+    def probe(rank):
+      client.request_sync(rank, 'heartbeat', timeout=0.3,
+                          idempotent=True, retry_policy=NO_RETRY)
+
+    hb = Heartbeat([0], probe, interval=0.1, miss_threshold=2)
+    t0 = time.monotonic()
+    hb.start()
+    deadline = time.monotonic() + 15
+    while not hb.is_dead(0) and time.monotonic() < deadline:
+      time.sleep(0.05)
+    elapsed = time.monotonic() - t0
+    hb.stop()
+    assert hb.is_dead(0)
+    assert elapsed < 10, f'hang detection took {elapsed:.1f}s'
+  finally:
+    faults.disarm()
+    client.close()
+    server.shutdown()
+
+
+# ----------------------------------------------------- channel diagnostics
+
+
+def test_mp_channel_timeout_carries_context():
+  from graphlearn_tpu.channel import MpChannel, QueueTimeoutError
+  ch = MpChannel(capacity=7)
+  with pytest.raises(QueueTimeoutError) as ei:
+    ch.recv(timeout_ms=20)
+  msg = str(ei.value)
+  assert 'mp channel' in msg and '20ms' in msg
+  assert 'capacity=7' in msg and 'received_so_far=0' in msg
+
+
+def test_shm_channel_timeout_carries_context():
+  from graphlearn_tpu.channel import QueueTimeoutError, ShmChannel
+  ch = ShmChannel(shm_size=1 << 16)
+  try:
+    ch.send({'a': np.arange(3)})
+    ch.recv(timeout_ms=100)
+    with pytest.raises(QueueTimeoutError) as ei:
+      ch.recv(timeout_ms=20)
+    msg = str(ei.value)
+    assert 'shm channel' in msg and '20ms' in msg
+    assert 'received_so_far=1' in msg and 'shmid=' in msg
+  finally:
+    ch.close()
+
+
+def test_remote_channel_timeout_carries_context():
+  from graphlearn_tpu.channel import (QueueTimeoutError,
+                                      RemoteReceivingChannel)
+  block = threading.Event()
+
+  def never_answers(rank, pid):
+    block.wait(30)
+    return None, True
+
+  ch = RemoteReceivingChannel([0, 1], [5, 6], prefetch_size=1,
+                              request_fn=never_answers)
+  try:
+    with pytest.raises(QueueTimeoutError) as ei:
+      ch.recv(timeout_ms=50)
+    msg = str(ei.value)
+    assert 'remote channel' in msg and '50ms' in msg
+    assert 'servers=[0, 1]' in msg and 'live_pairs=2' in msg
+    assert 'received_so_far=0' in msg
+  finally:
+    block.set()
+    ch.stop(join=True)
+
+
+def test_shm_send_drop_fault_site():
+  """channel.shm.send armed 'drop' silently loses the message — the
+  injected stand-in for a torn ring write."""
+  from graphlearn_tpu.channel import QueueTimeoutError, ShmChannel
+  ch = ShmChannel(shm_size=1 << 16)
+  try:
+    faults.arm('channel.shm.send', 'drop', times=1)
+    ch.send({'a': np.arange(3)})        # dropped
+    ch.send({'b': np.arange(4)})        # delivered
+    got = ch.recv(timeout_ms=200)
+    assert list(got) == ['b']
+    with pytest.raises(QueueTimeoutError):
+      ch.recv(timeout_ms=20)
+    assert trace.counter_get('fault.channel.shm.send') == 1
+  finally:
+    ch.close()
+
+
+# ---------------------------------------------------- server-side invariants
+
+
+def _tiny_dataset(n=16):
+  rows = np.concatenate([np.arange(n), np.arange(n)])
+  cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n])
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), graph_mode='CPU', num_nodes=n)
+  ds.init_node_labels(np.arange(n) % 2)
+  return ds
+
+
+def _node_cfg(batch_size=4, **kw):
+  from graphlearn_tpu.sampler import SamplingConfig, SamplingType
+  return SamplingConfig(SamplingType.NODE, [2], batch_size, False, False,
+                        False, False, False, False, 'out', kw.get('seed'))
+
+
+def test_server_fetch_and_create_fault_sites():
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  server = DistServer(_tiny_dataset())
+  try:
+    faults.arm('server.create_producer', 'raise', times=1)
+    with pytest.raises(faults.FaultError):
+      server.create_sampling_producer(np.arange(8), _node_cfg())
+    faults.disarm()
+    pid = server.create_sampling_producer(np.arange(8), _node_cfg())
+    server.start_new_epoch_sampling(pid)
+    faults.arm('server.fetch', 'raise', times=1)
+    with pytest.raises(faults.FaultError):
+      server.fetch_one_sampled_message(pid)
+    faults.disarm()
+    # recovery: the stream still serves after the injected failure
+    got = 0
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+      msg, end = server.fetch_one_sampled_message(pid, timeout_ms=500)
+      if msg is not None:
+        got += 1
+      if end:
+        break
+    assert got == server.producer_num_expected(pid) == 2
+  finally:
+    server.exit()
+
+
+def test_destroy_sampling_producer_idempotent_and_releases_shm():
+  """Satellite: shutdown idempotency + no shm leak across
+  create/destroy churn (live ShmChannel census returns to baseline)."""
+  from graphlearn_tpu.channel import live_channel_count
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  ds = _tiny_dataset()
+  server = DistServer(ds)
+  base = live_channel_count()
+  try:
+    for _ in range(3):
+      pid = server.create_sampling_producer(np.arange(8), _node_cfg(),
+                                            num_workers=1)
+      assert live_channel_count() == base + 1
+      server.destroy_sampling_producer(pid)
+      assert live_channel_count() == base        # ring released
+      server.destroy_sampling_producer(pid)      # idempotent no-op
+      server.destroy_sampling_producer(999999)   # unknown id no-op
+    assert server.exit() and server.exit()       # exit idempotent too
+  finally:
+    server.exit()
+
+
+def test_idle_producer_reaped_after_client_disconnect():
+  """Satellite: a client that vanishes mid-stream (never calls destroy)
+  must not leak the producer's ShmChannel — the TTL reaper releases
+  it."""
+  from graphlearn_tpu.channel import live_channel_count
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  server = DistServer(_tiny_dataset(), producer_ttl=0.3)
+  base = live_channel_count()
+  try:
+    pid = server.create_sampling_producer(np.arange(8), _node_cfg(),
+                                          num_workers=1)
+    assert live_channel_count() == base + 1
+    # ... client dies here: it never fetches again, never destroys ...
+    deadline = time.monotonic() + 30
+    while live_channel_count() > base and time.monotonic() < deadline:
+      time.sleep(0.05)
+    assert live_channel_count() == base          # ring released
+    assert trace.counter_get('resilience.producer_reaped') == 1
+    assert pid not in server._producers
+    assert pid not in server._last_active
+  finally:
+    server.exit()
+
+
+# ----------------------------------------------- producer health (satellite)
+
+
+def _mp_loader(ds, n, **kw):
+  return glt.distributed.MpDistNeighborLoader(
+      ds, [2], np.arange(n), batch_size=4, shuffle=False, num_workers=1,
+      seed=0, **kw)
+
+
+def test_check_worker_health_detects_dead_worker():
+  """A crashed worker with a zero restart budget surfaces as a
+  RuntimeError naming the worker, not a silent hang."""
+  ds = _tiny_dataset()
+  loader = _mp_loader(ds, 16, max_worker_restarts=0)
+  try:
+    loader.producer.check_worker_health()   # healthy: no-op
+    # simulate an abnormal death
+    loader.producer._procs[0].terminate()
+    loader.producer._procs[0].join(timeout=10)
+    with pytest.raises(RuntimeError, match='restart budget'):
+      loader.producer.check_worker_health()
+  finally:
+    loader.shutdown()

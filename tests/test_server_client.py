@@ -57,19 +57,21 @@ def test_rpc_hmac_handshake():
   good.close()
 
   # no secret: server sends a challenge the client never answers — the
-  # server closes, the request errors out (never executes)
+  # server closes, the request errors out (never executes; the original
+  # error class surfaces, not a TimeoutError wrapper)
   calls = []
   server.register('probe', lambda: calls.append(1))
   bad = RpcClient()
   bad.add_target(0, server.host, server.port)
-  with pytest.raises((TimeoutError, RuntimeError)):
+  with pytest.raises((ConnectionError, TimeoutError, RuntimeError)):
     bad.request_sync(0, 'probe', timeout=2.0)
   bad.close()
 
-  # wrong secret: rejected at the handshake
+  # wrong secret: rejected at the handshake (surfaces as the original
+  # ConnectionError — single-attempt rpc failures keep their class)
   wrong = RpcClient(secret=b'wrong')
   wrong.add_target(0, server.host, server.port)
-  with pytest.raises((TimeoutError, RuntimeError)):
+  with pytest.raises((ConnectionError, TimeoutError, RuntimeError)):
     wrong.request_sync(0, 'probe', timeout=2.0)
   wrong.close()
   assert not calls
