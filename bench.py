@@ -723,6 +723,33 @@ def main():
                                    'calibrated merge_dense, exact dedup')
   except Exception as e:
     result['hetero_ref_error'] = f'{type(e).__name__}: {e}'[:200]
+  # ---- distributed feature-exchange volume (analytic, products
+  # config P=8): the collate-time DistFeature all_to_all MB/shard/batch
+  # under the miss-only posture (bucket_frac=2.0, split_ratio=0.2 hit
+  # floor, bf16 wire) vs the full-width posture it replaced. Analytic
+  # from the same static capacities the program compiles with —
+  # PERF.md 'Feature path (distributed)'.
+  try:
+    from graphlearn_tpu.distributed.dist_feature import \
+        feature_exchange_mb
+    from graphlearn_tpu.sampler.neighbor_sampler import capacity_plan
+    node_cap = sum(capacity_plan(BATCH, FANOUT))
+    fx_p = 8
+    fx_opt = feature_exchange_mb(node_cap, fx_p, E2E_FEAT_DIM,
+                                 bucket_frac=2.0, wire_bytes=2,
+                                 hit_rate=0.2)
+    fx_full = feature_exchange_mb(node_cap, fx_p, E2E_FEAT_DIM,
+                                  bucket_frac=None, wire_bytes=4)
+    result['feature_exchange_mb_per_batch'] = round(fx_opt, 3)
+    result['feature_exchange_mb_per_batch_fullwidth'] = round(fx_full, 3)
+    result['feature_exchange_reduction_x'] = round(fx_full / fx_opt, 1)
+    result['feature_exchange_config'] = (
+        f'P={fx_p}, request_width={node_cap}, F={E2E_FEAT_DIM}, '
+        'bucket_frac=2.0, split_ratio=0.2, bf16 wire')
+  except Exception as e:
+    result['feature_exchange_mb_per_batch'] = None
+    result['feature_exchange_error'] = f'{type(e).__name__}: {e}'[:200]
+
   # the ONLY device->host fetch in the bench, after every trace is
   # captured (PERF.md: the first fetch degrades later dispatches).
   # null (not false) when the ref runs never produced a loader — a

@@ -26,6 +26,12 @@ def main():
   ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
   ap.add_argument('--mesh-sizes', default='1,2,4,8')
   ap.add_argument('--iters', type=int, default=20)
+  ap.add_argument('--feat-dim', type=int, default=100,
+                  help='feature width for the exchange-volume report '
+                       '(100 = ogbn-products)')
+  ap.add_argument('--split-ratio', type=float, default=0.2,
+                  help='hot-cache share assumed by the feature '
+                       'exchange-volume report (the hit-rate floor)')
   ap.add_argument('--cpu-devices', type=int, default=8)
   ap.add_argument('--tpu', action='store_true',
                   help='use the attached TPU devices instead of the '
@@ -119,12 +125,31 @@ def main():
     sampler = glt.distributed.DistNeighborSampler(
         dg, list(args.fanout), mesh, seed=0)
     dt, _ = timed(sampler)
+    # feature-exchange volume at this mesh size (analytic from the
+    # static capacities, like the sampler's exchange report): the
+    # collate-time DistFeature all_to_all MB/shard/batch under the
+    # miss-only posture vs the full-width posture it replaced
+    from graphlearn_tpu.distributed.dist_feature import \
+        feature_exchange_mb
+    node_cap = sampler._node_cap(sampler._capacities(args.batch_size))
+    fdim = args.feat_dim
+    fx_opt = feature_exchange_mb(node_cap, p, fdim, bucket_frac=2.0,
+                                 wire_bytes=2,
+                                 hit_rate=args.split_ratio)
+    fx_full = feature_exchange_mb(node_cap, p, fdim, bucket_frac=None,
+                                  wire_bytes=4)
     print(json.dumps({
         'metric': 'dist_loader_seed_batches_per_sec',
         'mesh_size': p,
         'value': round(args.iters * p / dt, 2),
         'seeds_per_sec': round(args.iters * p * args.batch_size / dt, 1),
         'secs': round(dt, 4),
+        'feature_exchange_mb_per_batch': round(fx_opt, 3),
+        'feature_exchange_mb_per_batch_fullwidth': round(fx_full, 3),
+        'feature_exchange_reduction_x': round(fx_full / fx_opt, 1),
+        'feature_exchange_config': (
+            f'request_width={node_cap}, F={fdim}, bucket_frac=2.0, '
+            f'split_ratio={args.split_ratio}, bf16 wire'),
         'backend': jax.default_backend(),
     }), flush=True)
 

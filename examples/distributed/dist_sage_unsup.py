@@ -113,7 +113,7 @@ def main():
   tx = optax.adam(args.lr)
   opt_state = tx.init(params)
 
-  from jax import shard_map
+  from graphlearn_tpu.utils.compat import shard_map
   from jax.sharding import PartitionSpec as PS
 
   def shard_scores(params, x, ei, em, eli, label):

@@ -108,7 +108,7 @@ def main():
   tx = optax.adam(args.lr)
   opt_state = tx.init(params)
 
-  from jax import shard_map
+  from graphlearn_tpu.utils.compat import shard_map
   from jax.sharding import PartitionSpec as PS
 
   def loss_fn(params, x, ei, em, y, nseed):

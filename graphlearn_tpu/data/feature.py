@@ -54,6 +54,8 @@ class Feature:
     with_device: False forces a pure-host store (reference ``with_gpu``).
     id2index: optional [N] old-id -> row map from the hotness reorder.
     dtype: optional storage dtype (e.g. jnp.bfloat16 to halve HBM).
+    cache_rows: absolute HBM row count (overrides ``split_ratio``; the
+      same knob pair as the distributed store, DistFeature).
   """
 
   def __init__(
@@ -65,8 +67,14 @@ class Feature:
       with_device: bool = True,
       id2index: Optional[np.ndarray] = None,
       dtype=None,
+      cache_rows: Optional[int] = None,
   ):
     self.feature_array = np.asarray(feature_array)
+    n = self.feature_array.shape[0]
+    self.cache_rows = (min(max(int(cache_rows), 0), n)
+                       if cache_rows is not None else None)
+    if self.cache_rows is not None and n:
+      split_ratio = self.cache_rows / n
     self.split_ratio = float(split_ratio)
     self.device_group_list = device_group_list
     self.device = device
@@ -82,6 +90,8 @@ class Feature:
     n = self.feature_array.shape[0]
     if not self.with_device:
       hot = 0
+    elif self.cache_rows is not None:
+      hot = self.cache_rows
     else:
       hot = int(n * self.split_ratio)
     place = self.device
@@ -181,9 +191,12 @@ class Feature:
     """Hand host arrays to another consumer (reference feature.py:240-257's
     CUDA-IPC re-init collapses to host-array handoff on TPU)."""
     return (self.feature_array, self.split_ratio, self.device,
-            self.with_device, self._id2index, self.dtype)
+            self.with_device, self._id2index, self.dtype,
+            self.cache_rows)
 
   @classmethod
   def from_ipc_handle(cls, handle):
-    arr, split_ratio, device, with_device, id2index, dtype = handle
-    return cls(arr, split_ratio, None, device, with_device, id2index, dtype)
+    arr, split_ratio, device, with_device, id2index, dtype, *rest = handle
+    cache_rows = rest[0] if rest else None
+    return cls(arr, split_ratio, None, device, with_device, id2index,
+               dtype, cache_rows=cache_rows)

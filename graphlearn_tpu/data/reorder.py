@@ -6,9 +6,39 @@ which lets the feature store keep a prefix of rows in HBM and the tail on
 host. Returns the permuted features plus the old-id -> new-row map
 (``id2index``) that lookups must apply.
 """
-from typing import Tuple
+from typing import Iterable, Tuple
 
 import numpy as np
+
+
+def in_degree_hotness(topology, num_nodes: int) -> np.ndarray:
+  """[num_nodes] in-degree hotness scores (higher = hotter) — the
+  ranking :func:`sort_by_in_degree` orders by, exposed standalone so the
+  DISTRIBUTED feature store can select its replicated hot-cache set
+  without reordering rows (DistFeature keeps ids canonical; only the
+  local Feature relies on the hot-first permutation)."""
+  in_deg = np.zeros((num_nodes,), dtype=np.int64)
+  if topology.layout == 'CSC':
+    d = topology.degrees
+    in_deg[:d.shape[0]] = d
+  else:
+    np.add.at(in_deg, topology.indices,
+              np.ones_like(topology.indices, dtype=np.int64))
+  return in_deg
+
+
+def frequency_hotness(id_batches: Iterable, num_nodes: int) -> np.ndarray:
+  """[num_nodes] presampling frequency hotness: count how often each id
+  appears across ``id_batches`` (arrays of visited node ids, e.g. the
+  ``node`` buffers of a few warmup loader batches; negative FILL pads
+  are ignored). Matches GLT's presampling hotness semantics — the ids a
+  real workload touches, not a structural proxy."""
+  counts = np.zeros((num_nodes,), dtype=np.int64)
+  for ids in id_batches:
+    ids = np.asarray(ids).reshape(-1)
+    ids = ids[(ids >= 0) & (ids < num_nodes)]
+    np.add.at(counts, ids, 1)
+  return counts
 
 
 def sort_by_in_degree(
@@ -36,12 +66,7 @@ def sort_by_in_degree(
     feature[v].
   """
   n = feature.shape[0]
-  if topology.layout == 'CSC':
-    in_deg = np.zeros((n,), dtype=np.int64)
-    d = topology.degrees
-    in_deg[:d.shape[0]] = d
-  else:
-    in_deg = np.bincount(topology.indices, minlength=n).astype(np.int64)
+  in_deg = in_degree_hotness(topology, n)
   del split_ratio  # full sort; ratio only matters to the caller's split
   order = np.argsort(-in_deg, kind='stable')  # hot first
   id2index = np.empty((n,), dtype=np.int64)

@@ -37,11 +37,23 @@ class DistDataset:
 
   def load(self, root_dir: str, mesh=None, node_labels=None,
            edge_dir: str = 'out', feature_dtype=None,
-           feature_with_cache: bool = True):
+           feature_with_cache: bool = True, split_ratio: float = 0.0,
+           cache_rows=None, hotness='in_degree', wire_dtype=None,
+           bucket_frac=2.0):
     """Load all partitions of `root_dir` and shard them over `mesh`
     (reference: DistDataset.load, dist_dataset.py:78-167). Handles both
     the homogeneous and the heterogeneous (per-type) partition layouts of
-    partition/base.py."""
+    partition/base.py.
+
+    ``split_ratio``/``cache_rows`` mirror the local ``data.Feature``
+    knobs: a non-zero value replicates that share of the globally
+    hottest feature rows per shard (DistFeature hot cache) so only
+    cache misses cross the interconnect. ``hotness`` ranks the rows:
+    'in_degree' (default) bincounts edge destinations across the loaded
+    partitions; pass explicit [N] scores (per type for hetero) for
+    presampling-frequency hotness, or None to cache the lowest ids.
+    ``wire_dtype``/``bucket_frac`` tune the miss exchange (see
+    DistFeature)."""
     num_parts, g0, nf0, ef0, node_pb, edge_pb = load_partition(root_dir, 0)
     if mesh is None:
       from .dist_context import get_context
@@ -58,6 +70,33 @@ class DistDataset:
 
     self.num_partitions = num_parts
     self.edge_dir = edge_dir
+    with_cache = split_ratio > 0 or cache_rows is not None
+
+    def _in_degree(num_nodes, ntype=None):
+      """In-degree hotness from the loaded partitions' edge cols (the
+      ids sampling touches as neighbors)."""
+      deg = np.zeros((num_nodes,), np.int64)
+      for g in parts:
+        ets = ([et for et in g if et[2] == ntype] if isinstance(g, dict)
+               else [None])
+        for et in ets:
+          cols = (g[et] if et is not None else g).edge_index[1]
+          np.add.at(deg, np.clip(cols, 0, num_nodes - 1), 1)
+      return deg
+
+    def _hotness(num_nodes, ntype=None):
+      if not with_cache:
+        return None
+      if isinstance(hotness, str):
+        assert hotness == 'in_degree', hotness
+        return _in_degree(num_nodes, ntype)
+      if isinstance(hotness, dict):
+        return hotness.get(ntype) if hotness else None
+      return hotness
+
+    feat_kw = dict(mesh=mesh, dtype=feature_dtype, wire_dtype=wire_dtype,
+                   bucket_frac=bucket_frac)
+    cache_kw = dict(split_ratio=split_ratio, cache_rows=cache_rows)
     if isinstance(g0, dict):
       from .dist_graph import DistHeteroGraph
       self.graph = DistHeteroGraph(num_parts, 0, parts, node_pb,
@@ -77,15 +116,16 @@ class DistDataset:
             blocks.append((ids, feats))
           self.node_feat_pb[nt] = feat_pb
           self.node_features[nt] = DistFeature(
-              num_parts, blocks, node_pb[nt], mesh=mesh,
-              dtype=feature_dtype)
+              num_parts, blocks, node_pb[nt],
+              hotness=_hotness(node_pb[nt].shape[0], nt), **cache_kw,
+              **feat_kw)
       if ef0:
         self.edge_features = {}
         for et in ef0:
           self.edge_features[et] = DistFeature(
               num_parts,
               [(ef[et].ids, ef[et].feats) for ef in efeats],
-              edge_pb[et], mesh=mesh, dtype=feature_dtype)
+              edge_pb[et], **feat_kw)
     else:
       self.graph = DistGraph(num_parts, 0, parts, node_pb, edge_pb,
                              edge_dir)
@@ -99,8 +139,9 @@ class DistDataset:
             feats, ids = nf.feats, nf.ids
           blocks.append((ids, feats))
         self.node_feat_pb = feat_pb
-        self.node_features = DistFeature(num_parts, blocks, node_pb,
-                                         mesh=mesh, dtype=feature_dtype)
+        self.node_features = DistFeature(
+            num_parts, blocks, node_pb,
+            hotness=_hotness(node_pb.shape[0]), **cache_kw, **feat_kw)
         # note: lookups route by the *graph* node_pb (each id's canonical
         # owner); the cache raises the chance the row is also local, but
         # canonical routing keeps responses unique. The feature pb with
@@ -110,7 +151,7 @@ class DistDataset:
         # keeps an edge Feature + edge_feat_pb, dist_dataset.py:149-162)
         self.edge_features = DistFeature(
             num_parts, [(ef.ids, ef.feats) for ef in efeats], edge_pb,
-            mesh=mesh, dtype=feature_dtype)
+            **feat_kw)
     if node_labels is not None:
       self.node_labels = (node_labels if isinstance(node_labels, dict)
                           else np.asarray(node_labels))

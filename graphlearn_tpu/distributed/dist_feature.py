@@ -1,40 +1,111 @@
-"""Sharded distributed feature store with all_to_all gather.
+"""Sharded distributed feature store: hot-vertex cache + miss-only exchange.
 
 TPU-native re-design of
 /root/reference/graphlearn_torch/python/distributed/dist_feature.py. The
 reference splits a lookup into a local UVA gather plus per-remote-partition
 async RPCs and stitches futures (dist_feature.py:134-269). Here the whole
-lookup is ONE jitted SPMD function: route requested ids to their owning
-shard (fixed-capacity all_to_all), gather rows locally (searchsorted over
-the shard's sorted owned ids), route rows back, unpermute. XLA overlaps the
-collective with compute — the asyncio machinery dissolves.
+lookup is ONE jitted SPMD function; the asyncio machinery dissolves.
+
+Byte posture (this file owns the largest per-batch wire volume in the
+system — feature rows are ~F x wider than sampler id traffic, PERF.md
+"Feature path"):
+
+  1. **Replicated hot cache** (GLT's UnifiedTensor split, SURVEY
+     §UnifiedTensor; reference data/feature.py split_ratio + hotness
+     reorder): the globally hottest ``cache_rows`` rows live replicated on
+     every shard next to its owned partition. Requested ids are split
+     hit/miss INSIDE the program by a searchsorted over the sorted cached
+     id set; hits gather locally and never touch the interconnect.
+  2. **Miss-only bucketed exchange**: only cache misses — deduped within
+     the batch (one request per unique id, response scattered back to all
+     its slots) — enter the all_to_all, packed into per-destination
+     buckets of capacity ``bucket_frac x mean miss load`` with the
+     psum-replicated ``lax.cond`` full-width fallback (exactly the
+     sampler-exchange contract: loss-free on EVERY input,
+     dist_neighbor_sampler._exchange_hop). On a 2-axis ('slice', 'chip')
+     mesh the transposes go hierarchical: full-width along 'chip' (ICI),
+     fractional along 'slice' (DCN), retraced for the response.
+  3. **Wire dtype**: ``wire_dtype=jnp.bfloat16`` ships response rows at
+     half width and upcasts to the storage dtype after
+     ``gather_from_buckets`` — independent of hit rate.
+
+On-device hit/miss/overflow counters ride the same program (a [P, 4]
+accumulator threaded through every ``get``), so hit rates are observable
+with ZERO per-batch host syncs: fetch with :meth:`stats` /
+:meth:`publish_stats` once per epoch.
 """
-import functools
 from typing import Optional
 
 import numpy as np
 
 from .. import ops
+from ..ops.route import exchange_capacity
 
 INT32_MAX = np.iinfo(np.int32).max
+
+# stats accumulator layout (per shard, int32)
+STAT_HITS, STAT_MISSES, STAT_UNIQUE, STAT_OVERFLOW = range(4)
+
+
+def miss_capacity(request_width: int, nparts: int, bucket_frac,
+                  hit_rate: float = 0.0) -> int:
+  """Static per-destination bucket capacity for a miss-only feature
+  exchange over ``request_width`` request slots: ``bucket_frac x`` the
+  mean per-destination MISS load (the expected unique-miss width is
+  ``request_width * (1 - hit_rate)``), rounded to lanes and clamped to
+  the loss-free full width. ``bucket_frac=None`` keeps the full-width
+  posture (every bucket ``request_width`` wide, can never overflow).
+  Thin front of the shared capacity policy in ops.route —
+  the sampler's exchange resolves through the same function."""
+  return exchange_capacity(request_width, nparts, bucket_frac, hit_rate)
+
+
+def feature_exchange_mb(request_width: int, nparts: int, feat_dim: int,
+                        bucket_frac=2.0, wire_bytes: int = 4,
+                        id_bytes: int = 4, hit_rate: float = 0.0) -> float:
+  """Analytic all_to_all MB/shard/batch of one distributed feature
+  lookup: [P, cap] id requests + [P, cap, F] row responses. The
+  full-width posture (the pre-cache baseline) is ``bucket_frac=None,
+  wire_bytes=4, hit_rate=0``. Benchmarks report this next to measured
+  volumes so byte regressions are visible without a trace."""
+  cap = miss_capacity(request_width, nparts, bucket_frac, hit_rate)
+  return nparts * cap * (id_bytes + feat_dim * wire_bytes) / 1e6
 
 
 class DistFeature:
   """Reference: dist_feature.py:51-269.
 
   Args:
-    num_partitions: partitions == mesh 'g' axis size.
+    num_partitions: partitions == product of the mesh axis sizes.
     feat_parts: list of (ids [n_p], feats [n_p, F]) per partition (the
       FeaturePartitionData payload, cache already merged via
       cat_feature_cache).
     feature_pb: [N] id -> owning partition (the *feature* partition book —
       may differ from the graph node_pb once caches move entries).
-    mesh: the graph mesh.
+    mesh: the graph mesh ('g',) flat or ('slice', 'chip') hierarchical.
     dtype: optional storage dtype (bf16 halves HBM + ICI bytes).
+    split_ratio: fraction of the N globally hottest rows replicated
+      per shard (0 = no cache, 1 = fully replicated), mirroring the
+      local ``data.Feature`` API.
+    cache_rows: absolute row count for the hot cache (overrides
+      ``split_ratio``).
+    hotness: [N] per-id hotness score (higher = hotter) selecting the
+      cached set — in-degrees (``data.reorder.in_degree_hotness``) or a
+      presampling frequency count (``data.reorder.frequency_hotness``).
+      None assumes ids are already hot-ordered (row 0 hottest), the
+      layout ``data.reorder.sort_by_in_degree`` produces.
+    wire_dtype: optional dtype for response rows ON THE WIRE (e.g.
+      jnp.bfloat16); storage and results stay ``dtype``.
+    bucket_frac: miss-exchange bucket slack over the mean miss load
+      (None = full-width loss-free posture, the pre-cache baseline).
+    dedup: dedup misses within the batch before the exchange (one
+      request per unique id; the response fans back to every slot).
   """
 
   def __init__(self, num_partitions: int, feat_parts, feature_pb,
-               mesh=None, dtype=None):
+               mesh=None, dtype=None, split_ratio: float = 0.0,
+               cache_rows: Optional[int] = None, hotness=None,
+               wire_dtype=None, bucket_frac=2.0, dedup: bool = True):
     self.num_partitions = num_partitions
     self.feature_pb = np.asarray(feature_pb)
     self.mesh = mesh
@@ -48,7 +119,35 @@ class DistFeature:
       order = np.argsort(ids)
       self.feat_ids[i, :ids.shape[0]] = ids[order]
       self.feats[i, :ids.shape[0]] = fe[order]
+    self.split_ratio = float(split_ratio)
+    self.wire_dtype = wire_dtype
+    self.bucket_frac = bucket_frac
+    self.dedup = dedup
+    n_total = int(self.feature_pb.shape[0])
+    h = int(cache_rows) if cache_rows is not None \
+        else int(n_total * self.split_ratio)
+    h = max(0, min(h, n_total))
+    self.cache_rows = h
+    # hit-rate floor used to size the miss buckets: uniform requests hit
+    # at exactly H/N; skewed-to-hot requests (the point of the cache)
+    # hit more, so capacities sized on (1 - H/N) only gain slack
+    self._cache_frac = h / n_total if n_total else 0.0
+    if h > 0:
+      if hotness is None:
+        hot_ids = np.arange(h, dtype=np.int64)
+      else:
+        hotness = np.asarray(hotness).reshape(-1)
+        assert hotness.shape[0] == n_total, (
+            f'hotness covers {hotness.shape[0]} ids, feature_pb has '
+            f'{n_total}')
+        hot_ids = np.argsort(-hotness, kind='stable')[:h]
+      self.cache_ids = np.sort(hot_ids).astype(np.int32)
+      self.cache_feats = self.cpu_get(self.cache_ids)
+    else:
+      self.cache_ids = None
+      self.cache_feats = None
     self._dev = None
+    self._stats = None
     self._fns = {}
 
   @property
@@ -61,61 +160,239 @@ class DistFeature:
       from ..utils import global_device_put
       shard = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
       repl = NamedSharding(self.mesh, P())
+      h = self.cache_rows
+      cache_ids = (self.cache_ids if h else
+                   np.full((1,), INT32_MAX, np.int32))
+      cache_feats = (self.cache_feats if h else
+                     np.zeros((1, self.feature_dim), self.feats.dtype))
       self._dev = dict(
           feat_ids=global_device_put(self.feat_ids, shard),
           feats=global_device_put(self.feats, shard),
           feature_pb=global_device_put(self.feature_pb.astype(np.int32),
-                                       repl))
+                                       repl),
+          cache_ids=global_device_put(cache_ids, repl),
+          cache_feats=global_device_put(cache_feats, repl))
     return self._dev
 
+  # ------------------------------------------------------------ stats
+  def _stats_dev(self):
+    if self._stats is None:
+      import jax
+      from jax.sharding import NamedSharding, PartitionSpec as P
+      from ..utils import global_device_put
+      shard = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
+      self._stats = global_device_put(
+          np.zeros((self.num_partitions, 4), np.int32), shard)
+    return self._stats
+
+  def stats(self) -> dict:
+    """Host snapshot of the on-device counters, summed over shards.
+
+    This is the ONE device->host fetch of the feature path — call it per
+    epoch (loaders do), never per batch. On a multi-host mesh only this
+    process's shard rows are fetched (a global np.asarray would span
+    non-addressable devices and raise) — counters are per-shard disjoint
+    rows of the [P, 4] accumulator, so the result is the process-local
+    view; aggregate across hosts out of band if needed."""
+    if self._stats is None:
+      tot = np.zeros((4,), np.int64)
+    elif getattr(self._stats, 'is_fully_addressable', True):
+      tot = np.asarray(self._stats).sum(axis=0).astype(np.int64)
+    else:
+      tot = sum(np.asarray(s.data).reshape(-1, 4).sum(axis=0)
+                for s in self._stats.addressable_shards).astype(np.int64)
+    lookups = int(tot[STAT_HITS] + tot[STAT_MISSES])
+    return dict(hits=int(tot[STAT_HITS]), misses=int(tot[STAT_MISSES]),
+                unique_misses=int(tot[STAT_UNIQUE]),
+                overflow=int(tot[STAT_OVERFLOW]), lookups=lookups,
+                hit_rate=(int(tot[STAT_HITS]) / lookups if lookups
+                          else 0.0))
+
+  def reset_stats(self):
+    self._stats = None
+
+  def publish_stats(self, prefix: str = 'dist_feature'):
+    """Fetch + reset the on-device counters into utils.trace named
+    counters ('<prefix>.hits' etc.) — the per-epoch surfacing hook."""
+    from ..utils import trace
+    s = self.stats()
+    for k in ('hits', 'misses', 'unique_misses', 'overflow', 'lookups'):
+      if s[k]:
+        trace.counter_inc(f'{prefix}.{k}', s[k])
+    self.reset_stats()
+    return s
+
+  # ---------------------------------------------------------- program
   def _build_fn(self, b: int):
-    """Jitted shard_map lookup for per-shard request blocks of size b."""
+    """Jitted shard_map lookup for per-shard request blocks of size b:
+    cache split -> miss dedup -> bucketed (or hierarchical) miss-only
+    exchange -> fan-out + merge, ONE dispatch, no host syncs."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
 
     nparts = self.num_partitions
     dev = self.device_arrays()
     fdim = self.feature_dim
     fdtype = self.feats.dtype
+    wdtype = self.wire_dtype or fdtype
+    h = self.cache_rows
+    dedup = self.dedup
+    bucket_frac = self.bucket_frac
+    hit_est = self._cache_frac
     # collectives/specs over every mesh axis: works identically on the
     # flat ('g',) mesh and a 2-axis ('slice', 'chip') mesh
     ax = tuple(self.mesh.axis_names)
+    sizes = tuple(self.mesh.shape[a] for a in ax)
+    hier = len(ax) == 2
 
-    def body(feat_ids, feats, pb, ids, mask):
-      # per-shard views: feat_ids [1, n], feats [1, n, F], ids [1, b]
-      feat_ids, feats = feat_ids[0], feats[0]
-      ids, mask = ids[0], mask[0]
-      dest = jnp.where(mask, pb[jnp.maximum(ids, 0)], nparts)
-      slot, ok = ops.route_slots(dest, mask, capacity=b)
-      send = ops.scatter_to_buckets(ids, dest, slot, ok, nparts, b)
-      req = jax.lax.all_to_all(send, ax, 0, 0)            # [P, b] requests
-      flat = req.reshape(-1)
+    def lookup_local(feat_ids, feats, flat):
+      """Rows for a flat request vector over this shard's sorted owned
+      ids (zeros where absent/padded)."""
       pos = jnp.clip(jnp.searchsorted(feat_ids, flat), 0,
                      feat_ids.shape[0] - 1)
       found = feat_ids[pos] == flat
-      rows = jnp.where(found[:, None], feats[pos], 0)
-      rows = rows.reshape(nparts, b, fdim)
-      resp = jax.lax.all_to_all(rows, ax, 0, 0)           # [P, b] responses
-      out = ops.gather_from_buckets(resp, dest, slot, ok, fill=0)
-      return out.astype(fdtype)[None]
+      return jnp.where(found[:, None], feats[pos], 0)
+
+    def exchange_flat(feat_ids, feats, pb, req, rmask):
+      """Fractional bucketed all_to_all with replicated full-width
+      fallback (sampler _exchange_hop parity). Returns rows [b, F]
+      (storage dtype) in request order + the overflow count."""
+      dest = jnp.where(rmask, pb[jnp.maximum(req, 0)], nparts)
+      slot, ok = ops.route_slots(dest, rmask, capacity=b)
+
+      def do(cap: int):
+        okc = ok & (slot < cap)
+        send = ops.scatter_to_buckets(req, dest, slot, okc, nparts, cap)
+        r = jax.lax.all_to_all(send, ax, 0, 0)          # [P, cap] reqs
+        rows = lookup_local(feat_ids, feats, r.reshape(-1))
+        rows = rows.astype(wdtype).reshape(nparts, cap, fdim)
+        resp = jax.lax.all_to_all(rows, ax, 0, 0)       # [P, cap, F]
+        back = ops.gather_from_buckets(resp, dest, slot, okc, fill=0)
+        return back.astype(fdtype)
+
+      cap_small = miss_capacity(b, nparts, bucket_frac, hit_est)
+      if cap_small >= b:
+        return do(b), jnp.int32(0)
+      ovf = jnp.sum(rmask & (slot >= cap_small)).astype(jnp.int32)
+      total_ovf = jax.lax.psum(ovf, ax)
+      rows = jax.lax.cond(total_ovf == 0, lambda _: do(cap_small),
+                          lambda _: do(b), None)
+      return rows, ovf
+
+    def exchange_hier(feat_ids, feats, pb, req, rmask):
+      """2-stage exchange for a (slice, chip) mesh: full-width along
+      'chip' (ICI), fractional along 'slice' (DCN), retraced for the
+      response — the feature-row counterpart of
+      dist_neighbor_sampler._exchange_hop_hier. Stage-2 capacity is
+      sized on the mean VALID miss load (~miss width over S), not the
+      C*b slot count."""
+      s_ax, c_ax = ax
+      s_sz, c_sz = sizes
+      dest = jnp.where(rmask, pb[jnp.maximum(req, 0)], nparts)
+      c_dst = jnp.where(rmask, dest % c_sz, c_sz)
+      slot1, ok1 = ops.route_slots(c_dst, rmask, capacity=b)
+      send1 = ops.scatter_to_buckets(req, c_dst, slot1, ok1, c_sz, b)
+      req1 = jax.lax.all_to_all(send1, c_ax, 0, 0)      # [C, b] via ICI
+      mid = req1.reshape(-1)
+      mid_mask = mid >= 0
+      mdest = jnp.where(mid_mask, pb[jnp.maximum(mid, 0)] // c_sz, s_sz)
+      slot2, ok2f = ops.route_slots(mdest, mid_mask, capacity=c_sz * b)
+      cap2 = (c_sz * b if bucket_frac is None or s_sz <= 1 else
+              min(c_sz * b,
+                  miss_capacity(b, s_sz, bucket_frac, hit_est)))
+
+      def hier_path(_):
+        ok2 = ok2f & (slot2 < cap2)
+        send2 = ops.scatter_to_buckets(mid, mdest, slot2, ok2, s_sz,
+                                       cap2)
+        req2 = jax.lax.all_to_all(send2, s_ax, 0, 0)    # [S, cap2] DCN
+        rows = lookup_local(feat_ids, feats, req2.reshape(-1))
+        rows = rows.astype(wdtype).reshape(s_sz, cap2, fdim)
+        r2 = jax.lax.all_to_all(rows, s_ax, 0, 0)
+        b2 = ops.gather_from_buckets(r2, mdest, slot2, ok2, fill=0)
+        r1 = jax.lax.all_to_all(b2.reshape(c_sz, b, fdim), c_ax, 0, 0)
+        back = ops.gather_from_buckets(r1, c_dst, slot1, ok1, fill=0)
+        return back.astype(fdtype)
+
+      def flat_path(_):
+        slotp, okp = ops.route_slots(dest, rmask, capacity=b)
+        send = ops.scatter_to_buckets(req, dest, slotp, okp, nparts, b)
+        r = jax.lax.all_to_all(send, ax, 0, 0)
+        rows = lookup_local(feat_ids, feats, r.reshape(-1))
+        rows = rows.astype(wdtype).reshape(nparts, b, fdim)
+        resp = jax.lax.all_to_all(rows, ax, 0, 0)
+        back = ops.gather_from_buckets(resp, dest, slotp, okp, fill=0)
+        return back.astype(fdtype)
+
+      if cap2 >= c_sz * b:
+        return hier_path(None), jnp.int32(0)
+      ovf = jnp.sum(mid_mask & (slot2 >= cap2)).astype(jnp.int32)
+      total_ovf = jax.lax.psum(ovf, ax)
+      rows = jax.lax.cond(total_ovf == 0, hier_path, flat_path, None)
+      return rows, ovf
+
+    def body(feat_ids, feats, pb, cache_ids, cache_feats, stats, ids,
+             mask):
+      # per-shard views: feat_ids [1, n], feats [1, n, F], ids [1, b]
+      feat_ids, feats = feat_ids[0], feats[0]
+      ids, mask, stats = ids[0], mask[0], stats[0]
+      safe = jnp.maximum(ids, 0)
+      if h > 0:
+        cpos = jnp.clip(jnp.searchsorted(cache_ids, safe), 0,
+                        cache_ids.shape[0] - 1)
+        is_hit = mask & (cache_ids[cpos] == safe)
+        out_hit = jnp.where(is_hit[:, None], cache_feats[cpos], 0)
+        miss = mask & ~is_hit
+      else:
+        is_hit = jnp.zeros_like(mask)
+        out_hit = jnp.zeros((b, fdim), fdtype)
+        miss = mask
+      if dedup:
+        # one request per unique missed id; `inverse` fans the response
+        # row back to every batch slot that asked for it
+        req, ucnt, inverse = ops.masked_unique(ids, miss, size=b)
+        rmask = req != ops.FILL
+      else:
+        req, rmask = ids, miss
+        inverse = jnp.where(miss, jnp.arange(b, dtype=jnp.int32), -1)
+        ucnt = jnp.sum(miss)
+      exchange = exchange_hier if hier else exchange_flat
+      rows, ovf = exchange(feat_ids, feats, pb, req, rmask)
+      out_miss = rows[jnp.maximum(inverse, 0)]
+      out = jnp.where(is_hit[:, None], out_hit.astype(fdtype),
+                      jnp.where(miss[:, None], out_miss, 0))
+      batch_stats = jnp.stack([
+          jnp.sum(is_hit), jnp.sum(miss), ucnt, ovf]).astype(jnp.int32)
+      return out[None], (stats + batch_stats)[None]
 
     fn = shard_map(
         body, mesh=self.mesh,
-        in_specs=(P(ax), P(ax), P(), P(ax), P(ax)),
-        out_specs=P(ax))
+        in_specs=(P(ax), P(ax), P(), P(), P(), P(ax), P(ax), P(ax)),
+        out_specs=(P(ax), P(ax)))
     jfn = jax.jit(fn)
-    return lambda ids, mask: jfn(dev['feat_ids'], dev['feats'],
-                                 dev['feature_pb'], ids, mask)
+
+    def run(ids, mask):
+      out, self._stats = jfn(dev['feat_ids'], dev['feats'],
+                             dev['feature_pb'], dev['cache_ids'],
+                             dev['cache_feats'], self._stats_dev(),
+                             ids, mask)
+      return out
+
+    return run
 
   def get(self, ids, mask=None):
     """Sharded lookup: ids [P, B] (per-shard request blocks) -> [P, B, F].
 
-    Reference: DistFeature.async_get / __getitem__
-    (dist_feature.py:122-153).
+    ONE program dispatch, zero host syncs (the hit/miss counters stay on
+    device — see :meth:`stats`). Reference: DistFeature.async_get /
+    __getitem__ (dist_feature.py:122-153).
     """
     import jax.numpy as jnp
+
+    from ..utils import trace
     ids = jnp.asarray(ids)
     assert ids.ndim == 2 and ids.shape[0] == self.num_partitions
     if mask is None:
@@ -123,6 +400,7 @@ class DistFeature:
     b = ids.shape[1]
     if b not in self._fns:
       self._fns[b] = self._build_fn(b)
+    trace.record_dispatch('dist_feature.get')
     return self._fns[b](ids, mask)
 
   def cpu_get(self, ids) -> np.ndarray:

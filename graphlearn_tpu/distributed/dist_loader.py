@@ -110,24 +110,43 @@ class DistLoader(OverflowGuardMixin):
   def __iter__(self):
     from ..utils import step_annotation
     guarded, recompute = self._overflow_epoch_start()
-    for i, (idx, mask) in enumerate(self._index_blocks()):
-      with step_annotation('glt_dist_batch', i):
-        inp = NodeSamplerInput(self.input_seeds[idx], self.input_type)
-        if recompute:
-          keys = self.sampler._next_keys()
-          out = self.sampler.sample_from_nodes(inp, seed_mask=mask,
-                                               keys=keys)
-          if self._batch_overflowed(out):
-            self.overflow_recomputes += 1
-            out = self._replay_sampler().sample_from_nodes(
-                inp, seed_mask=mask, keys=keys)
-        else:
-          out = self.sampler.sample_from_nodes(inp, seed_mask=mask)
-          if guarded:
-            self._accumulate_overflow(out)
-        yield self._collate_fn(out)
-    if guarded and not recompute:
-      self._finish_epoch_overflow()
+    try:
+      for i, (idx, mask) in enumerate(self._index_blocks()):
+        with step_annotation('glt_dist_batch', i):
+          inp = NodeSamplerInput(self.input_seeds[idx], self.input_type)
+          if recompute:
+            keys = self.sampler._next_keys()
+            out = self.sampler.sample_from_nodes(inp, seed_mask=mask,
+                                                 keys=keys)
+            if self._batch_overflowed(out):
+              self.overflow_recomputes += 1
+              out = self._replay_sampler().sample_from_nodes(
+                  inp, seed_mask=mask, keys=keys)
+          else:
+            out = self.sampler.sample_from_nodes(inp, seed_mask=mask)
+            if guarded:
+              self._accumulate_overflow(out)
+          yield self._collate_fn(out)
+      if guarded and not recompute:
+        self._finish_epoch_overflow()
+    finally:
+      # also on early break/close: the on-device int32 accumulator must
+      # be drained per epoch or it eventually wraps
+      self._publish_feature_stats()
+
+  def _publish_feature_stats(self):
+    """Surface the feature-store hit/miss counters into utils.trace at
+    EPOCH granularity — the counters accumulate on device across the
+    epoch's batches (DistFeature threads them through its one dispatch),
+    so this is the only device->host stats fetch of the feature path.
+    Edge-feature stores publish too: their accumulators thread through
+    every edge_attr gather and must be drained each epoch (an unread
+    int32 accumulator would eventually wrap)."""
+    for attr in ('node_features', 'edge_features'):
+      store = getattr(self.data, attr, None)
+      for f in (store.values() if isinstance(store, dict) else [store]):
+        if hasattr(f, 'publish_stats'):
+          f.publish_stats()
 
   def _collate_fn(self, out):
     """SamplerOutput [P, ...] -> stacked Data/HeteroData (reference:
@@ -736,28 +755,31 @@ class DistLinkNeighborLoader(DistLoader):
   def __iter__(self):
     from ..sampler import EdgeSamplerInput
     guarded, recompute = self._overflow_epoch_start()
-    for idx, mask in self._index_blocks():
-      inputs = EdgeSamplerInput(
-          self.seed_rows[idx], self.seed_cols[idx],
-          label=(self.edge_label[idx]
-                 if self.edge_label is not None else None),
-          input_type=self.input_type,
-          neg_sampling=self.neg_sampling)
-      if recompute:
-        keys = self.sampler._next_keys()
-        out = self.sampler.sample_from_edges(inputs, seed_mask=mask,
-                                             keys=keys)
-        if self._batch_overflowed(out):
-          self.overflow_recomputes += 1
-          out = self._replay_sampler().sample_from_edges(
-              inputs, seed_mask=mask, keys=keys)
-      else:
-        out = self.sampler.sample_from_edges(inputs, seed_mask=mask)
-        if guarded:
-          self._accumulate_overflow(out)
-      yield self._collate_fn(out)
-    if guarded and not recompute:
-      self._finish_epoch_overflow()
+    try:
+      for idx, mask in self._index_blocks():
+        inputs = EdgeSamplerInput(
+            self.seed_rows[idx], self.seed_cols[idx],
+            label=(self.edge_label[idx]
+                   if self.edge_label is not None else None),
+            input_type=self.input_type,
+            neg_sampling=self.neg_sampling)
+        if recompute:
+          keys = self.sampler._next_keys()
+          out = self.sampler.sample_from_edges(inputs, seed_mask=mask,
+                                               keys=keys)
+          if self._batch_overflowed(out):
+            self.overflow_recomputes += 1
+            out = self._replay_sampler().sample_from_edges(
+                inputs, seed_mask=mask, keys=keys)
+        else:
+          out = self.sampler.sample_from_edges(inputs, seed_mask=mask)
+          if guarded:
+            self._accumulate_overflow(out)
+        yield self._collate_fn(out)
+      if guarded and not recompute:
+        self._finish_epoch_overflow()
+    finally:
+      self._publish_feature_stats()
 
 
 class DistSubGraphLoader(DistLoader):
@@ -785,10 +807,14 @@ class DistSubGraphLoader(DistLoader):
     self.max_degree = max_degree
 
   def __iter__(self):
-    for idx, mask in self._index_blocks():
-      out = self.sampler.subgraph(self.input_seeds[idx], seed_mask=mask,
-                                  max_degree=self.max_degree)
-      yield self._collate_fn(out)
+    try:
+      for idx, mask in self._index_blocks():
+        out = self.sampler.subgraph(self.input_seeds[idx],
+                                    seed_mask=mask,
+                                    max_degree=self.max_degree)
+        yield self._collate_fn(out)
+    finally:
+      self._publish_feature_stats()
 
 
 class DistNeighborLoader(DistLoader):

@@ -58,19 +58,10 @@ from .dist_feature import DistFeature
 from .dist_graph import DistGraph, DistHeteroGraph
 
 
-def _round8(n: int) -> int:
-  return max(8, ((n + 7) // 8) * 8)
-
-
-def exchange_capacity(frontier_width: int, nparts: int,
-                      bucket_frac) -> int:
-  """Resolved per-destination bucket capacity for one exchange hop:
-  ``round8(bucket_frac * frontier / nparts)`` clamped to the loss-free
-  full width. The dryrun reports per-hop all_to_all bytes from this."""
-  if bucket_frac is None or nparts <= 1:
-    return frontier_width
-  return min(frontier_width,
-             _round8(int(bucket_frac * frontier_width / nparts)))
+# canonical home is ops.route (shared with the feature-store miss
+# exchange); re-exported here because benchmarks/tests import them from
+# this module
+from ..ops.route import exchange_capacity, round8 as _round8  # noqa: E402,F401
 
 
 def _local_sample(garr, flat, fm, k, key, weighted: bool):
@@ -587,7 +578,7 @@ class DistNeighborSampler:
 
   def _build_fn(self, b: int):
     import jax
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     nparts = self.graph.num_partitions
@@ -642,7 +633,7 @@ class DistNeighborSampler:
     one SPMD program."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     nparts = self.graph.num_partitions
@@ -741,7 +732,7 @@ class DistNeighborSampler:
     all_to_all the relabeled results back to the owning shard."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     nparts = self.graph.num_partitions
@@ -1027,7 +1018,7 @@ class DistNeighborSampler:
 
   def _build_hetero_fn(self, b: int, input_ntype):
     import jax
-    from jax import shard_map
+    from ..utils.compat import shard_map
 
     plan = self._hetero_plan({input_ntype: b})
 
@@ -1061,7 +1052,7 @@ class DistNeighborSampler:
     multi-type engine, per-type label-index metadata."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ..utils.compat import shard_map
 
     g = self.graph
     src_t, _, dst_t = etype

@@ -277,14 +277,24 @@ class HGT(nn.Module):
   # the param tree never depends on batch content (see HGTConv.in_dims)
   in_dims: Any = None
 
+  def __post_init__(self):
+    # EdgeType-keyed dicts cannot live on Module fields (flax >= 0.10
+    # asserts string dict keys); store as pair tuples, thaw at call time
+    from .models import freeze_etype_items
+    object.__setattr__(self, 'hop_edge_offsets',
+                       freeze_etype_items(self.hop_edge_offsets))
+    super().__post_init__()
+
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
-    from .models import check_hetero_offsets, hetero_trim
+    from .models import (check_hetero_offsets, hetero_trim,
+                         thaw_etype_items)
     hier = self.hop_node_offsets is not None
+    hop_edge_offsets = thaw_etype_items(self.hop_edge_offsets)
     if hier:
       check_hetero_offsets(x_dict, edge_index_dict,
-                           self.hop_node_offsets, self.hop_edge_offsets,
+                           self.hop_node_offsets, hop_edge_offsets,
                            self.num_layers)
     x_dict = {t: nn.relu(nn.Dense(self.hidden_dim, dtype=self.dtype,
                                   name=f'lin_{t}')(
@@ -307,7 +317,7 @@ class HGT(nn.Module):
       if hier:
         x_in, ei, em = hetero_trim(
             x_dict, edge_index_dict, edge_mask_dict,
-            self.hop_node_offsets, self.hop_edge_offsets, hops_used)
+            self.hop_node_offsets, hop_edge_offsets, hops_used)
       else:
         x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
       recs = out_rows = None

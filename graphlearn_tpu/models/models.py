@@ -18,6 +18,27 @@ from .conv import GATConv, GCNConv, SAGEConv
 _CONVS = {'sage': SAGEConv, 'gcn': GCNConv, 'gat': GATConv}
 
 
+def freeze_etype_items(d):
+  """Tuple-keyed dict -> ((key, value), ...) pair tuple, for flax Module
+  fields. flax >= 0.10 walks every Module attribute through its
+  state-dict machinery at submodule registration, which asserts that
+  dict keys are strings — so EdgeType-keyed mappings (convs,
+  hop_edge_offsets) must be stored as pair tuples on Modules. Pass-through
+  for None / already-converted values."""
+  if isinstance(d, dict):
+    return tuple((tuple(k) if isinstance(k, (tuple, list)) else k, v)
+                 for k, v in d.items())
+  return d
+
+
+def thaw_etype_items(d):
+  """Inverse of freeze_etype_items at call time: pair tuple -> dict
+  (pass-through for dicts / None, so un-frozen callers keep working)."""
+  if d is None or isinstance(d, dict):
+    return d
+  return dict(d)
+
+
 def check_hetero_offsets(x_dict, edge_index_dict, hop_node_offsets,
                          hop_edge_offsets, num_layers):
   """Trace-time layout validation shared by the hierarchical hetero
@@ -594,13 +615,21 @@ class GAT(nn.Module):
 
 class HeteroConv(nn.Module):
   """Per-edge-type convs summed into per-node-type outputs
-  (RGNN layer; reference examples/igbh/rgnn.py)."""
-  convs: Dict[EdgeType, Any]  # EdgeType -> nn.Module instance
+  (RGNN layer; reference examples/igbh/rgnn.py).
+
+  ``convs`` maps EdgeType -> nn.Module; a dict passed in is stored as
+  (etype, conv) pairs (flax forbids tuple dict keys on Module fields —
+  see freeze_etype_items)."""
+  convs: Any  # {EdgeType: nn.Module} or ((EdgeType, nn.Module), ...)
+
+  def __post_init__(self):
+    object.__setattr__(self, 'convs', freeze_etype_items(self.convs))
+    super().__post_init__()
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict):
     out: Dict[NodeType, Any] = {}
-    for et, conv in self.convs.items():
+    for et, conv in self.convs:
       src_t, _, dst_t = et
       if et not in edge_index_dict or src_t not in x_dict:
         continue
@@ -944,10 +973,18 @@ class RGNN(nn.Module):
   # caps as the loader's frontier_caps dict. Requires dedup='merge'.
   merge_dense: bool = False
 
+  def __post_init__(self):
+    # EdgeType-keyed dicts cannot live on Module fields (flax >= 0.10
+    # asserts string dict keys); store as pair tuples, thaw at call time
+    object.__setattr__(self, 'hop_edge_offsets',
+                       freeze_etype_items(self.hop_edge_offsets))
+    super().__post_init__()
+
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
                train: bool = False):
     hier = self.hop_node_offsets is not None
+    hop_edge_offsets = thaw_etype_items(self.hop_edge_offsets)
     assert not (self.tree_dense and self.merge_dense)
     if self.tree_dense or self.merge_dense:
       assert hier and self.tree_records is not None, (
@@ -955,7 +992,7 @@ class RGNN(nn.Module):
           '(sampler.hetero_tree_blocks)')
     if hier:
       check_hetero_offsets(x_dict, edge_index_dict,
-                           self.hop_node_offsets, self.hop_edge_offsets,
+                           self.hop_node_offsets, hop_edge_offsets,
                            self.num_layers)
     x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
                           name=f'embed_{t}')(x)
@@ -980,7 +1017,7 @@ class RGNN(nn.Module):
         hops_used = self.num_layers - i
         x_in, ei, em = hetero_trim(
             x_dict, edge_index_dict, edge_mask_dict,
-            self.hop_node_offsets, self.hop_edge_offsets, hops_used)
+            self.hop_node_offsets, hop_edge_offsets, hops_used)
       else:
         x_in, ei, em = x_dict, edge_index_dict, edge_mask_dict
       if self.tree_dense or self.merge_dense:

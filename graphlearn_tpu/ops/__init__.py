@@ -16,7 +16,8 @@ from .neighbor import (BLOCK, build_padded_adjacency,
                        uniform_sample_block, uniform_sample_local,
                        uniform_sample_padded, weighted_sample,
                        weighted_sample_local)
-from .route import gather_from_buckets, route_slots, scatter_to_buckets
+from .route import (exchange_capacity, gather_from_buckets, round8,
+                    route_slots, scatter_to_buckets)
 from .stitch import stitch_rows
 from .subgraph import (node_subgraph, node_subgraph_bucketed,
                        node_subgraph_local)

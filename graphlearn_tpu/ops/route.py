@@ -19,11 +19,36 @@ that trade capacity for all_to_all volume can detect (and assert on) any
 drop instead of losing samples silently.
 """
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 
 from .unique import FILL
+
+
+def round8(n: int) -> int:
+  """Round up to the lane-friendly multiple of 8 (min 8)."""
+  return max(8, ((n + 7) // 8) * 8)
+
+
+def exchange_capacity(request_width: int, nparts: int,
+                      bucket_frac, hit_rate: float = 0.0) -> int:
+  """Resolved per-destination bucket capacity for one fixed-shape
+  exchange: ``round8(bucket_frac * expected_load / nparts)`` clamped to
+  the loss-free full width, where the expected per-exchange load is
+  ``request_width`` discounted by ``hit_rate`` (the feature store's
+  cache-hit floor; the sampler's frontier exchange uses 0). ONE home
+  for the capacity policy — the sampler's `_exchange_hop` and the
+  feature store's `miss_capacity` both resolve through here, and the
+  dryrun reports per-hop all_to_all bytes from it."""
+  if bucket_frac is None or nparts <= 1:
+    return request_width
+  load = request_width
+  if hit_rate > 0:
+    load = max(0, math.ceil(request_width * (1.0 - float(hit_rate))))
+  return min(request_width,
+             round8(int(bucket_frac * load / nparts)))
 
 
 @functools.partial(jax.jit, static_argnames=('capacity', 'with_overflow'))

@@ -1,6 +1,7 @@
 from .checkpoint import CheckpointManager
 from .common import (count_dict, get_free_port, load_module,
                      merge_dict)
+from .compat import shard_map
 from .device import (enable_compilation_cache, ensure_device,
                      get_available_device, global_device_put)
 from .exit_status import python_exit_status

@@ -1,0 +1,33 @@
+"""Version shims over moving jax APIs.
+
+One place resolves symbols whose home changed across the jax versions this
+package must run on (the TPU rig's pinned jax vs the 0.4.x CI images), so
+call sites never need try/except imports.
+
+``shard_map``: top-level ``jax.shard_map`` exists only on newer jax; on
+0.4.x the implementation lives in ``jax.experimental.shard_map``. Both
+accept the keyword form used throughout this package
+(``shard_map(f, mesh=..., in_specs=..., out_specs=...)``). Resolution is
+deferred to the first call so importing this package never forces jax in
+(the package-wide convention: jax config keys must stay settable before
+first backend use).
+"""
+
+_shard_map_impl = None
+
+
+def _resolve_shard_map():
+  global _shard_map_impl
+  if _shard_map_impl is None:
+    try:
+      from jax import shard_map as sm  # jax >= 0.6 top-level export
+    except ImportError:
+      from jax.experimental.shard_map import shard_map as sm
+    _shard_map_impl = sm
+  return _shard_map_impl
+
+
+def shard_map(*args, **kwargs):
+  """jax.shard_map on jax versions that export it, else the
+  jax.experimental.shard_map implementation (jax 0.4.x)."""
+  return _resolve_shard_map()(*args, **kwargs)

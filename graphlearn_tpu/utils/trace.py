@@ -203,7 +203,12 @@ def wrap_dispatch(fn: Callable, name: Optional[str] = None) -> Callable:
 # Named event counters: the resilience layer (distributed/resilience.py)
 # reports degradation events here — retries, failovers, worker restarts,
 # injected faults — so a degraded-but-completed epoch is visible without
-# log scraping. Process-local; increments come from many threads at once
+# log scraping. The distributed feature store publishes its ON-DEVICE
+# hit/miss/overflow accumulator here too ('dist_feature.*'), via
+# DistFeature.publish_stats() at EPOCH granularity — the counters ride
+# the lookup program between publishes, so the hot loop never pays a
+# device->host fetch for observability (PERF.md rules).
+# Process-local; increments come from many threads at once
 # (heartbeat probes, pullers, RPC handler threads), and a dict
 # read-modify-write can interleave at bytecode boundaries, so a lock
 # guards the add. Read with counters()/counter_get, zero with

@@ -68,6 +68,7 @@ def _chaos_server_main(rank, q, ready, faults_spec=None):
   glt_mod.distributed.wait_and_shutdown_server(timeout=300)
 
 
+@pytest.mark.slow  # tier-1 budget: injected-fetch failover variants stay
 def test_sigkill_server_mid_epoch_failover():
   """Acceptance: 2 sampling servers, SIGKILL one mid-epoch — the remote
   loader detects the death (TCP reset / heartbeat), redistributes the
@@ -277,6 +278,7 @@ def _epoch_fingerprint(loader):
   return out
 
 
+@pytest.mark.slow  # tier-1 budget: worker-restart replay variants stay
 def test_worker_kill_bit_identical_replay(monkeypatch):
   """Acceptance: kill a producer worker mid-epoch; the producer
   respawns it with the PRNG stream fast-forwarded and replays the

@@ -380,6 +380,7 @@ def test_dist_link_sampler_binary():
     np.testing.assert_array_equal(label[p], [1, 1, 0, 0])
 
 
+@pytest.mark.slow  # tier-1 budget: multi-seed scan; full suite runs it
 def test_dist_link_negatives_strict():
   """neg_strict=True on a dense graph: every mask-VALID negative pair is
   guaranteed a non-edge (the shard-local check is complete because each
@@ -412,9 +413,9 @@ def test_dist_link_negatives_strict():
   seed_r = np.array([[0, 2], [1, 3]], np.int32)
   seed_c = (seed_r + 1) % n
 
-  def negatives(strict):
+  def negatives(strict, seed):
     sampler = glt.distributed.DistNeighborSampler(
-        dg, [2], mesh, seed=3, neg_strict=strict)
+        dg, [2], mesh, seed=seed, neg_strict=strict)
     got = []
     for trial in range(6):
       out = sampler.sample_from_edges(EdgeSamplerInput(
@@ -432,13 +433,21 @@ def test_dist_link_negatives_strict():
           got.append((u, v))
     return got
 
-  strict_pairs = negatives(True)
-  assert strict_pairs, 'strict sampler produced no valid negatives'
-  for u, v in strict_pairs:
-    assert (u, v) not in adj, (u, v)
-  # non-strict on this dense graph: slip-through is near-certain
-  loose_pairs = negatives(False)
-  assert any(p in adj for p in loose_pairs), \
+  # the strict guarantee must hold for EVERY stream; the loose
+  # slip-through is probabilistic (~22% per draw), so scan a few seeds —
+  # a single fixed seed makes the assertion a coin flip against each jax
+  # version's PRNG stream (it lost on 0.4.x)
+  seeds = (3, 7, 11, 19, 23)
+  slipped = False
+  for s in seeds:
+    strict_pairs = negatives(True, s)
+    assert strict_pairs, 'strict sampler produced no valid negatives'
+    for u, v in strict_pairs:
+      assert (u, v) not in adj, (u, v)
+    slipped = slipped or any(p in adj for p in negatives(False, s))
+    if slipped:
+      break
+  assert slipped, \
       'expected at least one slipped edge in non-strict mode'
 
 

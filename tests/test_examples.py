@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLE = os.path.join(REPO, 'examples', 'train_sage_ogbn_products.py')
@@ -93,6 +94,7 @@ def test_products_staged_npz_path(tmp_path):
 GATE = os.path.join(REPO, 'examples', 'igbh', 'train_rgnn_gate.py')
 
 
+@pytest.mark.slow  # tier-1 budget (ROADMAP 870s): full training run
 def test_hetero_gate_discriminative_merge_dense():
   """The hetero accuracy gate end to end on its hardest path
   (calibrated caps + dense k-run typed aggregation): a few epochs on

@@ -200,6 +200,7 @@ def test_merge_dense_matches_segment():
                                  rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: SAGE merge-dense variant stays tier-1
 def test_merge_dense_gat_matches_segment():
   """MergeGATConv's per-target k-run softmax == segment-softmax GATConv
   on merge batches (seed logits identical), incl. calibrated caps."""
@@ -416,7 +417,8 @@ def test_hgt_bf16():
   assert np.isfinite(np.asarray(out, np.float32)).all()
 
 
-@pytest.mark.parametrize('dedup', ['tree', 'map'])
+@pytest.mark.parametrize('dedup', [
+    'tree', pytest.param('map', marks=pytest.mark.slow)])  # tier-1 budget
 def test_hierarchical_rgnn_matches_full(dedup):
   """The hierarchical (trim-per-layer) RGNN forward matches the full
   forward on the seed slots — over hetero TREE batches and hetero
@@ -537,7 +539,8 @@ def test_tree_dense_gat_matches_segment():
                              rtol=5e-5, atol=5e-5)
 
 
-@pytest.mark.parametrize('dedup', ['tree', 'map'])
+@pytest.mark.parametrize('dedup', [
+    'tree', pytest.param('map', marks=pytest.mark.slow)])  # tier-1 budget
 def test_hierarchical_hgt_matches_full(dedup):
   """HGT with hetero hop offsets (trim-per-layer) matches the full
   forward on the seed slots — tree and exact-dedup (merge) hetero
@@ -612,6 +615,7 @@ def test_merge_dense_zero_degree_leading_seed():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: hgt_tree_dense variant stays tier-1
 def test_tree_dense_hetero_matches_segment():
   """TreeHeteroConv's typed dense k-run aggregation == HeteroConv over
   per-etype segment convs on hetero tree batches (seed logits), for
@@ -755,6 +759,11 @@ def test_hgt_tree_dense_matches_segment():
                              rtol=2e-4, atol=2e-4)
 
 
+# tier-1 budget (ROADMAP 870s): the heaviest hetero equivalence
+# variants run under the slow marker; tier-1 keeps the typed-dense
+# (test_tree_dense_hetero_matches_segment) and typed-merge
+# (test_hgt_merge_dense_matches_segment[True]) representatives
+@pytest.mark.slow
 @pytest.mark.parametrize('use_caps', [True, False])
 def test_merge_dense_hetero_matches_segment(use_caps):
   """TreeHeteroConv(mode='merge') — dense k-run typed aggregation over
@@ -912,7 +921,8 @@ def test_flat_run_mean_window_impl_matches():
   np.testing.assert_allclose(o_ref, o_win, rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize('use_caps', [True, False])
+@pytest.mark.parametrize('use_caps', [
+    True, pytest.param(False, marks=pytest.mark.slow)])  # tier-1 budget
 def test_hgt_merge_dense_matches_segment(use_caps):
   """HGT(merge_dense=True) — dense k-run typed attention on exact-dedup
   merge batches (calibrated caps and uncapped) — matches the segment

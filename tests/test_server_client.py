@@ -294,6 +294,7 @@ def _matrix_client_main(rank, addrs, out_q):
     out_q.put((rank, f'{type(e).__name__}: {e}'))
 
 
+@pytest.mark.slow  # tier-1 budget: matrix variant; e2e pairs stay tier-1
 def test_two_servers_two_clients_matrix():
   """The reference's remote-mode matrix (2 sampling servers x 2 training
   clients, each client splitting its seeds across BOTH servers —
@@ -510,6 +511,7 @@ def test_mp_dist_hetero_link_loader():
     loader.shutdown()
 
 
+@pytest.mark.slow  # tier-1 budget: node/hetero e2e stay tier-1
 def test_server_client_link_end_to_end():
   """Remote LINK loading (round 5): seed edges split across sampling
   servers; producers draw negatives server-side and stream batches
@@ -550,6 +552,7 @@ def test_server_client_link_end_to_end():
   assert not server.is_alive()
 
 
+@pytest.mark.slow  # tier-1 budget: node/hetero e2e stay tier-1
 def test_server_client_hetero_link_end_to_end():
   """Remote HETERO LINK loading: typed seed edges ship to the server
   inside EdgeSamplerInputs, its mp workers run the typed link engine,
