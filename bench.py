@@ -665,6 +665,65 @@ def main():
   except Exception as e:
     result['scan_epoch_error'] = f'{type(e).__name__}: {e}'[:200]
 
+  # ---- scanned DISTRIBUTED epoch: dist-epoch-as-a-program ----------
+  # The collocated mesh loop's counterpart of the keys above: the
+  # per-step distributed loop pays >= 2 dispatches/batch (sample +
+  # collate + feature/label gathers + train step) while DistScanTrainer
+  # runs the epoch as ceil(steps/K) + 2 (loader/scan_epoch.py). Runs on
+  # whatever devices the backend exposes (mesh size 1 on a single-chip
+  # rig — the dispatch-count story is mesh-size-independent); wall
+  # times are the scheduling claim, device-trace staged for the
+  # multi-chip run.
+  try:
+    import jax.numpy as jnp
+    import optax
+    from benchmarks.bench_dist_loader import (make_dist_fixture,
+                                              run_scan_ab)
+    from graphlearn_tpu.models import GraphSAGE
+    from graphlearn_tpu.models import train as train_lib
+    dp_ = min(8, len(jax.devices()))
+    dn, ddeg, dbatch, dsteps, dchunk = 100_000, 10, 256, 8, 4
+    drng = np.random.default_rng(3)
+    drows = drng.integers(0, dn, dn * ddeg)
+    dcols = drng.integers(0, dn, dn * ddeg)
+    _, dds, dmesh = make_dist_fixture(
+        drows, dcols, dn, dp_, feat_dim=32, split_ratio=0.2,
+        labels=drng.integers(0, 16, dn), feat_rng=drng)
+    dseeds = drng.integers(0, dn, dp_ * dbatch * dsteps)
+
+    def _dist_loader():
+      return glt.distributed.DistNeighborLoader(
+          dds, [10, 5], dseeds, batch_size=dbatch, shuffle=False,
+          drop_last=True, seed=0, mesh=dmesh)
+
+    dmodel = GraphSAGE(hidden_dim=64, out_dim=16, num_layers=2)
+    dtx = optax.adam(1e-3)
+    dfirst = next(iter(_dist_loader()))
+    dparams = dmodel.init(jax.random.PRNGKey(0),
+                          np.asarray(dfirst.x)[0],
+                          np.asarray(dfirst.edge_index)[0],
+                          np.asarray(dfirst.edge_mask)[0])
+
+    def _dist_state():
+      return train_lib.TrainState(dparams, dtx.init(dparams),
+                                  jnp.zeros((), jnp.int32))
+
+    ab = run_scan_ab(_dist_loader, dmodel, dtx, 16, dchunk,
+                     _dist_state)
+    ddc, sdc = ab['step_dispatches'], ab['scan_dispatches']
+    result['dist_epoch_dispatches'] = ddc.total
+    result['dist_epoch_wall_s'] = round(ab['step_wall_s'], 3)
+    result['dist_scan_epoch_dispatches'] = sdc.total
+    result['dist_scan_epoch_wall_s'] = round(ab['scan_wall_s'], 3)
+    result['dist_scan_epoch_steps'] = int(
+        np.asarray(ab['scan_losses']).shape[0])
+    result['dist_scan_epoch_chunk'] = dchunk
+    result['dist_scan_mesh_size'] = dp_
+    result['dist_scan_epoch_dispatch_reduction_x'] = round(
+        ddc.total / max(sdc.total, 1), 1)
+  except Exception as e:
+    result['dist_scan_epoch_error'] = f'{type(e).__name__}: {e}'[:200]
+
   # ---- RUN_MEAN_IMPL A/B (the prof_copytax.py decision, VERDICT r5):
   # emit both impls' e2e step ms as bench keys so the next on-chip run
   # DECIDES the models.RUN_MEAN_IMPL default instead of staying stalled

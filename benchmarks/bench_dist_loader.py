@@ -18,6 +18,104 @@ import time
 import numpy as np
 
 
+def make_dist_fixture(rows, cols, num_nodes, p, feat_dim=None,
+                      split_ratio=0.2, labels=None, feat_rng=None):
+  """ONE partition/shard fixture builder for the dist benchmarks —
+  main(), _scan_ab and bench.py's dist-scan section all build the same
+  round-robin node book + per-partition edge/feature shards, and a
+  drift between the arms would silently benchmark different datasets
+  (the _make_timed precedent). With ``feat_dim`` returns
+  ``(dist_graph, dist_dataset, mesh)``; without, feature shards are
+  skipped and dataset is None (sampler-only benchmarks).
+
+  Import-light on purpose: callers set JAX_PLATFORMS/XLA_FLAGS before
+  the first jax import, so jax/glt load lazily here."""
+  import jax
+  from jax.sharding import Mesh
+
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.typing import GraphPartitionData
+
+  node_pb = (np.arange(num_nodes) % p).astype(np.int32)
+  epb = node_pb[rows]
+  eids = np.arange(rows.shape[0])
+  parts, feats = [], []
+  for q in range(p):
+    m = epb == q
+    parts.append(GraphPartitionData(
+        edge_index=np.stack([rows[m], cols[m]]), eids=eids[m]))
+    if feat_dim is not None:
+      ids = np.nonzero(node_pb == q)[0]
+      feats.append((ids.astype(np.int64),
+                    feat_rng.standard_normal((ids.shape[0], feat_dim))
+                    .astype(np.float32)))
+  mesh = Mesh(np.array(jax.devices()[:p]), ('g',))
+  if feat_dim is None:
+    return glt.distributed.DistGraph(p, 0, parts, node_pb), None, mesh
+  dg = glt.distributed.DistGraph(p, 0, parts, node_pb, epb)
+  df = glt.distributed.DistFeature(p, feats, node_pb, mesh,
+                                   split_ratio=split_ratio)
+  ds = glt.distributed.DistDataset(p, 0, dg, df, node_labels=labels)
+  return dg, ds, mesh
+
+
+def run_scan_ab(make_loader, model, tx, num_classes, chunk_size,
+                make_state, warmup=True):
+  """ONE measurement protocol for the scanned-vs-per-step distributed
+  epoch A/B — _scan_ab, bench.py's dist-scan section and
+  __graft_entry__'s dryrun stage all run it, so a drift (a dropped
+  warmup epoch, a missing block_until_ready) can't silently skew one
+  arm of the PERF.md dispatch/wall claims.
+
+  Per arm: optional compile epoch (``warmup``), then one measured epoch
+  under utils.count_dispatches with block_until_ready inside the wall
+  timer. ``make_state`` builds a fresh TrainState and is called ONCE
+  per arm; the measured epoch continues from the warmup's RETURNED
+  state because DistScanTrainer.run_epoch donates its input (a second
+  make_state over the same params tree would read deleted buffers).
+  Returns a dict with each arm's final state, losses (device arrays),
+  DispatchCounter and wall seconds."""
+  import time
+
+  import jax
+
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.utils import count_dispatches
+
+  def _arm(run):
+    state = make_state()
+    if warmup:
+      state, losses = run(state)
+      jax.block_until_ready(losses)
+    with count_dispatches() as dc:
+      t0 = time.perf_counter()
+      state, losses = run(state)
+      jax.block_until_ready(losses)
+      wall = time.perf_counter() - t0
+    return state, losses, dc, wall
+
+  ref = glt.loader.DistFusedEpochTrainer(make_loader(), model, tx,
+                                         num_classes)
+  st_step, l_step, dc_step, wall_step = _arm(
+      lambda s: ref.run_epoch_steps(s))
+
+  trainer = glt.loader.DistScanTrainer(make_loader(), model, tx,
+                                       num_classes,
+                                       chunk_size=chunk_size)
+
+  def _scan(s):
+    state, losses, _ = trainer.run_epoch(s)
+    return state, losses
+
+  st_scan, l_scan, dc_scan, wall_scan = _arm(_scan)
+  return {
+      'step_state': st_step, 'step_losses': l_step,
+      'step_dispatches': dc_step, 'step_wall_s': wall_step,
+      'scan_state': st_scan, 'scan_losses': l_scan,
+      'scan_dispatches': dc_scan, 'scan_wall_s': wall_scan,
+  }
+
+
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument('--num-nodes', type=int, default=200_000)
@@ -49,12 +147,35 @@ def main():
                        '(estimate_hetero_frontier_caps) on an '
                        'IGBH-shaped typed graph and report the '
                        'step-time ratio (round 5)')
+  ap.add_argument('--scan', action='store_true',
+                  help='per mesh size, A/B the PER-STEP collocated '
+                       'training epoch against the scanned '
+                       'DistScanTrainer epoch (dispatch counts + '
+                       'CPU-mesh wall; loader/scan_epoch.py)')
+  ap.add_argument('--scan-steps', type=int, default=8,
+                  help='epoch length (optimizer steps) for --scan')
+  ap.add_argument('--scan-chunk', type=int, default=4,
+                  help='lax.scan chunk size K for --scan')
   args = ap.parse_args()
 
+  if not args.tpu:
+    # jax 0.4.x has no jax_num_cpu_devices config key — the XLA flag
+    # must be in place before backend init (conftest.py's pattern)
+    import os
+    import re
+    flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '',
+                   os.environ.get('XLA_FLAGS', ''))
+    os.environ['XLA_FLAGS'] = (
+        flags +
+        f' --xla_force_host_platform_device_count={args.cpu_devices}'
+    ).strip()
   import jax
   if not args.tpu:
     jax.config.update('jax_platforms', 'cpu')
-    jax.config.update('jax_num_cpu_devices', args.cpu_devices)
+    try:
+      jax.config.update('jax_num_cpu_devices', args.cpu_devices)
+    except AttributeError:
+      pass   # jax 0.4.x: XLA_FLAGS above is the knob
   from jax.sharding import Mesh
 
   sys.path.insert(0, __file__.rsplit('/', 2)[0])
@@ -63,6 +184,9 @@ def main():
 
   if args.compare_hetero_calibrated:
     _compare_hetero(args, jax, glt, GraphPartitionData, Mesh)
+    return
+  if args.scan:
+    _scan_ab(args, jax, glt)
     return
 
   n = args.num_nodes
@@ -75,7 +199,6 @@ def main():
   cols = np.empty(e, np.int64)
   cols[:e // 2] = rng.integers(0, n, e // 2)
   cols[e // 2:] = rng.zipf(1.5, e - e // 2) % n
-  eids = np.arange(rows.shape[0])
   host_topo = None
   if args.compare_calibrated:
     host_topo = glt.data.Topology(np.stack([rows, cols]), num_nodes=n)
@@ -83,15 +206,7 @@ def main():
   for p in [int(x) for x in args.mesh_sizes.split(',')]:
     if p > len(jax.devices()):
       continue
-    node_pb = (np.arange(n) % p).astype(np.int32)
-    epb = node_pb[rows]
-    parts = []
-    for q in range(p):
-      m = epb == q
-      parts.append(GraphPartitionData(
-          edge_index=np.stack([rows[m], cols[m]]), eids=eids[m]))
-    mesh = Mesh(np.array(jax.devices()[:p]), ('g',))
-    dg = glt.distributed.DistGraph(p, 0, parts, node_pb)
+    dg, _, mesh = make_dist_fixture(rows, cols, n, p)
     seeds = rng.integers(0, n, (p, args.batch_size)).astype(np.int32)
 
     timed = _make_timed(jax, seeds, args.iters,
@@ -150,6 +265,70 @@ def main():
         'feature_exchange_config': (
             f'request_width={node_cap}, F={fdim}, bucket_frac=2.0, '
             f'split_ratio={args.split_ratio}, bf16 wire'),
+        'backend': jax.default_backend(),
+    }), flush=True)
+
+
+def _scan_ab(args, jax, glt):
+  """Per-step collocated training epoch vs DistScanTrainer's scanned
+  epoch, per mesh size: instrumented dispatch counts (the wall-clock
+  story on the remote-dispatch rig — PERF.md) plus CPU-mesh wall as a
+  scheduling sanity check. Both arms run the SAME data-parallel update
+  (pipeline.DistFusedEpochTrainer), so the A/B isolates epoch
+  EXECUTION: ~5 dispatches/step vs ceil(steps/K) + 2 per epoch."""
+  import optax
+  from graphlearn_tpu.models import GraphSAGE
+  from graphlearn_tpu.models import train as train_lib
+
+  n = args.num_nodes
+  rng = np.random.default_rng(0)
+  rows = rng.integers(0, n, n * args.avg_deg)
+  cols = rng.integers(0, n, n * args.avg_deg)
+  ncls = 16
+  labels = rng.integers(0, ncls, n)
+  for p in [int(x) for x in args.mesh_sizes.split(',')]:
+    if p > len(jax.devices()):
+      continue
+    _, ds, mesh = make_dist_fixture(
+        rows, cols, n, p, feat_dim=args.feat_dim,
+        split_ratio=args.split_ratio, labels=labels, feat_rng=rng)
+    seeds = rng.integers(0, n, p * args.batch_size * args.scan_steps)
+
+    def make_loader():
+      return glt.distributed.DistNeighborLoader(
+          ds, list(args.fanout), seeds, batch_size=args.batch_size,
+          shuffle=False, drop_last=True, seed=0, mesh=mesh)
+
+    model = GraphSAGE(hidden_dim=64, out_dim=ncls,
+                      num_layers=len(args.fanout))
+    tx = optax.adam(1e-3)
+    first = next(iter(make_loader()))
+    params = model.init(jax.random.PRNGKey(0), np.asarray(first.x)[0],
+                        np.asarray(first.edge_index)[0],
+                        np.asarray(first.edge_mask)[0])
+
+    def fresh_state():
+      import jax.numpy as jnp
+      return train_lib.TrainState(params, tx.init(params),
+                                  jnp.zeros((), jnp.int32))
+
+    ab = run_scan_ab(make_loader, model, tx, ncls, args.scan_chunk,
+                     fresh_state)
+    dc_step, dc_scan = ab['step_dispatches'], ab['scan_dispatches']
+    steps = int(np.asarray(ab['scan_losses']).shape[0])
+    print(json.dumps({
+        'metric': 'dist_scan_epoch_ab',
+        'mesh_size': p,
+        'steps': steps,
+        'chunk': args.scan_chunk,
+        'dist_epoch_dispatches': dc_step.total,
+        'dist_scan_epoch_dispatches': dc_scan.total,
+        'dispatch_reduction_x': round(
+            dc_step.total / max(dc_scan.total, 1), 1),
+        'dist_epoch_wall_s': round(ab['step_wall_s'], 4),
+        'dist_scan_epoch_wall_s': round(ab['scan_wall_s'], 4),
+        'wall_ratio': round(
+            ab['step_wall_s'] / max(ab['scan_wall_s'], 1e-9), 2),
         'backend': jax.default_backend(),
     }), flush=True)
 

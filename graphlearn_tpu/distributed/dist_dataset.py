@@ -157,6 +157,19 @@ class DistDataset:
                           else np.asarray(node_labels))
     return self
 
+  def feature_stores(self):
+    """Every DistFeature this dataset owns (node + edge, flattened over
+    the per-type dicts) — the discovery point for epoch-granularity
+    stats publishing: the collocated loaders and the scanned-epoch
+    trainer both drain the on-device accumulators through this list
+    (an unread int32 accumulator would eventually wrap). The sampler's
+    label stores are NOT dataset-owned — loaders drain those via
+    sampler.label_stores()."""
+    for store in (self.node_features, self.edge_features):
+      for f in (store.values() if isinstance(store, dict) else [store]):
+        if hasattr(f, 'publish_stats'):
+          yield f
+
   @property
   def node_pb(self):
     return self.graph.node_pb if self.graph is not None else None

@@ -223,18 +223,21 @@ class DistFeature:
     return s
 
   # ---------------------------------------------------------- program
-  def _build_fn(self, b: int):
-    """Jitted shard_map lookup for per-shard request blocks of size b:
-    cache split -> miss dedup -> bucketed (or hierarchical) miss-only
-    exchange -> fan-out + merge, ONE dispatch, no host syncs."""
+  def _shard_body(self, b: int):
+    """Per-shard lookup body over UNWRAPPED per-shard views — the core
+    of the one-dispatch program, exposed so outer shard_map programs
+    (DistScanTrainer's scanned epoch) can inline the exact same
+    cache-split -> miss-dedup -> bucketed-exchange -> merge computation
+    and thread the [4] stats row through their own carry.
+
+    Returns ``body(feat_ids [n], feats [n, F], pb, cache_ids,
+    cache_feats, stats_row [4], ids [b], mask [b]) ->
+    (rows [b, F], new_stats_row [4])``. Must be traced on this store's
+    mesh (the exchange collectives run over every mesh axis)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
-
-    from ..utils.compat import shard_map
 
     nparts = self.num_partitions
-    dev = self.device_arrays()
     fdim = self.feature_dim
     fdtype = self.feats.dtype
     wdtype = self.wire_dtype or fdtype
@@ -336,9 +339,6 @@ class DistFeature:
 
     def body(feat_ids, feats, pb, cache_ids, cache_feats, stats, ids,
              mask):
-      # per-shard views: feat_ids [1, n], feats [1, n, F], ids [1, b]
-      feat_ids, feats = feat_ids[0], feats[0]
-      ids, mask, stats = ids[0], mask[0], stats[0]
       safe = jnp.maximum(ids, 0)
       if h > 0:
         cpos = jnp.clip(jnp.searchsorted(cache_ids, safe), 0,
@@ -366,7 +366,29 @@ class DistFeature:
                       jnp.where(miss[:, None], out_miss, 0))
       batch_stats = jnp.stack([
           jnp.sum(is_hit), jnp.sum(miss), ucnt, ovf]).astype(jnp.int32)
-      return out[None], (stats + batch_stats)[None]
+      return out, stats + batch_stats
+
+    return body
+
+  def _build_fn(self, b: int):
+    """Jitted shard_map lookup for per-shard request blocks of size b:
+    cache split -> miss dedup -> bucketed (or hierarchical) miss-only
+    exchange -> fan-out + merge, ONE dispatch, no host syncs."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    dev = self.device_arrays()
+    ax = tuple(self.mesh.axis_names)
+    core = self._shard_body(b)
+
+    def body(feat_ids, feats, pb, cache_ids, cache_feats, stats, ids,
+             mask):
+      # per-shard views: feat_ids [1, n], feats [1, n, F], ids [1, b]
+      out, new_stats = core(feat_ids[0], feats[0], pb, cache_ids,
+                            cache_feats, stats[0], ids[0], mask[0])
+      return out[None], new_stats[None]
 
     fn = shard_map(
         body, mesh=self.mesh,

@@ -63,6 +63,7 @@ class DistLoader(OverflowGuardMixin):
     self.shuffle = shuffle
     self.drop_last = drop_last
     self.collect_features = collect_features
+    self.seed = seed   # kept: DistScanTrainer derives its perm key here
     self._rng = np.random.default_rng(seed)
     self.num_partitions = data.num_partitions
 
@@ -141,18 +142,27 @@ class DistLoader(OverflowGuardMixin):
     so this is the only device->host stats fetch of the feature path.
     Edge-feature stores publish too: their accumulators thread through
     every edge_attr gather and must be drained each epoch (an unread
-    int32 accumulator would eventually wrap)."""
-    for attr in ('node_features', 'edge_features'):
-      store = getattr(self.data, attr, None)
-      for f in (store.values() if isinstance(store, dict) else [store]):
-        if hasattr(f, 'publish_stats'):
-          f.publish_stats()
+    int32 accumulator would eventually wrap). The sampler's sharded
+    LABEL stores are DistFeatures with the same accumulator and the
+    same wrap hazard — they drain here too, under 'dist_label' so the
+    headline dist_feature.* parity (per-step vs scanned, which skips
+    label-stat accumulation by design) is untouched."""
+    for f in self.data.feature_stores():
+      f.publish_stats()
+    for f in self.sampler.label_stores():
+      f.publish_stats(prefix='dist_label')
 
   def _collate_fn(self, out):
     """SamplerOutput [P, ...] -> stacked Data/HeteroData (reference:
     dist_loader.py:331-441 parses the channel SampleMessage; here arrays
     are already device-resident and sharded)."""
     from .. import ops
+    from ..utils.trace import record_dispatch
+    # the collate's own program launches (edge_index stack; the feature
+    # and label gathers count separately under 'dist_feature.get') —
+    # together with 'dist_sample' this makes the per-step distributed
+    # loop's >= 2 dispatches/step an assertable budget, not arithmetic
+    record_dispatch('dist_collate')
     from ..loader import HeteroData
     from ..sampler import HeteroSamplerOutput
     x, y = self.sampler.collate(

@@ -425,6 +425,12 @@ class DistNeighborSampler:
                          'a per-hop list on homogeneous graphs')
       self.frontier_caps = tuple(frontier_caps)
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
+    # host-side PRNG stream position: step keys are
+    # split(fold_in(self._key, count), P) with count starting at 1
+    # (see _keys_for) — replayable by counter, matching the local
+    # sampler's discipline so scanned epochs can fold the counter into
+    # the scan carry
+    self._call_count = 0
     # every-axis collectives: ('g',) on the flat mesh, or
     # ('slice', 'chip') on a 2-axis multi-slice mesh (init_multihost
     # mesh_shape) — specs/collectives below use the tuple uniformly
@@ -468,14 +474,24 @@ class DistNeighborSampler:
       self._dev[key] = jax.device_put(g.sorted_local_indices(), shard)
     return self._dev[key]
 
-  def _next_keys(self):
+  def _keys_for(self, count):
+    """Per-shard keys for PRNG-stream position ``count``:
+    split(fold_in(base_key, count), P). Counter-addressed (not
+    split-and-carry) so the scanned-epoch program (loader/scan_epoch.py
+    DistScanTrainer) can replay the exact per-step stream from a carried
+    step counter — count may be a host int or a traced scalar."""
     import jax
-    self._key, sub = jax.random.split(self._key)
+    sub = jax.random.fold_in(self._key, count)
     return jax.random.split(sub, self.graph.num_partitions)
 
+  def _next_keys(self):
+    self._call_count += 1
+    return self._keys_for(self._call_count)
+
   def state_dict(self):
-    """Split-and-carry PRNG: the carried key is the whole state."""
-    return {'key': np.asarray(self._key).tolist()}
+    """fold_in counter PRNG: base key + stream position."""
+    return {'key': np.asarray(self._key).tolist(),
+            'call_count': self._call_count}
 
   def load_state_dict(self, state):
     import jax.numpy as jnp
@@ -484,6 +500,8 @@ class DistNeighborSampler:
           f'checkpoint sampler state {sorted(state)} was written by a '
           'different sampler type; resuming would diverge')
     self._key = jnp.asarray(np.asarray(state['key'], np.uint32))
+    # pre-fold_in checkpoints carry no counter; resume at stream start
+    self._call_count = int(state.get('call_count', 0))
 
   def _capacities(self, b: int, with_frontier_caps: bool = True):
     """Per-hop frontier capacity plan (single-chip capacity_plan with the
@@ -1146,6 +1164,8 @@ class DistNeighborSampler:
     sig = ('het', b, input_ntype)
     if sig not in self._fns:
       self._fns[sig] = self._build_hetero_fn(b, input_ntype)
+    from ..utils.trace import record_dispatch
+    record_dispatch('dist_sample')
     res = self._fns[sig](jnp.asarray(seeds, jnp.int32),
                          jnp.asarray(smask), self._next_keys())
     return HeteroSamplerOutput(
@@ -1201,6 +1221,8 @@ class DistNeighborSampler:
       return self._hetero_sample_from_nodes(input_ntype, seeds, smask)
     if b not in self._fns:
       self._fns[b] = self._build_fn(b)
+    from ..utils.trace import record_dispatch
+    record_dispatch('dist_sample')
     res = self._fns[b](jnp.asarray(seeds, jnp.int32), jnp.asarray(smask),
                        keys if keys is not None else self._next_keys())
     return SamplerOutput(
@@ -1238,6 +1260,8 @@ class DistNeighborSampler:
     neg = inputs.neg_sampling
     mode = 'none' if neg is None else neg.mode
     num_neg = 0 if neg is None else neg.num_negatives(b)
+    from ..utils.trace import record_dispatch
+    record_dispatch('dist_sample')
 
     if self.is_hetero:
       assert etype is not None, 'hetero link sampling requires input_type'
@@ -1332,6 +1356,8 @@ class DistNeighborSampler:
     sig = ('sub', b, max_degree)
     if sig not in self._fns:
       self._fns[sig] = self._build_subgraph_fn(b, max_degree)
+    from ..utils.trace import record_dispatch
+    record_dispatch('dist_sample')
     res = self._fns[sig](jnp.asarray(seeds, jnp.int32),
                          jnp.asarray(smask), self._next_keys())
     return SamplerOutput(
@@ -1386,6 +1412,15 @@ class DistNeighborSampler:
              else out.node[:, :label_cap])
       y = self._label_dist(node_labels).get(buf)[..., 0]
     return x, y
+
+  def label_stores(self):
+    """The sharded label DistFeatures built by _label_dist — their
+    on-device [P, 4] stats accumulators carry the same int32 wrap
+    hazard as the dataset's feature stores, so the loaders drain them
+    per epoch alongside data.feature_stores()."""
+    if hasattr(self, '_labels_cache'):
+      for _, store in self._labels_cache.values():
+        yield store
 
   def _label_dist(self, labels, key=None):
     """Sharded label store, built once per distinct label array (keyed by

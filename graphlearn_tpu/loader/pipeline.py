@@ -39,6 +39,14 @@ _RECOMPUTE_MSG = (
     "for recompute, or overflow_policy='raise'/'warn' here (the flag "
     'accumulates on device and is checked once at epoch end).')
 
+_DIST_REMOTE_MSG = (
+    'scanned/fused distributed epochs are COLLOCATED-MESH only: pass a '
+    'DistNeighborLoader over the training mesh. Remote (server-client) '
+    'and mp-worker loaders keep the per-step host loop — their failover '
+    'acks need per-batch host visibility (docs/failure_model.md: a dead '
+    "server's unacked seeds are redistributed per batch; inside a "
+    'scanned chunk there is no per-batch host point to ack from).')
+
 
 class FusedEpochTrainer:
   """Shared plumbing for the fused epoch executors (OverlappedTrainer,
@@ -208,4 +216,303 @@ class OverlappedTrainer(FusedEpochTrainer):
       self.loader._ovf_accum = ovf
       if not truncated:
         self.loader._finish_epoch_overflow()
+    return state, losses
+
+
+class DistFusedEpochTrainer:
+  """Shared plumbing for the DISTRIBUTED fused-epoch executors
+  (scan_epoch.DistScanTrainer and its per-step reference loop): scope
+  validation, the data-parallel train-step body (per-shard grads ->
+  pmean over every mesh axis -> optax update), and the traced
+  sample+collate body both the scanned chunks and the per-step program
+  compose.
+
+  Scope: a COLLOCATED homogeneous or heterogeneous DistNeighborLoader
+  with feature collection and node labels (supervised node
+  classification on the mesh — the distributed counterpart of
+  FusedEpochTrainer's scope). Remote/mp loaders are rejected
+  (``_DIST_REMOTE_MSG``): their failover contract needs per-batch host
+  visibility. ``overflow_policy='recompute'`` is rejected exactly like
+  the local trainers (per-batch host sync).
+  """
+
+  _NAME = 'DistFusedEpochTrainer'
+
+  def __init__(self, loader, model, tx, num_classes: int,
+               seed_labels_only: Optional[bool] = None):
+    from ..distributed.dist_loader import (DistLinkNeighborLoader,
+                                           DistLoader, DistSubGraphLoader)
+    from ..models import train as train_lib
+    if not isinstance(loader, DistLoader):
+      raise ValueError(f'{self._NAME}: {type(loader).__name__} is not a '
+                       f'collocated DistLoader. {_DIST_REMOTE_MSG}')
+    if isinstance(loader, (DistLinkNeighborLoader, DistSubGraphLoader)):
+      raise ValueError(f'{self._NAME} covers supervised NODE '
+                       'classification; link/subgraph loaders keep the '
+                       'per-step loop')
+    if loader.overflow_policy == 'recompute':
+      raise ValueError(_RECOMPUTE_MSG)
+    sampler = loader.sampler
+    if sampler.with_edge:
+      raise ValueError('with_edge batches are not supported in the '
+                       'fused distributed epoch programs')
+    if getattr(loader.data, 'edge_features', None):
+      raise ValueError(f'{self._NAME} does not collate edge features; '
+                       'use the per-step loader loop')
+    if not loader.collect_features or sampler.dist_feature is None:
+      raise ValueError(f'{self._NAME} needs collect_features=True and a '
+                       'DistFeature store (the fused program inlines the '
+                       'cached miss-only lookup)')
+    if loader.data.node_labels is None:
+      raise ValueError(f'{self._NAME} needs node labels')
+    self.loader = loader
+    self.model = model
+    self.tx = tx
+    self.num_classes = num_classes
+    self._sampler = sampler
+    self.is_hetero = sampler.is_hetero
+    self.mesh = sampler.mesh
+    self._axes = sampler._axes
+    self._axis_sizes = sampler._axis_sizes
+    self._nparts = loader.num_partitions
+    self._batch_size = loader.batch_size    # per shard
+    if seed_labels_only is None:
+      seed_labels_only = loader.seed_labels_only
+    self._label_cap = self._batch_size if seed_labels_only else None
+    if self.is_hetero:
+      self._input_type = loader.input_type
+      assert self._input_type is not None, \
+          'hetero distributed training requires typed seeds'
+      labels = loader.data.node_labels
+      if not isinstance(labels, dict) or self._input_type not in labels:
+        raise ValueError(f'{self._NAME} needs labels for the seed type '
+                         f'{self._input_type!r}')
+      self._label_store = sampler._label_dist(labels[self._input_type],
+                                              self._input_type)
+      self._feat = dict(sampler.dist_feature)
+    else:
+      self._input_type = None
+      self._label_store = sampler._label_dist(loader.data.node_labels)
+      self._feat = sampler.dist_feature
+    self._loss_fn = train_lib.make_loss_fn(model, num_classes)
+    self._train_state_cls = train_lib.TrainState
+    self._step_fn = None   # built lazily (first per-step train_step)
+
+  # -------------------------------------------------------- traced bodies
+
+  def _dp_step_body(self, state, batch):
+    """Per-shard data-parallel update (traced): grads/loss/acc pmean'd
+    over EVERY mesh axis — the SPMD analog of the reference's DDP
+    allreduce — then one optax update of the replicated state."""
+    import jax
+    (loss, acc), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+        state.params, batch)
+    grads = jax.lax.pmean(grads, self._axes)
+    loss = jax.lax.pmean(loss, self._axes)
+    acc = jax.lax.pmean(acc, self._axes)
+    updates, opt_state = self.tx.update(grads, state.opt_state,
+                                        state.params)
+    import optax
+    params = optax.apply_updates(state.params, updates)
+    return self._train_state_cls(params, opt_state, state.step + 1), \
+        loss, acc
+
+  def _make_sample_collate(self):
+    """Traced per-shard sample -> feature/label collate body shared by
+    the scanned chunks (scan_epoch.DistScanTrainer) — the in-program
+    equivalent of loader.__iter__'s sample_from_nodes + _collate_fn
+    path, threading the feature-cache stats rows instead of the
+    device-resident accumulator.
+
+    Returns ``(shard_tree, repl_tree, body)`` where ``body(views, repl,
+    stats_rows, seeds, smask, key) -> (batch, overflow,
+    new_stats_rows)``; ``views`` is the per-shard ([0]-indexed) view of
+    ``shard_tree`` and the trees are the device arrays to feed the
+    enclosing shard_map (every ``shard_tree`` leaf takes spec P(axes),
+    every ``repl_tree`` leaf P())."""
+    import jax.numpy as jnp
+    sampler = self._sampler
+    b = self._batch_size
+    label_cap = self._label_cap
+    if self.is_hetero:
+      return self._make_hetero_sample_collate()
+    from ..distributed.dist_neighbor_sampler import _homo_hop_loop
+    fanouts = tuple(sampler.num_neighbors)
+    caps = sampler._capacities(b)
+    node_cap = sampler._node_cap(caps)
+    dedup = sampler.dedup
+    weighted = sampler._weighted_for()
+    bucket_frac = sampler.bucket_frac
+    ax, sizes, nparts = self._axes, self._axis_sizes, self._nparts
+    feat_body = self._feat._shard_body(node_cap)
+    lab_body = self._label_store._shard_body(
+        label_cap if label_cap is not None else node_cap)
+    d = sampler._dev
+    gsh = {k: d[k] for k in ('row_ids', 'indptr', 'indices', 'eids')}
+    if weighted:
+      gsh['wcum'] = d['wcum']
+    fdev = self._feat.device_arrays()
+    ldev = self._label_store.device_arrays()
+    shard_tree = dict(
+        g=gsh,
+        f={k: fdev[k] for k in ('feat_ids', 'feats')},
+        l={k: ldev[k] for k in ('feat_ids', 'feats')})
+    repl_tree = dict(
+        pb=d['node_pb'],
+        f={k: fdev[k] for k in ('feature_pb', 'cache_ids',
+                                'cache_feats')},
+        l={k: ldev[k] for k in ('feature_pb', 'cache_ids',
+                                'cache_feats')})
+
+    def body(views, repl, stats_rows, seeds, smask, key):
+      res = _homo_hop_loop(views['g'], repl['pb'], seeds, smask, key,
+                           fanouts, caps, node_cap, nparts, False,
+                           weighted, dedup=dedup,
+                           bucket_frac=bucket_frac, axes=ax,
+                           axis_sizes=sizes)
+      ids = res['node']
+      fv, frep = views['f'], repl['f']
+      x, srow = feat_body(fv['feat_ids'], fv['feats'],
+                          frep['feature_pb'], frep['cache_ids'],
+                          frep['cache_feats'], stats_rows, ids, ids >= 0)
+      lab_ids = ids[:label_cap] if label_cap is not None else ids
+      lv, lrep = views['l'], repl['l']
+      y, _ = lab_body(lv['feat_ids'], lv['feats'], lrep['feature_pb'],
+                      lrep['cache_ids'], lrep['cache_feats'],
+                      jnp.zeros((4,), jnp.int32), lab_ids, lab_ids >= 0)
+      batch = dict(x=x,
+                   edge_index=jnp.stack([res['row'], res['col']]),
+                   edge_mask=res['edge_mask'], y=y[:, 0],
+                   num_seed_nodes=res['num_sampled_nodes'][0])
+      return batch, res['overflow'], srow
+
+    return shard_tree, repl_tree, body
+
+  def _make_hetero_sample_collate(self):
+    """Typed counterpart of _make_sample_collate: the engine's typed
+    hop loop + per-ntype cached feature lookups (stats row per store) +
+    the seed type's label gather."""
+    import jax.numpy as jnp
+    sampler = self._sampler
+    b = self._batch_size
+    label_cap = self._label_cap
+    t_in = self._input_type
+    plan = sampler._hetero_plan({t_in: b})
+    _, _, node_caps = plan
+    feat_types = [t for t in sampler.graph.ntypes
+                  if node_caps.get(t, 0) > 0 and t in self._feat]
+    # the stores whose [4] stats rows thread the scan carry (one per
+    # sampled, feature-bearing ntype) — DistScanTrainer reads this to
+    # shape the carry and write the accumulators back per epoch
+    self._feat_types = feat_types
+    feat_bodies = {t: self._feat[t]._shard_body(node_caps[t])
+                   for t in feat_types}
+    lab_body = self._label_store._shard_body(
+        label_cap if label_cap is not None else node_caps[t_in])
+    d = sampler._dev
+    gsh = {}
+    for et in sampler.graph.etypes:
+      ga = d[et]
+      gsh[et] = {k: ga[k] for k in ('row_ids', 'indptr', 'indices',
+                                    'eids')}
+      if sampler._weighted_for(et):
+        gsh[et]['wcum'] = ga['wcum']
+    fdevs = {t: self._feat[t].device_arrays() for t in feat_types}
+    ldev = self._label_store.device_arrays()
+    shard_tree = dict(
+        g=gsh,
+        f={t: {k: fdevs[t][k] for k in ('feat_ids', 'feats')}
+           for t in feat_types},
+        l={k: ldev[k] for k in ('feat_ids', 'feats')})
+    repl_tree = dict(
+        pb=dict(d['#pb']),
+        f={t: {k: fdevs[t][k] for k in ('feature_pb', 'cache_ids',
+                                        'cache_feats')}
+           for t in feat_types},
+        l={k: ldev[k] for k in ('feature_pb', 'cache_ids',
+                                'cache_feats')})
+
+    def body(views, repl, stats_rows, seeds, smask, key):
+      res, _ = sampler._hetero_engine(views['g'], repl['pb'],
+                                      {t_in: (seeds, smask)}, key, plan)
+      x, new_rows = {}, {}
+      for t in feat_types:
+        ids = res['node'][t]
+        fv, frep = views['f'][t], repl['f'][t]
+        x[t], new_rows[t] = feat_bodies[t](
+            fv['feat_ids'], fv['feats'], frep['feature_pb'],
+            frep['cache_ids'], frep['cache_feats'], stats_rows[t], ids,
+            ids >= 0)
+      ids = res['node'][t_in]
+      lab_ids = ids[:label_cap] if label_cap is not None else ids
+      lv, lrep = views['l'], repl['l']
+      y, _ = lab_body(lv['feat_ids'], lv['feats'], lrep['feature_pb'],
+                      lrep['cache_ids'], lrep['cache_feats'],
+                      jnp.zeros((4,), jnp.int32), lab_ids, lab_ids >= 0)
+      ei = {et: jnp.stack([res['row'][et], res['col'][et]])
+            for et in res['row']}
+      batch = dict(x=x, edge_index=ei, edge_mask=res['edge_mask'],
+                   y=y[:, 0],
+                   num_seed_nodes=res['num_sampled_nodes'][t_in][0])
+      return batch, res['overflow'], new_rows
+
+    return shard_tree, repl_tree, body
+
+  # ------------------------------------------------- per-step reference
+
+  def _build_step_fn(self):
+    """The per-step data-parallel train program (ONE dispatch per
+    optimizer update): shard_map over the mesh, per-shard batch views,
+    pmean'd grads, replicated state in/out."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+    ax = self._axes
+    dp = self._dp_step_body
+
+    def body(state, x, ei, em, y, nseed):
+      view = lambda t: jax.tree.map(lambda a: a[0], t)
+      batch = dict(x=view(x), edge_index=view(ei), edge_mask=view(em),
+                   y=y[0], num_seed_nodes=nseed[0])
+      return dp(state, batch)
+
+    fn = shard_map(
+        body, mesh=self.mesh,
+        in_specs=(P(), P(ax), P(ax), P(ax), P(ax), P(ax)),
+        out_specs=(P(), P(), P()), check_replication=False)
+    return jax.jit(fn)
+
+  def train_step(self, state, batch):
+    """One data-parallel optimizer update from a collocated dist batch
+    (the loader's stacked Data/HeteroData). Returns
+    ``(state, loss, acc)`` — loss/acc replicated device scalars."""
+    import jax.numpy as jnp
+
+    from ..utils.trace import record_dispatch
+    if self._step_fn is None:
+      self._step_fn = self._build_step_fn()
+    if self.is_hetero:
+      y = batch.y[self._input_type]
+      nseed = jnp.asarray(batch.num_sampled_nodes[self._input_type])[:, 0]
+    else:
+      y = batch.y
+      nseed = jnp.asarray(batch.num_sampled_nodes)[:, 0]
+    record_dispatch('dist_train_step')
+    return self._step_fn(state, batch.x, batch.edge_index,
+                         batch.edge_mask, y, nseed)
+
+  def run_epoch_steps(self, state, max_steps: Optional[int] = None):
+    """The PER-STEP reference epoch: iterate the collocated loader
+    (sample + collate dispatches per batch) and apply the data-parallel
+    step per batch — the loop the scanned epoch must replay
+    bit-identically (shuffle=False) and the A/B baseline for the
+    dispatch-count story. Returns (state, losses) with ``losses`` a
+    list of replicated device scalars."""
+    losses = []
+    for batch in self.loader:
+      state, loss, _ = self.train_step(state, batch)
+      losses.append(loss)
+      if max_steps is not None and len(losses) >= max_steps:
+        break
     return state, losses

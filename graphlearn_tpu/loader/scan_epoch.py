@@ -49,6 +49,13 @@ Usage:
                             drop_last=True, ...)
     trainer = ScanTrainer(loader, model, tx, num_classes, chunk_size=32)
     state, losses, accs = trainer.run_epoch(state)   # arrays stay on device
+
+`DistScanTrainer` (below) is the DISTRIBUTED counterpart: the same
+epoch-as-a-program contract over the collocated mesh loop, with the
+scan body composing the sharded sampler's all_to_all hop engine, the
+cached miss-only feature exchange, and the pmean'd data-parallel train
+step inside ONE shard_map chunk program (PERF.md 'Scanned distributed
+epoch').
 """
 from typing import Optional
 
@@ -56,7 +63,8 @@ import numpy as np
 
 from ..utils.trace import record_dispatch
 from .node_loader import NodeLoader
-from .pipeline import _RECOMPUTE_MSG, FusedEpochTrainer
+from .pipeline import (_RECOMPUTE_MSG, DistFusedEpochTrainer,
+                       FusedEpochTrainer)
 
 
 class ScanTrainer(FusedEpochTrainer):
@@ -208,8 +216,10 @@ class ScanTrainer(FusedEpochTrainer):
     if self._seeds_dev is None:
       self._seeds_dev = jnp.asarray(
           np.asarray(self.loader.input_seeds, dtype=np.int32))
+    # _epochs advances only on SUCCESS (below, with _call_count): a
+    # failed epoch's re-run must redraw the SAME permutation, matching
+    # the un-advanced sampler key stream
     perm_key = jax.random.fold_in(self._perm_key, self._epochs)
-    self._epochs += 1
     record_dispatch('epoch_seeds')
     seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
                                        full_steps)
@@ -234,6 +244,7 @@ class ScanTrainer(FusedEpochTrainer):
     # keep the host fold_in stream aligned with what the device consumed
     # (checkpoint/resume and any later per-step sampling continue it)
     self._sampler._call_count += steps
+    self._epochs += 1
 
     if len(losses) > 1:
       record_dispatch('metrics_concat')
@@ -248,4 +259,309 @@ class ScanTrainer(FusedEpochTrainer):
       self.loader._ovf_accum = ovf
       if not truncated:
         self.loader._finish_epoch_overflow()
+    return state, losses, accs
+
+
+class DistScanTrainer(DistFusedEpochTrainer):
+  """Distributed epoch-as-a-program: one epoch of the COLLOCATED mesh
+  loop as ``ceil(steps/K) + 2`` dispatches.
+
+  The per-step distributed loop pays >= 2 program dispatches per batch
+  (sample program + collate, plus the feature/label gathers and the
+  train step) and a host numpy seed slice each step — on this rig's
+  remote-dispatch runtime the dominant wall-clock tax (PERF.md). Here
+  the scanned chunk is ONE jitted shard_map program whose ``lax.scan``
+  body composes, per shard and per step:
+
+    per-shard seed slice (dynamic_slice into the on-device [steps, B]
+    seed matrix) -> fold_in key replay (``split(fold_in(base, count),
+    P)[shard]`` — exactly DistNeighborSampler._keys_for, so a
+    shuffle=False scanned epoch replays the per-step loop's draws
+    BIT-IDENTICALLY) -> the sampler's multi-hop all_to_all exchange
+    (_homo_hop_loop / _hetero_engine) -> DistFeature's cached miss-only
+    lookup with the [4] stats row in the scan carry (publish_stats()
+    still fetches once per epoch) -> label gather -> the pmean'd
+    data-parallel train step. The calibrated-caps overflow flag
+    (already psum-replicated by the engine) ORs into the carry.
+
+  Collocated-mesh only: remote/server-client loaders keep the per-step
+  loop (their failover acks need per-batch host visibility —
+  docs/failure_model.md), and ``overflow_policy='recompute'`` is
+  rejected (per-batch host sync). On failover/restart the scan carry
+  and cache state are rebuilt — failover granularity is the CHUNK, not
+  the batch.
+
+  Args:
+    loader: collocated DistNeighborLoader (homo or hetero) with
+      feature collection and node labels.
+    chunk_size: K steps per scanned dispatch (the tail chunk compiles
+      once more at its own length).
+    perm_seed: base seed for the ON-DEVICE epoch permutation (default:
+      the loader's seed). The host loader's numpy shuffle stream is
+      left untouched; shuffle=False epochs replay the host order
+      exactly (arange + cyclic tail padding).
+
+  Usage:
+      trainer = DistScanTrainer(loader, model, tx, num_classes, K)
+      state, losses, accs = trainer.run_epoch(state)
+  """
+
+  _NAME = 'DistScanTrainer'
+
+  def __init__(self, loader, model, tx, num_classes: int,
+               chunk_size: int = 32,
+               seed_labels_only: Optional[bool] = None,
+               perm_seed: Optional[int] = None):
+    import jax
+    super().__init__(loader, model, tx, num_classes, seed_labels_only)
+    if chunk_size < 1:
+      raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
+    self.chunk_size = int(chunk_size)
+    if perm_seed is None:
+      perm_seed = loader.seed or 0
+    # tag the perm stream off fold_in(2**32 - 1): the sampler's step
+    # keys are split(fold_in(PRNGKey(seed), count >= 1), P) on the SAME
+    # default seed — the tag sits where no step counter can ever land
+    self._perm_key = jax.random.fold_in(jax.random.PRNGKey(perm_seed),
+                                        0xFFFFFFFF)
+    self._epochs = 0        # folds into the perm key: fresh shuffle/epoch
+    self._seeds_dev = None  # input seeds, uploaded once
+    self._shard_tree, self._repl_tree, self._sc_body = \
+        self._make_sample_collate()
+    self._seed_fn = self._build_seed_fn()
+    self._chunk_fns = {}    # k (static chunk length) -> program
+    self._concat_fn = self._build_concat_fn()
+
+  # ------------------------------------------------------------- programs
+
+  def _build_seed_fn(self):
+    """ONE program for the epoch prologue: permutation draw + seed
+    gather + [P, steps, B] reshape + ragged-tail validity mask.
+    Replays DistLoader._index_blocks exactly for shuffle=False: blocks
+    are row-major [steps, P, B] slices of the epoch order, and the
+    short final block is padded by CYCLING the order (np.resize) with
+    the pad slots masked invalid."""
+    import jax
+    import jax.numpy as jnp
+    batch = self._batch_size
+    nparts = self._nparts
+    shuffle = self.loader.shuffle
+
+    def epoch_seeds(seeds, key, steps):
+      n = seeds.shape[0]
+      order = (jax.random.permutation(key, n) if shuffle
+               else jnp.arange(n, dtype=jnp.int32))
+      total = steps * nparts * batch
+      if total <= n:       # drop_last: the permutation's prefix
+        ext = order[:total]
+        maskf = jnp.ones((total,), bool)
+      else:                # ragged tail: cyclic pad, masked invalid
+        pad = order[jnp.arange(total - n, dtype=jnp.int32) % n]
+        ext = jnp.concatenate([order, pad])
+        maskf = jnp.arange(total) < n
+      seed_mat = seeds[ext].reshape(steps, nparts, batch)
+      mask_mat = maskf.reshape(steps, nparts, batch)
+      # leading axis = partition: the chunk program shards on dim 0
+      return (seed_mat.transpose(1, 0, 2),
+              mask_mat.transpose(1, 0, 2))
+
+    return jax.jit(epoch_seeds, static_argnums=(2,))
+
+  def _chunk_fn_for(self, k: int):
+    """The scanned K-step shard_map program (built per static chunk
+    length; the chunk position enters as a DEVICE scalar so every full
+    chunk reuses one executable). State and the overflow/stats carry
+    are donated — HBM stays flat across chunk dispatches."""
+    if k in self._chunk_fns:
+      return self._chunk_fns[k]
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+    ax = self._axes
+    mesh = self.mesh
+    nparts = self._nparts
+    sc_body = self._sc_body
+    dp = self._dp_step_body
+
+    def body(shard_tree, repl_tree, stats, params, opt_state, stepc,
+             ovf, seed_mat, mask_mat, base_key, count0, start):
+      views = jax.tree.map(lambda a: a[0], shard_tree)
+      stats_rows = jax.tree.map(lambda a: a[0], stats)
+      seeds_k = lax.dynamic_slice_in_dim(seed_mat[0], start, k, 0)
+      masks_k = lax.dynamic_slice_in_dim(mask_mat[0], start, k, 0)
+      # the sampler's fold_in stream: global step g -> count0 + g
+      counts_k = count0 + start + lax.iota(jnp.int32, k)
+      # this shard's linear partition index, row-major over the axis
+      # order — matches the [P, ...] leading-axis sharding and the
+      # per-step path's keys[p] selection
+      my = jnp.int32(0)
+      for a in ax:
+        my = my * mesh.shape[a] + lax.axis_index(a)
+
+      def step(carry, xs):
+        params, opt_state, stepc, ovf, srows = carry
+        seeds, smask, count = xs
+        keys = jax.random.split(jax.random.fold_in(base_key, count),
+                                nparts)
+        batch, overflow, srows = sc_body(views, repl_tree, srows, seeds,
+                                         smask, keys[my])
+        state, loss, acc = dp(
+            self._train_state_cls(params, opt_state, stepc), batch)
+        return (state.params, state.opt_state, state.step,
+                ovf | overflow, srows), (loss, acc)
+
+      (params, opt_state, stepc, ovf, srows), (losses, accs) = lax.scan(
+          step, (params, opt_state, stepc, ovf, stats_rows),
+          (seeds_k, masks_k, counts_k))
+      return (params, opt_state, stepc, ovf,
+              jax.tree.map(lambda a: a[None], srows), losses, accs)
+
+    sh = jax.tree.map(lambda _: P(ax), self._shard_tree)
+    rp = jax.tree.map(lambda _: P(), self._repl_tree)
+    stats_spec = (P(ax) if not self.is_hetero
+                  else {t: P(ax) for t in self._feat_types})
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(sh, rp, stats_spec, P(), P(), P(), P(), P(ax), P(ax),
+                  P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), stats_spec, P(), P()),
+        check_replication=False)
+    # donate the train state + the overflow/stats carries (args 3-6 +
+    # 2); the graph/feature tables and seed matrix are reused across
+    # chunks and must NOT be donated
+    jfn = jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6))
+    self._chunk_fns[k] = jfn
+    return jfn
+
+  def _build_concat_fn(self):
+    """One program concatenating the per-chunk [K] loss/acc outputs."""
+    import jax
+    import jax.numpy as jnp
+
+    def epoch_metrics_concat(losses, accs):
+      return jnp.concatenate(losses), jnp.concatenate(accs)
+
+    return jax.jit(epoch_metrics_concat)
+
+  # ----------------------------------------------------------------- epoch
+
+  def run_epoch(self, state, max_steps: Optional[int] = None):
+    """One scanned distributed epoch. Returns ``(state, losses, accs)``
+    with losses/accs [steps]-shaped replicated device arrays — fetch
+    once, after the epoch.
+
+    The input ``state`` is DONATED to the first chunk dispatch; train
+    on the returned state. ``max_steps`` truncates the epoch to exactly
+    that many optimizer updates (the permutation is still drawn for the
+    full epoch, so truncation never changes which seeds later steps
+    would have seen)."""
+    import jax
+    import jax.numpy as jnp
+    guarded, recompute = self.loader._overflow_epoch_start()
+    if recompute:   # unreachable after __init__'s check; kept for parity
+      raise ValueError(_RECOMPUTE_MSG)
+    full_steps = len(self.loader)
+    steps = full_steps
+    truncated = False
+    if max_steps is not None and max_steps < steps:
+      steps, truncated = max_steps, True
+    if steps <= 0:
+      # mirror the per-step loop's zero-batch epoch (DistLoader.__iter__
+      # closes the overflow guard and STILL publishes in its finally):
+      # the feature-stats accumulators a prior template iteration left
+      # on device must drain this epoch too, or they eventually wrap
+      empty = jnp.zeros((0,), jnp.float32)
+      try:
+        if guarded and not truncated:
+          self.loader._finish_epoch_overflow()
+      finally:
+        self.loader._publish_feature_stats()
+      return state, empty, empty
+
+    if self._seeds_dev is None:
+      self._seeds_dev = jnp.asarray(
+          np.asarray(self.loader.input_seeds, dtype=np.int32))
+    # _epochs advances only on SUCCESS (below, with _call_count): a
+    # failed epoch's re-run must redraw the SAME permutation or the
+    # chunk-granularity failover story (docs/failure_model.md) can't
+    # reproduce the completed chunks' seed matrix
+    perm_key = jax.random.fold_in(self._perm_key, self._epochs)
+    record_dispatch('dist_epoch_seeds')
+    seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
+                                       full_steps)
+
+    base_key = self._sampler._key
+    count0 = np.int32(self._sampler._call_count + 1)
+    stats = ({t: self._feat[t]._stats_dev() for t in self._feat_types}
+             if self.is_hetero else self._feat._stats_dev())
+    # commit the replicated carry leaves explicitly: a fresh (host /
+    # single-device) state and the chunk program's replicated outputs
+    # must present the SAME sharding signature, or every epoch's first
+    # chunk retraces (sharding is part of the jit cache key)
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(self.mesh, PartitionSpec())
+    params, opt_state, stepc, ovf = jax.device_put(
+        (state.params, state.opt_state, state.step,
+         jnp.zeros((), bool)), repl)
+
+    def stats_back(tree):
+      # hand the carried accumulators back to the stores AFTER EVERY
+      # chunk (not just at epoch end): each chunk DONATES its stats
+      # input, so the store must never be left referencing a deleted
+      # buffer — a mid-epoch stats() read, or a later publish after an
+      # aborted epoch, would otherwise raise 'Array has been deleted'
+      if self.is_hetero:
+        for t in self._feat_types:
+          self._feat[t]._stats = tree[t]
+      else:
+        self._feat._stats = tree
+
+    losses, accs = [], []
+    start = 0
+    try:
+      while start < steps:
+        k = min(self.chunk_size, steps - start)
+        record_dispatch('dist_scan_chunk')
+        params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
+            self._chunk_fn_for(k)(
+                self._shard_tree, self._repl_tree, stats, params,
+                opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
+                count0, np.int32(start))
+        stats_back(stats)
+        losses.append(loss_k)
+        accs.append(acc_k)
+        start += k
+    except BaseException:
+      # the in-flight chunk's donated stats input is gone; drop the
+      # partial epoch's counts rather than leave a dead reference
+      stats_back({t: None for t in self._feat_types}
+                 if self.is_hetero else None)
+      raise
+    # keep the host fold_in stream aligned with what the device consumed
+    # (checkpoint/resume and any later per-step sampling continue it)
+    self._sampler._call_count += steps
+    self._epochs += 1
+
+    if len(losses) > 1:
+      record_dispatch('dist_metrics_concat')
+      losses, accs = self._concat_fn(losses, accs)
+    else:
+      losses, accs = losses[0], accs[0]
+
+    state = self._train_state_cls(params, opt_state, stepc)
+    try:
+      if guarded:
+        # same contract as the local trainers: natural epoch end
+        # applies overflow_policy; a max_steps break leaves the flag to
+        # loader.check_overflow()
+        self.loader._ovf_accum = ovf
+        if not truncated:
+          self.loader._finish_epoch_overflow()
+    finally:
+      # also when the overflow guard raises — the per-step loop's
+      # finally-publish contract (the accumulator must drain per epoch)
+      self.loader._publish_feature_stats()
     return state, losses, accs

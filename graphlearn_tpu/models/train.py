@@ -29,10 +29,14 @@ def create_train_state(model, rng, sample_batch, lr: float = 3e-3,
   return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
 
 
-def make_train_step(model, tx, num_classes: int):
-  """Build the jitted supervised step. The batch dict carries padded
-  x/edge_index/edge_mask/y plus num_seed_nodes (seed slots lead the node
-  list by inducer construction)."""
+def make_loss_fn(model, num_classes: int):
+  """Masked seed-slot cross-entropy ``(params, batch) -> (loss, acc)``
+  — ONE definition shared by the local jitted step and the distributed
+  per-step/scanned epoch programs (loader/pipeline.py), so the
+  scanned-vs-per-step bit-equivalence bar can never drift on the loss.
+  Works for homo batches (array x/edge_index/edge_mask) and hetero
+  batches (per-type dicts, seed-type logits/y) alike — the model owns
+  the signature."""
 
   def loss_fn(params, batch):
     logits = model.apply(params, batch['x'], batch['edge_index'],
@@ -52,6 +56,16 @@ def make_train_step(model, tx, num_classes: int):
     correct = (logits.argmax(-1) == y) & seed_mask
     acc = correct.sum() / jnp.maximum(seed_mask.sum(), 1)
     return loss, acc
+
+  return loss_fn
+
+
+def make_train_step(model, tx, num_classes: int):
+  """Build the jitted supervised step. The batch dict carries padded
+  x/edge_index/edge_mask/y plus num_seed_nodes (seed slots lead the node
+  list by inducer construction)."""
+
+  loss_fn = make_loss_fn(model, num_classes)
 
   @jax.jit
   def train_step(state: TrainState, batch):

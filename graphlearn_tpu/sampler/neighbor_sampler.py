@@ -718,31 +718,26 @@ class NeighborSampler(BaseSampler):
   def _padded_arrays(self):
     """Lazily built device-resident padded adjacency (homo).
 
-    HBM-mode graphs rebuild ON DEVICE (one edge-list sort + scatter,
-    ~0.5 s at products scale) — the host path cost ~90 s/epoch of
-    numpy + upload under the per-epoch reseed (round-4 matrix
-    finding). CPU-mode graphs keep the host builder.
+    EVERY graph mode rebuilds ON DEVICE (one edge-list sort + scatter
+    over the already-uploaded CSR, ~0.5 s at products scale): the host
+    builder cost ~90-101 s/epoch of numpy lexsort + [N, W] upload under
+    the per-epoch reseed at 2.45M nodes (round-4 matrix finding) —
+    which would dominate any SCANNED epoch using padded_window (the
+    whole epoch is ~ceil(steps/K) dispatches, so a 90 s host prologue
+    is the epoch). CPU-mode graphs upload indptr/indices through
+    _graph_arrays anyway, so the device path costs no extra transfer;
+    ops.build_padded_adjacency (host) remains for direct callers.
     """
     import jax
-    import jax.numpy as jnp
     g = self._get_graph()
     key = ('padded', id(g))
     if key not in self._garrs:
-      if getattr(g, 'mode', None) == 'HBM':
-        ga = self._graph_arrays()
-        tab, deg, epos = ops.build_padded_adjacency_device(
-            ga['indptr'], ga['indices'], self.padded_window,
-            jax.random.PRNGKey(self._padded_seed),
-            edge_pos=self.with_edge)
-        self._garrs[key] = dict(tab=tab, deg=deg, eptab=epos)
-      else:
-        tab, deg, epos = ops.build_padded_adjacency(
-            np.asarray(g.indptr), np.asarray(g.indices),
-            self.padded_window, seed=self._padded_seed,
-            edge_pos=self.with_edge)
-        self._garrs[key] = dict(
-            tab=jnp.asarray(tab), deg=jnp.asarray(deg),
-            eptab=(jnp.asarray(epos) if epos is not None else None))
+      ga = self._graph_arrays()
+      tab, deg, epos = ops.build_padded_adjacency_device(
+          ga['indptr'], ga['indices'], self.padded_window,
+          jax.random.PRNGKey(self._padded_seed),
+          edge_pos=self.with_edge)
+      self._garrs[key] = dict(tab=tab, deg=deg, eptab=epos)
     return self._garrs[key]
 
   def _block_arrays(self, etype=None):

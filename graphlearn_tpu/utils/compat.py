@@ -27,7 +27,21 @@ def _resolve_shard_map():
   return _shard_map_impl
 
 
-def shard_map(*args, **kwargs):
+def shard_map(*args, check_replication=None, **kwargs):
   """jax.shard_map on jax versions that export it, else the
-  jax.experimental.shard_map implementation (jax 0.4.x)."""
-  return _resolve_shard_map()(*args, **kwargs)
+  jax.experimental.shard_map implementation (jax 0.4.x).
+
+  ``check_replication`` (optional bool) resolves to the version's
+  replication-check keyword — ``check_vma`` on new jax, ``check_rep``
+  on 0.4.x. Programs whose replicated outputs come from collectives
+  inside ``lax.scan`` (the scanned-epoch trainers) pass False: the
+  static replication checker cannot see through the scan carry, while
+  the values are replicated by construction (every shard computes the
+  same pmean)."""
+  impl = _resolve_shard_map()
+  if check_replication is not None:
+    import inspect
+    params = inspect.signature(impl).parameters
+    key = 'check_vma' if 'check_vma' in params else 'check_rep'
+    kwargs[key] = check_replication
+  return impl(*args, **kwargs)

@@ -151,6 +151,12 @@ class DispatchCounter:
   def record(self, name: str = 'program'):
     self.counts[name] = self.counts.get(name, 0) + 1
 
+  def subtotal(self, prefix: str) -> int:
+    """Dispatches whose site name starts with ``prefix`` — the
+    dispatch-budget tests assert per-subsystem slices ('dist_' for the
+    distributed hot path) without being brittle to unrelated sites."""
+    return sum(v for k, v in self.counts.items() if k.startswith(prefix))
+
   def __repr__(self):
     return f'DispatchCounter(total={self.total}, counts={self.counts})'
 

@@ -211,7 +211,13 @@ def test_dist_sampler_bucket_frac_loss_free(bucket_frac):
       assert v in ((u + 1) % N, (u + 2) % N), (bucket_frac, u, v)
 
 
-@pytest.mark.parametrize('bucket_frac', [2.0, 0.25])
+@pytest.mark.parametrize('bucket_frac', [
+    # tier-1 keeps the 0.25 variant: it exercises BOTH the fractional
+    # DCN capacity and (on skewed hops) the replicated fallback; the
+    # 2.0 slack variant adds an 8-device hier compile for path
+    # coverage the 0.25 run and the slow hier scanned-epoch
+    # equivalence already provide (tier-1 wall-budget canary)
+    pytest.param(2.0, marks=pytest.mark.slow), 0.25])
 def test_dist_sampler_two_axis_mesh(bucket_frac):
   """The same sampling program runs on a 2-axis (slice, chip) mesh —
   the multi-slice layout: the hierarchical 2-stage exchange transposes
