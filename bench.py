@@ -325,6 +325,165 @@ def _error_record(stage: str, err: str) -> dict:
   }
 
 
+# ------------------------------------------------------------ key registry
+# The declared schema of the record main() prints (and _error_record's
+# failure shape). `python bench.py --validate BENCH_*.json` checks saved
+# records against it — a renamed or misspelled key otherwise silently
+# orphans the metric history the BENCH_r*.json trajectory exists to keep
+# (tests/test_analysis.py runs this over the checked-in files as a cheap
+# tier-1 gate). Add the registry entry IN THE SAME CHANGE as the
+# result[...] assignment.
+BENCH_KEY_REGISTRY = {
+    # headline sampling throughput
+    'backend': 'jax backend platform the run executed on',
+    'metric': 'headline metric name (sampled_edges_per_sec)',
+    'value': 'headline value, M edges/s (tree mode); null on failure',
+    'unit': 'headline unit string',
+    'vs_baseline': 'headline / GLT-CUDA A100 figure estimate',
+    'headline_semantics': 'which dedup semantics the headline measures',
+    'timing': "'device-trace' or 'dispatch-wall-fallback'",
+    'device_ms_per_batch': 'tree-mode device ms per batch',
+    'dispatch_ms_per_batch': 'dispatch wall ms per batch (sanity)',
+    'map_edges_per_sec_m': 'exact-dedup (merge) throughput',
+    'map_device_ms_per_batch': 'exact-dedup device ms per batch',
+    'padded16_edges_per_sec_m': 'padded-window W=16 throughput',
+    'padded16_device_ms_per_batch': 'padded-window device ms per batch',
+    'block_edges_per_sec_m': 'block-strategy throughput',
+    'block_device_ms_per_batch': 'block-strategy device ms per batch',
+    'map_calibrated_edges_per_sec_m': 'calibrated exact-dedup throughput',
+    'map_calibrated_device_ms_per_batch': 'calibrated exact device ms',
+    'map_calibrated_vs_baseline': 'calibrated exact / A100 figure',
+    'calibrated_caps': 'per-hop frontier caps the calibrated run used',
+    'sampled_edges_per_sec_per_chip_m': 'north-star per-chip (tree)',
+    'sampled_edges_per_sec_per_chip_exact_m': 'north-star per-chip (exact)',
+    # end-to-end train step + epoch projection
+    'train_step_ms_f32': 'e2e sample+collate+train ms, f32',
+    'train_step_ms_bf16': 'e2e ms, bf16 tree path',
+    'train_step_ms_exact_bf16': 'e2e ms, bf16 calibrated exact path',
+    'steps_per_epoch_products': 'ogbn-products full batches at 1024',
+    'epoch_time_s': 'north-star epoch seconds (reference semantics)',
+    'epoch_time_s_exact': 'alias of epoch_time_s (exact path)',
+    'epoch_time_s_tree': 'epoch seconds, relaxed tree path',
+    'epoch_time_semantics': 'which path epoch_time_s measures',
+    'epoch_time_basis': 'how the epoch figure is derived (honesty label)',
+    # MFU / FLOP accounting
+    'model_gflops_per_step_tree': 'analytic matmul GFLOPs/step, tree',
+    'model_gflops_per_step_exact': 'analytic matmul GFLOPs/step, exact',
+    'model_tflops_per_sec_bf16': 'achieved TFLOP/s, tree bf16',
+    'model_tflops_per_sec_exact_bf16': 'achieved TFLOP/s, exact bf16',
+    'mfu_pct_bf16': 'MFU % of v5e peak, tree bf16 (whole step)',
+    'mfu_pct_exact_bf16': 'MFU %, exact bf16 (whole step)',
+    'mfu_pct_train_program_bf16': 'MFU %, train program only',
+    'mfu_pct_train_program_exact_bf16': 'MFU %, exact train program only',
+    # scanned epoch (PR 1)
+    'epoch_dispatches': 'measured dispatches for the scanned bench epoch',
+    'epoch_dispatches_products_est': 'ceil(products_steps/K)+2 estimate',
+    'scan_epoch_steps': 'steps in the measured scanned epoch',
+    'scan_epoch_chunk': 'K (chunk size) of the measured scanned epoch',
+    'scan_epoch_wall_s': 'scanned epoch wall seconds',
+    'scan_epoch_device_trace_s': 'scanned epoch device-trace seconds',
+    'epoch_time_s_scanned': 'products-scale scanned epoch projection',
+    # scanned DISTRIBUTED epoch (PR 4)
+    'dist_epoch_dispatches': 'per-step collocated dist epoch dispatches',
+    'dist_epoch_wall_s': 'per-step collocated dist epoch wall seconds',
+    'dist_scan_epoch_dispatches': 'DistScanTrainer epoch dispatches',
+    'dist_scan_epoch_wall_s': 'DistScanTrainer epoch wall seconds',
+    'dist_scan_epoch_steps': 'steps in the measured dist scanned epoch',
+    'dist_scan_epoch_chunk': 'K of the measured dist scanned epoch',
+    'dist_scan_mesh_size': 'mesh size the dist A/B ran on',
+    'dist_scan_epoch_dispatch_reduction_x': 'per-step / scanned dispatches',
+    # feature-exchange volume (PR 3, analytic)
+    'feature_exchange_mb_per_batch': 'miss-only exchange MB/shard/batch',
+    'feature_exchange_mb_per_batch_fullwidth': 'full-width posture MB',
+    'feature_exchange_reduction_x': 'fullwidth / miss-only MB ratio',
+    'feature_exchange_config': 'P/width/F/bucket/split/wire of the figure',
+    # RUN_MEAN_IMPL decision pair (VERDICT r5)
+    'run_mean_impl_reshape_ms': 'e2e step ms with RUN_MEAN_IMPL=reshape',
+    'run_mean_impl_window_ms': 'e2e step ms with RUN_MEAN_IMPL=window',
+    # hetero train steps
+    'hetero_rgnn_step_ms_bf16': 'RGNN (sage) e2e step ms',
+    'hetero_rgnn_train_program_ms': 'RGNN train program device ms',
+    'hetero_rgat_step_ms_bf16': 'RGAT e2e step ms',
+    'hetero_rgat_train_program_ms': 'RGAT train program device ms',
+    'hetero_rgnn_ref_step_ms_bf16': 'RGNN at reference shape (5120x3)',
+    'hetero_rgnn_ref_train_program_ms': 'RGNN ref train program ms',
+    'hetero_rgat_ref_step_ms_bf16': 'RGAT at reference shape',
+    'hetero_rgat_ref_train_program_ms': 'RGAT ref train program ms',
+    'hetero_ref_config': 'reference-shape run configuration',
+    'hetero_ref_overflow': 'any ref-shape loader truncated (bool/null)',
+    # failure shapes (_error_record + per-section catches)
+    'error': 'whole-run failure: stage + message',
+    'config': 'bench graph config echoed on failure records',
+    'last_good_numbers': 'pointer to the last trusted figures',
+}
+# per-section failure keys: '<section>_error' for these section stems
+# (plus '<registered key>_error' for per-key isolation, e.g.
+# run_mean_impl_reshape_ms_error)
+BENCH_ERROR_SECTIONS = (
+    'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
+    'hetero_step', 'hetero_ref', 'feature_exchange',
+)
+
+
+def _known_bench_key(key: str) -> bool:
+  if key in BENCH_KEY_REGISTRY:
+    return True
+  if key.endswith('_error'):
+    stem = key[:-len('_error')]
+    return stem in BENCH_ERROR_SECTIONS or stem in BENCH_KEY_REGISTRY
+  return False
+
+
+def validate_bench_record(record) -> list:
+  """Problems (strings) with one parsed bench record; [] when clean."""
+  if not isinstance(record, dict):
+    return [f'record is {type(record).__name__}, expected a JSON object']
+  problems = []
+  for key in ('metric', 'value', 'unit', 'vs_baseline'):
+    if key not in record:
+      problems.append(f"missing required key '{key}' (the driver "
+                      'contract: every record carries the headline '
+                      'fields, null-valued on failure)')
+  for key in sorted(record):
+    if not _known_bench_key(key):
+      problems.append(f"unknown key '{key}' — not in BENCH_KEY_REGISTRY; "
+                      'register it (bench.py) in the same change that '
+                      'emits it, or fix the spelling')
+  return problems
+
+
+def validate_bench_files(paths) -> int:
+  """--validate entry: check saved BENCH_*.json records (raw bench
+  output, or the driver wrapper whose 'parsed' field holds it) against
+  BENCH_KEY_REGISTRY. Prints findings; returns a process exit code."""
+  import glob as _glob
+  import os
+  if not paths:
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(_glob.glob(os.path.join(here, 'BENCH_*.json')))
+  total = 0
+  for path in paths:
+    try:
+      with open(path) as fh:
+        data = json.load(fh)
+    except (OSError, ValueError) as e:
+      print(f'{path}: unreadable: {e}')
+      total += 1
+      continue
+    record = data.get('parsed', data) if isinstance(data, dict) else data
+    if record is None:
+      # a driver wrapper whose run produced no parseable line: nothing
+      # to schema-check (rc/tail carry the failure story)
+      print(f'{path}: no parsed record (skipped)')
+      continue
+    problems = validate_bench_record(record)
+    for p in problems:
+      print(f'{path}: {p}')
+    total += len(problems)
+  print(f'bench --validate: {total} problem(s) in {len(paths)} file(s)')
+  return 1 if total else 0
+
+
 def _relay_ports() -> tuple:
   """Probed relay ports; GLT_BENCH_RELAY_PORTS overrides (tests force
   the down path with it). Malformed tokens are ignored — a bad override
@@ -824,6 +983,11 @@ def main():
 
 if __name__ == '__main__':
   import os
+  import sys
+  if '--validate' in sys.argv[1:]:
+    # schema check only: no jax, no device, no axon probe
+    args = [a for a in sys.argv[1:] if a != '--validate']
+    sys.exit(validate_bench_files(args))
   try:
     if os.environ.get('PALLAS_AXON_POOL_IPS') and not _axon_relay_up():
       # clearly down: fail fast with a parseable record instead of

@@ -1,0 +1,34 @@
+"""graftlint: AST-level static analysis for this package's hot-path
+invariants.
+
+The perf story of the scanned-epoch / distributed hot paths rests on
+contracts that no runtime test can cheaply enforce — zero implicit
+device->host syncs inside traced program bodies, counter-addressed
+(never split-and-carry) PRNG keys so scan replay stays bit-identical,
+dispatch instrumentation on every jitted entrypoint so the
+``epoch_dispatches`` budgets mean anything, ``shard_map`` resolved only
+through ``utils/compat.py``, and a closed registry of documented fault
+points. graftlint checks them at the AST level, with line-level
+``# graftlint: allow[<rule>] <reason>`` pragmas and a checked-in
+baseline for intentional exceptions.
+
+CLI::
+
+    python -m graphlearn_tpu.analysis.lint graphlearn_tpu/
+
+Rules (see docs/static_analysis.md):
+
+    host-sync                 host round-trips inside traced code
+    prng-discipline           split-and-carry / key reuse in samplers
+    dispatch-instrumentation  un-instrumented jit dispatch sites
+    compat-shard-map          shard_map imported outside utils/compat
+    fault-point-coverage      unregistered / undocumented fault sites
+
+This package deliberately imports neither jax nor the rest of
+graphlearn_tpu at analysis time — everything is pure ``ast`` over
+source text, so the linter runs anywhere Python runs.
+"""
+from .core import Config, Finding, load_baseline, run_lint, write_baseline
+
+__all__ = ['Config', 'Finding', 'run_lint', 'load_baseline',
+           'write_baseline']

@@ -1,0 +1,372 @@
+"""Shared AST machinery for graftlint checkers.
+
+Everything here is best-effort, per-module, name-based dataflow — the
+goal is catching the regressions this codebase's conventions make
+likely, not soundness. Where resolution fails we err on the quiet side
+(a missed edge), and the conventions themselves (nested defs are traced
+program bodies; builders return their jitted programs) close most of
+the gap. docs/static_analysis.md spells out the approximations.
+"""
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# jax transforms whose callable argument is traced
+TRACING_CALLS = {
+    'jax.jit', 'jit',
+    'jax.vmap', 'vmap', 'jax.pmap', 'pmap',
+    'jax.grad', 'grad', 'jax.value_and_grad', 'value_and_grad',
+    'jax.checkpoint', 'jax.remat',
+    'lax.scan', 'jax.lax.scan', 'lax.cond', 'jax.lax.cond',
+    'lax.while_loop', 'jax.lax.while_loop',
+    'lax.fori_loop', 'jax.lax.fori_loop', 'lax.switch', 'jax.lax.switch',
+    'lax.map', 'jax.lax.map', 'lax.associative_scan',
+    'shard_map',   # the compat wrapper (direct jax use is its own rule)
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+  """'jax.random.split' for Attribute/Name chains, else None."""
+  parts = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name):
+    parts.append(node.id)
+    return '.'.join(reversed(parts))
+  return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+  return dotted_name(call.func)
+
+
+def last_segment(name: Optional[str]) -> Optional[str]:
+  return name.rsplit('.', 1)[-1] if name else None
+
+
+def matches(name: Optional[str], targets) -> bool:
+  """Dotted-name match, exact or by trailing segments ('random.split'
+  matches 'jax.random.split'). A BARE name only matches exactly —
+  otherwise the builtin ``map`` (or a local ``cond``/``scan`` helper)
+  would match 'lax.map' and mint false tracing roots; bare forms that
+  should match are listed explicitly in TRACING_CALLS."""
+  if not name:
+    return False
+  for t in targets:
+    if name == t or name.endswith('.' + t) or \
+        ('.' in name and t.endswith('.' + name)):
+      return True
+  return False
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+  """name -> canonical dotted path, from this module's imports.
+  Relative imports keep their trailing module path ('..utils.compat'
+  -> 'utils.compat'), enough for suffix matching."""
+  out: Dict[str, str] = {}
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Import):
+      for a in node.names:
+        if a.asname:
+          out[a.asname] = a.name
+    elif isinstance(node, ast.ImportFrom):
+      base = (node.module or '').lstrip('.')
+      for a in node.names:
+        full = f'{base}.{a.name}' if base else a.name
+        out[a.asname or a.name] = full
+  return out
+
+
+def canonical(name: Optional[str], aliases: Dict[str, str]) -> Optional[str]:
+  """Expand the leading alias segment: 'np.asarray' -> 'numpy.asarray'."""
+  if not name:
+    return None
+  head, _, rest = name.partition('.')
+  base = aliases.get(head, head)
+  return f'{base}.{rest}' if rest else base
+
+
+# ------------------------------------------------------------ function index
+
+class FuncInfo:
+  __slots__ = ('node', 'qualname', 'parent', 'nested', 'returned_defs',
+               'is_nested')
+
+  def __init__(self, node, qualname, parent):
+    self.node = node
+    self.qualname = qualname
+    self.parent = parent          # enclosing FuncInfo or None
+    self.nested: List['FuncInfo'] = []
+    self.returned_defs: Set[str] = set()   # qualnames this fn may return
+    self.is_nested = parent is not None
+
+
+class FuncIndex:
+  """All function defs in a module, with name->defs lookup and which
+  nested defs each def may return (builders returning program bodies)."""
+
+  def __init__(self, tree: ast.AST):
+    self.by_qual: Dict[str, FuncInfo] = {}
+    self.by_name: Dict[str, List[FuncInfo]] = {}
+    self._walk(tree, None, '')
+    for fi in self.by_qual.values():
+      fi.returned_defs = self._returned_defs(fi)
+
+  def _walk(self, node, parent: Optional[FuncInfo], prefix: str):
+    for child in ast.iter_child_nodes(node):
+      if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        qual = f'{prefix}{child.name}'
+        # a def whose immediate container is a class is a method, not a
+        # traced closure of `parent`
+        method = isinstance(node, ast.ClassDef)
+        fi = FuncInfo(child, qual, None if method else parent)
+        self.by_qual[qual] = fi
+        self.by_name.setdefault(child.name, []).append(fi)
+        if fi.parent is not None:
+          fi.parent.nested.append(fi)
+        self._walk(child, fi, qual + '.')
+      elif isinstance(child, ast.ClassDef):
+        self._walk(child, None, f'{prefix}{child.name}.')
+      else:
+        # defs under if/try/with keep the same enclosing function
+        self._walk(child, parent, prefix)
+
+  def _returned_defs(self, fi: FuncInfo) -> Set[str]:
+    local_defs = {n.node.name: n.qualname for n in fi.nested}
+    var_defs: Dict[str, str] = {}
+    for node in self.own_nodes(fi):
+      if isinstance(node, ast.Assign) and isinstance(node.value, ast.Name):
+        if node.value.id in local_defs:
+          for t in node.targets:
+            if isinstance(t, ast.Name):
+              var_defs[t.id] = local_defs[node.value.id]
+    out: Set[str] = set()
+
+    def resolve(expr):
+      if isinstance(expr, ast.Name):
+        q = local_defs.get(expr.id) or var_defs.get(expr.id)
+        if q:
+          out.add(q)
+      elif isinstance(expr, ast.Tuple):
+        for e in expr.elts:
+          resolve(e)
+
+    for node in self.own_nodes(fi):
+      if isinstance(node, ast.Return) and node.value is not None:
+        resolve(node.value)
+    return out
+
+  def own_nodes(self, fi: FuncInfo) -> Iterator[ast.AST]:
+    """Nodes of ``fi`` excluding nested function bodies."""
+    stack = list(ast.iter_child_nodes(fi.node))
+    while stack:
+      n = stack.pop()
+      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)):
+        continue
+      yield n
+      stack.extend(ast.iter_child_nodes(n))
+
+  def lookup(self, node: ast.AST) -> Optional[FuncInfo]:
+    for fi in self.by_name.get(getattr(node, 'name', ''), []):
+      if fi.node is node:
+        return fi
+    return None
+
+
+# --------------------------------------------------------------- bindings
+
+def local_bindings(index: FuncIndex,
+                   fi: FuncInfo) -> Dict[str, Tuple[str, str]]:
+  """name -> (kind, target) for assignments visible in ``fi``'s scope
+  chain. kind 'ref': `x = self._foo` / `x = foo` — calling x calls
+  target. kind 'result': `x = self._foo(...)` — calling x calls what
+  target RETURNS. Inner scopes shadow outer ones."""
+  out: Dict[str, Tuple[str, str]] = {}
+  chain = []
+  f = fi
+  while f is not None:
+    chain.append(f)
+    f = f.parent
+  for f in reversed(chain):
+    for node in index.own_nodes(f):
+      if not isinstance(node, ast.Assign):
+        continue
+      src = node.value
+      entry = None
+      if isinstance(src, ast.Call):
+        seg = last_segment(call_name(src))
+        if seg:
+          entry = ('result', seg)
+      elif isinstance(src, (ast.Attribute, ast.Name)):
+        seg = last_segment(dotted_name(src))
+        if seg:
+          entry = ('ref', seg)
+      if entry is None:
+        continue
+      for t in node.targets:
+        if isinstance(t, ast.Name):
+          out[t.id] = entry
+  return out
+
+
+# --------------------------------------------------------------- traced set
+
+def traced_functions(index: FuncIndex, tree: ast.AST,
+                     aliases: Dict[str, str]) -> Set[str]:
+  """Qualnames of functions whose bodies run under tracing.
+
+  Seeds: callables handed to jax transforms (jit/scan/shard_map/...,
+  call or decorator form) plus NESTED defs — in this codebase a closure
+  inside a program builder is, by convention, a traced program body.
+  Host-side closures are excluded when recognizable: a nested def that
+  records dispatches or calls through a name bound to a jax.jit result
+  is a host dispatch wrapper, not a traced body.
+
+  Closure: a def referenced inside a traced function is traced, and a
+  call through a 'result' binding traces the bound builder's RETURNED
+  defs (`core = self._shard_body(b)` => _shard_body's nested `body`)."""
+  traced: Set[str] = set()
+  pending: List[FuncInfo] = []
+
+  def mark(fi: Optional[FuncInfo]):
+    if fi is not None and fi.qualname not in traced:
+      traced.add(fi.qualname)
+      pending.append(fi)
+
+  def mark_name(name: Optional[str]):
+    for fi in index.by_name.get(name or '', []):
+      mark(fi)
+
+  # decorator roots: @jax.jit / @functools.partial(jax.jit, ...)
+  for fi in index.by_qual.values():
+    for dec in fi.node.decorator_list:
+      if isinstance(dec, ast.Call):
+        name = canonical(call_name(dec), aliases)
+        if matches(name, {'functools.partial', 'partial'}) and dec.args:
+          name = canonical(dotted_name(dec.args[0]), aliases)
+      else:
+        name = canonical(dotted_name(dec), aliases)
+      if matches(name, TRACING_CALLS):
+        mark(fi)
+
+  # call-argument roots: jax.jit(f) / lax.scan(body, ...) / partial forms
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.Call):
+      continue
+    name = canonical(call_name(node), aliases)
+    target = None
+    if matches(name, TRACING_CALLS) and node.args:
+      target = node.args[0]
+    elif matches(name, {'functools.partial', 'partial'}) and \
+        len(node.args) > 1:
+      inner = canonical(dotted_name(node.args[0]), aliases)
+      if matches(inner, TRACING_CALLS):
+        target = node.args[1]
+    if target is not None:
+      mark_name(last_segment(dotted_name(target)))
+
+  # nested-def convention, minus host dispatch wrappers
+  for fi in index.by_qual.values():
+    if fi.is_nested and not _is_host_wrapper(index, fi):
+      mark(fi)
+
+  while pending:
+    fi = pending.pop()
+    bindings = local_bindings(index, fi)
+    shadowed = _locally_bound_names(index, fi)
+    for node in index.own_nodes(fi):
+      name = None
+      is_bare = False
+      if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+        name, is_bare = node.id, True
+      elif isinstance(node, ast.Attribute):
+        name = node.attr
+      if not name:
+        continue
+      kind_target = bindings.get(name)
+      if kind_target is not None:
+        kind, seg = kind_target
+        if kind == 'ref':
+          mark_name(seg)
+        else:   # result-of-call: the builder's returned bodies run traced
+          for builder in index.by_name.get(seg, []):
+            for q in builder.returned_defs:
+              mark(index.by_qual.get(q))
+        continue
+      if is_bare and name in shadowed:
+        # a parameter / local variable shadows any same-named module
+        # function (e.g. a scan body's `stats` arg vs. a host-side
+        # `stats()` method) — loading it is not a function reference
+        continue
+      mark_name(name)
+  return traced
+
+
+def _locally_bound_names(index: FuncIndex, fi: FuncInfo) -> Set[str]:
+  """Names bound as data (params, assignment targets, loop/with/except
+  targets) anywhere in ``fi``'s enclosing-def chain. Nested function
+  defs are deliberately NOT included — referencing one by name IS a
+  traced-callable reference."""
+  out: Set[str] = set()
+  f = fi
+  while f is not None:
+    a = f.node.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs +
+                [a.vararg, a.kwarg]):
+      if arg is not None:
+        out.add(arg.arg)
+    for node in index.own_nodes(f):
+      if isinstance(node, ast.Name) and \
+          isinstance(node.ctx, (ast.Store, ast.Del)):
+        out.add(node.id)
+    f = f.parent
+  return out
+
+
+def _is_host_wrapper(index: FuncIndex, fi: FuncInfo) -> bool:
+  """A nested def that performs host-side dispatch bookkeeping."""
+  for node in index.own_nodes(fi):
+    if isinstance(node, ast.Call):
+      seg = last_segment(call_name(node))
+      if seg in ('record_dispatch', 'wrap_dispatch'):
+        return True
+      if seg and _binds_jit(index, fi, seg):
+        return True
+  return False
+
+
+def _binds_jit(index: FuncIndex, fi: FuncInfo, name: str) -> bool:
+  """True if ``name`` is bound to a jax.jit(...) result in fi's
+  enclosing def chain (the `jfn = jax.jit(fn)` ... `jfn(...)` shape)."""
+  f = fi.parent
+  while f is not None:
+    for node in index.own_nodes(f):
+      if isinstance(node, ast.Assign) and \
+          isinstance(node.value, ast.Call) and \
+          last_segment(call_name(node.value)) == 'jit':
+        for t in node.targets:
+          if isinstance(t, ast.Name) and t.id == name:
+            return True
+    f = f.parent
+  return False
+
+
+# ------------------------------------------------------------------ parents
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+  parents: Dict[ast.AST, ast.AST] = {}
+  for node in ast.walk(tree):
+    for child in ast.iter_child_nodes(node):
+      parents[child] = node
+  return parents
+
+
+def enclosing_function(index: FuncIndex, node: ast.AST,
+                       parents: Dict[ast.AST, ast.AST]) -> Optional[FuncInfo]:
+  n = parents.get(node)
+  while n is not None:
+    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      return index.lookup(n)
+    n = parents.get(n)
+  return None

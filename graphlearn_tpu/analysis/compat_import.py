@@ -1,0 +1,54 @@
+"""Rule compat-shard-map: shard_map resolves ONLY through utils/compat.
+
+``jax.shard_map`` is a moving target across the jax versions this
+package must run on (top-level export on the TPU rig's jax, the
+``jax.experimental.shard_map`` module on the 0.4.x CI images, and the
+``check_rep``/``check_vma`` keyword rename between them).
+``utils/compat.py`` owns that resolution; a direct import anywhere else
+reintroduces exactly the ~40-collection-failure class of breakage PR 3
+fixed, invisible until the code runs on the other jax.
+"""
+import ast
+from typing import List
+
+from . import astutil
+from .core import Config, Finding, ParsedModule
+
+RULE = 'compat-shard-map'
+
+_MSG = ('direct {what} — shard_map must resolve through '
+        'utils/compat.py (version shim: top-level vs experimental home, '
+        'check_rep/check_vma rename); import '
+        '`from ..utils.compat import shard_map` instead')
+
+
+def check_package(modules: List[ParsedModule], config: Config):
+  out: List[Finding] = []
+  for mod in modules:
+    if mod.relpath == config.compat_module:
+      continue
+    for node in ast.walk(mod.tree):
+      what = None
+      if isinstance(node, ast.Import):
+        for a in node.names:
+          if a.name.startswith('jax.experimental.shard_map'):
+            what = f'`import {a.name}`'
+      elif isinstance(node, ast.ImportFrom):
+        m = (node.module or '')
+        if m.startswith('jax.experimental.shard_map'):
+          what = f'`from {m} import ...`'
+        elif m == 'jax' and any(a.name == 'shard_map'
+                                for a in node.names):
+          what = '`from jax import shard_map`'
+        elif m == 'jax.experimental' and any(a.name == 'shard_map'
+                                             for a in node.names):
+          what = '`from jax.experimental import shard_map`'
+      elif isinstance(node, ast.Attribute):
+        dn = astutil.dotted_name(node)
+        if dn in ('jax.shard_map', 'jax.experimental.shard_map',
+                  'jax.experimental.shard_map.shard_map'):
+          what = f'use of `{dn}`'
+      if what:
+        out.append(Finding(RULE, mod.path, mod.relpath, node.lineno,
+                           node.col_offset + 1, _MSG.format(what=what)))
+  return out

@@ -1,0 +1,328 @@
+"""graftlint core: findings, pragmas, baseline, module loading, runner.
+
+Checker modules (host_sync, prng, dispatch, compat_import, fault_points)
+each expose ``RULE`` (the rule id) and ``check_package(modules, config)``
+returning findings over the whole parsed-module set — package-wide scope
+is the common case (fault-point uniqueness spans files), and per-file
+rules simply loop.
+
+Suppression layers, in order:
+
+  1. pragma — ``# graftlint: allow[<rule>] <reason>`` on the flagged
+     line (or on a line of its own directly above it) suppresses that
+     rule there. A reason is REQUIRED: an unexplained exception is
+     itself a finding (rule ``pragma``), as is an unknown rule name.
+  2. baseline — a checked-in JSON of finding fingerprints
+     (``graftlint.baseline.json``) for debt accepted at introduction.
+     Fingerprints hash (rule, relpath, stripped source line, occurrence
+     index), not line numbers, so unrelated edits don't churn it. The
+     shipped baseline is EMPTY and the tier-1 suite keeps it that way.
+"""
+import ast
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+  rule: str
+  path: str          # absolute file path
+  relpath: str       # package-relative (the scoping + fingerprint key)
+  line: int
+  col: int
+  message: str
+  symbol: str = ''   # enclosing function qualname, when known
+
+  def location(self) -> str:
+    return f'{self.relpath}:{self.line}'
+
+  def render(self) -> str:
+    sym = f' [{self.symbol}]' if self.symbol else ''
+    return f'{self.relpath}:{self.line}:{self.col}: {self.rule}: ' \
+           f'{self.message}{sym}'
+
+
+@dataclass
+class Config:
+  """Scoping knobs. Defaults encode THIS repo's hot-path contracts;
+  tests override them to point rules at fixture files.
+
+  Module patterns are package-relative posix paths: a pattern ending in
+  '/' is a directory prefix, '*' matches every module, anything else is
+  an exact file match.
+  """
+  # rule host-sync: modules whose traced code must be sync-free
+  hot_sync_modules: Tuple[str, ...] = (
+      'loader/scan_epoch.py', 'loader/pipeline.py',
+      'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
+      'ops/')
+  # rule dispatch-instrumentation: modules whose jit entrypoints must
+  # record dispatches (the dispatch-budget tests' instrumented surface)
+  dispatch_modules: Tuple[str, ...] = (
+      'loader/scan_epoch.py', 'loader/pipeline.py', 'loader/node_loader.py',
+      'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
+      'distributed/dist_loader.py', 'sampler/neighbor_sampler.py',
+      'data/unified_tensor.py')
+  # cross-module jit factories the per-module dataflow can't see: calls
+  # to these names yield jitted callables (models/train.py builders)
+  known_jit_factories: Tuple[str, ...] = ('make_train_step',)
+  # rule prng-discipline: sampler/loader surfaces with replay contracts
+  prng_modules: Tuple[str, ...] = ('sampler/', 'loader/', 'distributed/')
+  # rule compat-shard-map: the one module allowed to touch jax shard_map
+  compat_module: str = 'utils/compat.py'
+  # rule fault-point-coverage inputs (package-relative / repo-relative)
+  fault_registry_module: str = 'utils/faults.py'
+  failure_doc: str = 'docs/failure_model.md'
+  # resolved at run time from the linted paths unless set explicitly
+  repo_root: Optional[str] = None
+
+
+@dataclass
+class ParsedModule:
+  path: str
+  relpath: str
+  source: str
+  lines: List[str]
+  tree: ast.AST
+  # line -> set of rule names a pragma allows there (after same-line +
+  # line-above expansion); '' entries mean a malformed pragma finding
+  pragmas: Dict[int, set] = field(default_factory=dict)
+  pragma_findings: List[Finding] = field(default_factory=list)
+
+
+def in_scope(relpath: str, patterns: Sequence[str]) -> bool:
+  for p in patterns:
+    if p == '*':
+      return True
+    if p.endswith('/') and relpath.startswith(p):
+      return True
+    if relpath == p:
+      return True
+  return False
+
+
+# ------------------------------------------------------------------ pragmas
+
+PRAGMA_RULES = ('host-sync', 'prng-discipline', 'dispatch-instrumentation',
+                'compat-shard-map', 'fault-point-coverage')
+_PRAGMA_MARK = 'graftlint:'
+
+
+def _pragma_comments(mod: ParsedModule):
+  """(lineno, comment_text, own_line) for comment TOKENS mentioning
+  graftlint. Tokenizing (not line-scanning) keeps pragma lookalikes in
+  docstrings — like the ones documenting the pragma itself — inert."""
+  import io
+  import tokenize
+  try:
+    tokens = tokenize.generate_tokens(io.StringIO(mod.source).readline)
+    for tok in tokens:
+      if tok.type == tokenize.COMMENT and _PRAGMA_MARK in tok.string:
+        own_line = mod.lines[tok.start[0] - 1].strip().startswith('#')
+        yield tok.start[0], tok.string, own_line
+  except tokenize.TokenError:
+    return
+
+
+def _parse_pragmas(mod: ParsedModule):
+  """Collect allow-pragmas per line; malformed ones become findings."""
+  import re
+  rx = re.compile(r'#\s*graftlint:\s*allow\[([^\]]*)\]\s*(.*)$')
+  for i, text, own_line in _pragma_comments(mod):
+    m = rx.search(text)
+    if not m:
+      mod.pragma_findings.append(Finding(
+          'pragma', mod.path, mod.relpath, i, 1,
+          "malformed graftlint pragma — expected '# graftlint: "
+          "allow[<rule>] <reason>'"))
+      continue
+    rules = {r.strip() for r in m.group(1).split(',') if r.strip()}
+    reason = m.group(2).strip()
+    bad = rules - set(PRAGMA_RULES)
+    if bad or not rules:
+      mod.pragma_findings.append(Finding(
+          'pragma', mod.path, mod.relpath, i, 1,
+          f'unknown rule(s) in pragma: {sorted(bad) or "(none)"} — '
+          f'valid rules: {", ".join(PRAGMA_RULES)}'))
+      continue
+    if not reason:
+      mod.pragma_findings.append(Finding(
+          'pragma', mod.path, mod.relpath, i, 1,
+          'graftlint pragma needs a reason after the closing bracket '
+          '(unexplained exceptions rot)'))
+      continue
+    targets = [i]
+    # a pragma on a comment-only line covers the next line
+    if own_line:
+      targets.append(i + 1)
+    for t in targets:
+      mod.pragmas.setdefault(t, set()).update(rules)
+
+
+def suppressed(mod: ParsedModule, finding: Finding) -> bool:
+  return finding.rule in mod.pragmas.get(finding.line, ())
+
+
+# ----------------------------------------------------------------- baseline
+
+BASELINE_NAME = 'graftlint.baseline.json'
+
+
+def fingerprint(f: Finding, lines: List[str], occurrence: int) -> str:
+  text = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ''
+  h = hashlib.sha1(
+      f'{f.rule}|{f.relpath}|{text}|{occurrence}'.encode()).hexdigest()
+  return h[:16]
+
+
+def fingerprints_for(findings: List[Finding],
+                     modules: Dict[str, ParsedModule]) -> List[str]:
+  """Stable fingerprints: occurrence index disambiguates identical
+  (rule, file, line-text) triples so two equal violations don't share
+  one baseline slot."""
+  seen: Dict[Tuple[str, str, str], int] = {}
+  out = []
+  for f in findings:
+    mod = modules.get(f.path)
+    lines = mod.lines if mod else []
+    text = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ''
+    key = (f.rule, f.relpath, text)
+    occ = seen.get(key, 0)
+    seen[key] = occ + 1
+    out.append(fingerprint(f, lines, occ))
+  return out
+
+
+def load_baseline(path: str) -> set:
+  if not os.path.exists(path):
+    return set()
+  with open(path) as fh:
+    data = json.load(fh)
+  if not isinstance(data, dict) or data.get('version') != 1:
+    raise ValueError(f'{path}: not a graftlint baseline (version 1)')
+  return set(data.get('fingerprints', []))
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   modules: Dict[str, ParsedModule]):
+  data = {'version': 1,
+          'fingerprints': sorted(fingerprints_for(findings, modules))}
+  with open(path, 'w') as fh:
+    json.dump(data, fh, indent=2, sort_keys=True)
+    fh.write('\n')
+
+
+# ------------------------------------------------------------ module loading
+
+def _package_relpath(path: str) -> str:
+  """Path relative to the file's topmost enclosing package (the highest
+  ancestor directory chain that carries __init__.py). Fixture files in
+  bare temp dirs fall back to their basename, which tests match with
+  exact-name patterns."""
+  path = os.path.abspath(path)
+  root = os.path.dirname(path)
+  top = None
+  d = root
+  while os.path.exists(os.path.join(d, '__init__.py')):
+    top = d
+    d = os.path.dirname(d)
+    if d == top:
+      break
+  base = os.path.dirname(top) if top else root
+  return os.path.relpath(path, base).replace(os.sep, '/').split('/', 1)[-1] \
+      if top else os.path.basename(path)
+
+
+def parse_module(path: str) -> Optional[ParsedModule]:
+  with open(path, encoding='utf-8') as fh:
+    source = fh.read()
+  try:
+    tree = ast.parse(source, filename=path)
+  except SyntaxError as e:
+    mod = ParsedModule(path, _package_relpath(path), source,
+                       source.splitlines(), ast.Module(body=[],
+                                                       type_ignores=[]))
+    mod.pragma_findings.append(Finding(
+        'syntax', mod.path, mod.relpath, e.lineno or 1, e.offset or 1,
+        f'file does not parse: {e.msg}'))
+    return mod
+  mod = ParsedModule(path, _package_relpath(path), source,
+                     source.splitlines(), tree)
+  _parse_pragmas(mod)
+  return mod
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+  out = []
+  for p in paths:
+    p = os.path.abspath(p)
+    if os.path.isdir(p):
+      for dirpath, dirnames, filenames in os.walk(p):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ('__pycache__', '.git', 'build'))
+        for fn in sorted(filenames):
+          if fn.endswith('.py'):
+            out.append(os.path.join(dirpath, fn))
+    elif p.endswith('.py'):
+      out.append(p)
+  return out
+
+
+# ------------------------------------------------------------------- runner
+
+def _checkers():
+  from . import compat_import, dispatch, fault_points, host_sync, prng
+  return (host_sync, prng, dispatch, compat_import, fault_points)
+
+
+def run_lint(paths: Sequence[str], config: Optional[Config] = None,
+             baseline: Optional[set] = None):
+  """Lint ``paths`` (files/dirs). Returns ``(findings, suppressed_count,
+  baselined_count, modules)`` where ``findings`` are the live (neither
+  pragma- nor baseline-suppressed) findings, sorted by location."""
+  config = config or Config()
+  files = collect_files(paths)
+  modules: Dict[str, ParsedModule] = {}
+  for f in files:
+    mod = parse_module(f)
+    if mod is not None:
+      modules[mod.path] = mod
+  if config.repo_root is None and files:
+    # the directory holding the topmost package: doc paths resolve here
+    pkg_file = files[0]
+    d = os.path.dirname(pkg_file)
+    while os.path.exists(os.path.join(d, '__init__.py')):
+      d = os.path.dirname(d)
+    config = replace(config, repo_root=d)
+
+  mods = list(modules.values())
+  raw: List[Finding] = []
+  for mod in mods:
+    raw.extend(mod.pragma_findings)
+  for checker in _checkers():
+    raw.extend(checker.check_package(mods, config))
+
+  live, n_pragma = [], 0
+  for f in raw:
+    mod = modules.get(f.path)
+    if mod is not None and suppressed(mod, f):
+      n_pragma += 1
+    else:
+      live.append(f)
+
+  n_base = 0
+  if baseline:
+    fps = fingerprints_for(live, modules)
+    kept = []
+    for f, fp in zip(live, fps):
+      if fp in baseline:
+        n_base += 1
+      else:
+        kept.append(f)
+    live = kept
+
+  live.sort(key=lambda f: (f.relpath, f.line, f.col, f.rule))
+  return live, n_pragma, n_base, modules

@@ -1,0 +1,88 @@
+"""Rule host-sync: no implicit device->host round-trips in traced code.
+
+The scanned-epoch programs (PR 1/4) exist to keep an entire epoch on
+device; ONE stray ``.item()`` / ``int(traced)`` / ``np.asarray(traced)``
+inside a function reachable from the jitted scan bodies either fails at
+trace time (the lucky case) or — via a concretization fallback or a
+forgotten eager path — silently reintroduces the per-step host sync the
+whole architecture removed (PERF.md: wall clock scales with dispatches
+and fetches, not device ms; PyTorch-Direct, arxiv 2101.07956, builds the
+same argument for GPU-centric access). This rule flags the sync surface
+inside traced functions of the hot modules.
+
+What counts as a sync call:
+
+  ``x.item()`` / ``x.tolist()`` / ``x.block_until_ready()``
+  ``int(x)`` / ``float(x)`` / ``bool(x)`` on a non-constant argument
+  ``jax.device_get(x)`` / ``np.asarray(x)`` / ``np.array(x)``
+
+Traced scope is computed per astutil.traced_functions (jit/scan/
+shard_map roots + the nested-def convention). Static host-side shape
+arithmetic on real constants is legitimate at trace time — suppress
+those with ``# graftlint: allow[host-sync] <why>``.
+"""
+import ast
+from typing import List
+
+from . import astutil
+from .core import Config, Finding, ParsedModule, in_scope
+
+RULE = 'host-sync'
+
+_ATTR_SYNCS = {'item', 'tolist', 'block_until_ready'}
+_CAST_SYNCS = {'int', 'float', 'bool'}
+_FUNC_SYNCS = {'jax.device_get', 'numpy.asarray', 'numpy.array'}
+
+
+def _is_const(node: ast.AST) -> bool:
+  return isinstance(node, ast.Constant)
+
+
+def check_package(modules: List[ParsedModule], config: Config):
+  findings = []
+  for mod in modules:
+    if not in_scope(mod.relpath, config.hot_sync_modules):
+      continue
+    findings.extend(_check_module(mod))
+  return findings
+
+
+def _check_module(mod: ParsedModule) -> List[Finding]:
+  index = astutil.FuncIndex(mod.tree)
+  aliases = astutil.import_aliases(mod.tree)
+  traced = astutil.traced_functions(index, mod.tree, aliases)
+  out: List[Finding] = []
+  for qual in sorted(traced):
+    fi = index.by_qual.get(qual)
+    if fi is None:
+      continue
+    for node in index.own_nodes(fi):
+      if not isinstance(node, ast.Call):
+        continue
+      msg = _sync_message(node, aliases)
+      if msg:
+        out.append(Finding(
+            RULE, mod.path, mod.relpath, node.lineno, node.col_offset + 1,
+            f'{msg} inside traced code — this forces a device->host '
+            'sync (or a per-call retrace) in a scanned/fused hot path; '
+            'keep the value on device, or hoist the host step out of '
+            'the program', symbol=qual))
+  return out
+
+
+def _sync_message(call: ast.Call, aliases) -> str:
+  func = call.func
+  if isinstance(func, ast.Attribute) and func.attr in _ATTR_SYNCS:
+    return f'.{func.attr}() call'
+  name = astutil.call_name(call)
+  if isinstance(func, ast.Name) and func.id in _CAST_SYNCS:
+    if call.args and not all(_is_const(a) for a in call.args):
+      return f'{func.id}() cast'
+    return ''
+  # EXACT canonical match only: 'jnp.asarray' canonicalizes to
+  # 'jax.numpy.asarray' (device-side, fine) and must not suffix-match
+  # 'numpy.asarray'
+  cname = astutil.canonical(name, aliases)
+  if cname in _FUNC_SYNCS:
+    return f'{name}() call'
+  return ''

@@ -1,0 +1,119 @@
+"""graftlint CLI.
+
+Usage::
+
+    python -m graphlearn_tpu.analysis.lint graphlearn_tpu/
+    python -m graphlearn_tpu.analysis.lint --write-baseline graphlearn_tpu/
+    python -m graphlearn_tpu.analysis.lint --list-rules
+
+Exit codes: 0 clean (after pragmas + baseline), 1 findings, 2 usage /
+internal error. The default baseline is ``graftlint.baseline.json``
+next to the linted package (kept EMPTY in this repo — the tier-1 suite
+enforces it; see docs/static_analysis.md for the debt workflow).
+"""
+import argparse
+import os
+import sys
+
+from .core import (PRAGMA_RULES, Config, load_baseline, run_lint,
+                   write_baseline)
+
+_RULE_DOCS = {
+    'host-sync':
+        'device->host sync calls (.item/.tolist/int()/float()/bool()/'
+        'np.asarray/jax.device_get/block_until_ready) reachable from '
+        'jitted scan/shard_map bodies in hot modules',
+    'prng-discipline':
+        'split-and-carry keys, constant keys in loops, and key reuse in '
+        'sampler/loader modules — the fold_in counter pattern is the '
+        'contract scan replay depends on',
+    'dispatch-instrumentation':
+        'jax.jit / jitted shard_map entrypoints dispatched without '
+        'record_dispatch/wrap_dispatch in hot modules',
+    'compat-shard-map':
+        'shard_map imported from jax directly instead of utils/compat.py',
+    'fault-point-coverage':
+        'fault_point sites must be literal, unique, in '
+        'utils/faults.py REGISTERED_SITES, and documented in '
+        'docs/failure_model.md',
+}
+
+
+def _default_baseline(paths):
+  for p in paths:
+    p = os.path.abspath(p)
+    d = p if os.path.isdir(p) else os.path.dirname(p)
+    cand = os.path.join(os.path.dirname(d.rstrip(os.sep)),
+                        'graftlint.baseline.json')
+    if os.path.exists(cand):
+      return cand
+    cand = os.path.join(d, 'graftlint.baseline.json')
+    if os.path.exists(cand):
+      return cand
+  return None
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(
+      prog='python -m graphlearn_tpu.analysis.lint',
+      description='graftlint: hot-path invariant checks for '
+                  'graphlearn_tpu (see docs/static_analysis.md)')
+  ap.add_argument('paths', nargs='*', help='files or directories to lint')
+  ap.add_argument('--baseline', default=None,
+                  help='baseline JSON (default: graftlint.baseline.json '
+                       'next to the linted package, when present)')
+  ap.add_argument('--no-baseline', action='store_true',
+                  help='ignore any baseline file')
+  ap.add_argument('--write-baseline', action='store_true',
+                  help='accept current findings into the baseline file')
+  ap.add_argument('--list-rules', action='store_true')
+  ap.add_argument('-q', '--quiet', action='store_true',
+                  help='summary line only')
+  args = ap.parse_args(argv)
+
+  if args.list_rules:
+    for rule in PRAGMA_RULES:
+      print(f'{rule}\n    {_RULE_DOCS[rule]}')
+    return 0
+  if not args.paths:
+    ap.print_usage(sys.stderr)
+    print('error: no paths given (try: graphlearn_tpu/)', file=sys.stderr)
+    return 2
+
+  baseline_path = args.baseline or _default_baseline(args.paths)
+  baseline = set()
+  if baseline_path and not args.no_baseline and not args.write_baseline:
+    try:
+      baseline = load_baseline(baseline_path)
+    except (ValueError, OSError) as e:
+      print(f'error: {e}', file=sys.stderr)
+      return 2
+
+  findings, n_pragma, n_base, modules = run_lint(args.paths, Config(),
+                                                 baseline)
+
+  if args.write_baseline:
+    path = baseline_path or os.path.join(
+        os.path.abspath(args.paths[0]), '..', 'graftlint.baseline.json')
+    path = os.path.normpath(path)
+    write_baseline(path, findings, modules)
+    print(f'wrote {len(findings)} fingerprint(s) to {path}')
+    return 0
+
+  if not args.quiet:
+    for f in findings:
+      print(f.render())
+  nfiles = len(modules)
+  extras = []
+  if n_pragma:
+    extras.append(f'{n_pragma} pragma-suppressed')
+  if n_base:
+    extras.append(f'{n_base} baselined')
+  extra = f' ({", ".join(extras)})' if extras else ''
+  print(f'graftlint: {len(findings)} finding(s) in {nfiles} file(s)'
+        f'{extra}')
+  return 1 if findings else 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,163 @@
+"""Rule prng-discipline: counter-addressed keys, never split-and-carry.
+
+The scanned-epoch replay contracts (PR 2 worker restart, PR 4 scanned
+chunks) depend on every sampler/loader PRNG stream being COUNTER
+ADDRESSED: step g's key is ``fold_in(base_key, count0 + g)`` (sharded:
+``split(fold_in(base, count), P)`` — DistNeighborSampler._keys_for), so
+any position in the stream is reachable from (base_key, integer) alone.
+Split-and-carry (``key, sub = split(key)``) makes position N reachable
+only by replaying N splits — a restarted worker or a scanned chunk
+cannot jump to its offset, and the bit-identical-replay guarantees in
+docs/failure_model.md silently break.
+
+Flags, in sampler/loader-scoped modules:
+
+  * split-and-carry: a ``jax.random.split`` result assigned back over
+    its own key argument (``key, sub = split(key)``,
+    ``self._key, s = split(self._key)``).
+  * constant-key loops: ``jax.random.PRNGKey(...)`` created inside a
+    ``for``/``while`` body — every iteration draws the same stream.
+  * key reuse: the same key name consumed by two jax.random draws with
+    no intervening reassignment — two identical draws where the author
+    almost certainly wanted two streams.
+"""
+import ast
+from typing import List
+
+from . import astutil
+from .core import Config, Finding, ParsedModule, in_scope
+
+RULE = 'prng-discipline'
+
+# draws that CONSUME a key (same key twice == same randomness twice);
+# fold_in is exempt — fold_in(key, a) / fold_in(key, b) IS the pattern
+_CONSUMERS = {
+    'split', 'bits', 'uniform', 'normal', 'randint', 'bernoulli',
+    'categorical', 'choice', 'permutation', 'gumbel', 'exponential',
+    'truncated_normal', 'shuffle',
+}
+
+
+def check_package(modules: List[ParsedModule], config: Config):
+  findings = []
+  for mod in modules:
+    if not in_scope(mod.relpath, config.prng_modules):
+      continue
+    findings.extend(_check_module(mod))
+  return findings
+
+
+def _key_expr(node: ast.AST) -> str:
+  """Comparable identity for a key expression: bare name or self.attr."""
+  if isinstance(node, ast.Name):
+    return node.id
+  name = astutil.dotted_name(node)
+  return name or ''
+
+
+def _is_random_call(call: ast.Call, attr: str) -> bool:
+  name = astutil.call_name(call)
+  seg = astutil.last_segment(name)
+  if seg != attr:
+    return False
+  # 'jax.random.split' / 'random.split' / bare 'split' (from-import)
+  return name in (attr, f'random.{attr}', f'jax.random.{attr}',
+                  f'jrandom.{attr}', f'jr.{attr}')
+
+
+def _check_module(mod: ParsedModule) -> List[Finding]:
+  out: List[Finding] = []
+  index = astutil.FuncIndex(mod.tree)
+  aliases = astutil.import_aliases(mod.tree)
+
+  # ---- split-and-carry ------------------------------------------------
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Assign):
+      continue
+    call = node.value
+    if not (isinstance(call, ast.Call) and _is_random_call(call, 'split')
+            and call.args):
+      continue
+    key_id = _key_expr(call.args[0])
+    if not key_id:
+      continue
+    targets = []
+    for t in node.targets:
+      targets.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+    for t in targets:
+      if _key_expr(t) == key_id:
+        out.append(Finding(
+            RULE, mod.path, mod.relpath, node.lineno, node.col_offset + 1,
+            f'split-and-carry: jax.random.split({key_id}) assigned back '
+            f'over {key_id} — stream position N is then only reachable '
+            'by N sequential splits, which breaks scan replay and '
+            'worker-restart fast-forward (docs/failure_model.md). Use '
+            'the counter pattern: fold_in(base_key, count) per call '
+            '(sharded: split(fold_in(base, count), P))'))
+        break
+
+  # ---- PRNGKey inside a loop ------------------------------------------
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, (ast.For, ast.While)):
+      continue
+    for sub in ast.walk(node):
+      if isinstance(sub, ast.Call) and _is_random_call(sub, 'PRNGKey'):
+        out.append(Finding(
+            RULE, mod.path, mod.relpath, sub.lineno, sub.col_offset + 1,
+            'jax.random.PRNGKey(...) constructed inside a loop — unless '
+            'the seed varies per iteration this redraws one stream; '
+            'hoist the base key and fold_in the loop counter'))
+
+  # ---- key reuse (per function, lexical) -------------------------------
+  for fi in index.by_qual.values():
+    uses = {}      # key name -> [linenos of consuming draws]
+    assigns = {}   # key name -> [linenos of reassignment]
+    for node in index.own_nodes(fi):
+      if isinstance(node, ast.Call):
+        seg = astutil.last_segment(astutil.call_name(node))
+        if seg in _CONSUMERS and node.args and \
+            isinstance(node.args[0], ast.Name) and \
+            _looks_like_random(node, aliases):
+          uses.setdefault(node.args[0].id, []).append(node.lineno)
+      for tgt in _assigned_names(node):
+        assigns.setdefault(tgt, []).append(node.lineno)
+    for key, lines in uses.items():
+      lines.sort()
+      re_lines = sorted(assigns.get(key, []))
+      for a, b in zip(lines, lines[1:]):
+        if not any(a < r <= b for r in re_lines):
+          out.append(Finding(
+              RULE, mod.path, mod.relpath, b, 1,
+              f'key reuse: {key!r} is consumed by two jax.random draws '
+              f'(lines {a} and {b}) with no reassignment between them — '
+              'identical randomness twice; derive one key per draw '
+              '(fold_in or split)', symbol=fi.qualname))
+  return out
+
+
+def _looks_like_random(call: ast.Call, aliases) -> bool:
+  """Only count draws that resolve to jax.random — NOT numpy's host RNG
+  (np.random.permutation twice on one array is the established loader
+  idiom, not key reuse) and not stdlib random."""
+  name = astutil.call_name(call) or ''
+  cname = astutil.canonical(name, aliases) or ''
+  if cname.startswith('jax.random.'):
+    return True
+  # unresolvable conventional jax.random aliases (jr/jrandom)
+  return name.split('.', 1)[0] in ('jr', 'jrandom')
+
+
+def _assigned_names(node: ast.AST):
+  if isinstance(node, ast.Assign):
+    for t in node.targets:
+      for e in (t.elts if isinstance(t, ast.Tuple) else [t]):
+        if isinstance(e, ast.Name):
+          yield e.id
+  elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+    if isinstance(node.target, ast.Name):
+      yield node.target.id
+  elif isinstance(node, ast.For):
+    t = node.target
+    for e in (t.elts if isinstance(t, ast.Tuple) else [t]):
+      if isinstance(e, ast.Name):
+        yield e.id

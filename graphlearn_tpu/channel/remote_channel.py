@@ -26,7 +26,7 @@ the loader's seed-level failover (unacked seeds are re-requested).
 """
 import queue
 import threading
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from .base import ChannelBase, QueueTimeoutError, SampleMessage
 

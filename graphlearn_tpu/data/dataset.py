@@ -13,9 +13,8 @@ from typing import Dict, Optional, Union
 import numpy as np
 
 from ..typing import EdgeType, NodeType
-from .feature import DeviceGroup, Feature
+from .feature import Feature
 from .graph import Graph, Topology
-from .reorder import sort_by_in_degree
 
 
 class Dataset:

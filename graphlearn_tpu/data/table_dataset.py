@@ -8,7 +8,6 @@ Alibaba-internal package not present here, so the ODPS path is gated; the
 same multi-reader ingestion shape is provided for local columnar files
 (.npy/.npz/.csv), which is the portable equivalent.
 """
-import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 

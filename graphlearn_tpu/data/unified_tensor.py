@@ -26,6 +26,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.trace import record_dispatch
+
 
 def _next_pow2(n: int) -> int:
   return 1 << max(0, (n - 1).bit_length())
@@ -152,10 +154,12 @@ class UnifiedTensor:
     fut = self._pool.submit(host_gather)
     hot_fn, scatter_fn = self._fns()
     hot_ids = jnp.asarray(np.where(is_hot, ids_np, 0))
+    record_dispatch('unified_tensor.hot_gather')
     out = hot_fn(self._device_part, hot_ids)   # async; overlaps host work
     pos = np.full((cold_cap,), b, np.int32)    # pad positions drop
     pos[:n_cold] = cold_pos
     cold_rows = jax.device_put(fut.result(), self._small_block_target())
+    record_dispatch('unified_tensor.cold_scatter')
     return scatter_fn(out, jnp.asarray(pos), cold_rows)
 
   use_pallas = False   # opt-in: device traces show XLA's take is faster

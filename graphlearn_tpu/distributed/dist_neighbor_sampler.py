@@ -108,8 +108,11 @@ def _exchange_hop_hier(garr, pb, frontier, fmask, k, key, sizes,
   mid_mask = mid >= 0
   mdest = jnp.where(mid_mask, pb[jnp.maximum(mid, 0)] // c_sz, s_sz)
   slot2, ok2f = ops.route_slots(mdest, mid_mask, capacity=c_sz * bf)
-  cap2 = (c_sz * bf if bucket_frac is None or s_sz <= 1 else
-          min(c_sz * bf, _round8(int(bucket_frac * bf / s_sz))))
+  if bucket_frac is None or s_sz <= 1:
+    cap2 = c_sz * bf
+  else:
+    # graftlint: allow[host-sync] trace-time shape arithmetic — bf is a static Python int (frontier.shape[0]), never a traced value
+    cap2 = min(c_sz * bf, _round8(int(bucket_frac * bf / s_sz)))
 
   def hier_path(_):
     ok2 = ok2f & (slot2 < cap2)

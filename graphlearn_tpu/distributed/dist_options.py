@@ -8,7 +8,7 @@ training step's mesh program — the default and fastest on TPU), mp
 seed prep/IO is the bottleneck), and remote (sampling on server processes,
 batches streamed to clients over DCN).
 """
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, Union
 
 

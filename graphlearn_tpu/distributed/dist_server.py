@@ -17,7 +17,7 @@ import numpy as np
 from ..channel import QueueTimeoutError, ShmChannel
 from ..sampler import NodeSamplerInput, SamplingConfig
 from ..utils.faults import fault_point
-from .dist_context import _set_server_context, get_context
+from .dist_context import _set_server_context
 from .dist_sampling_producer import DistMpSamplingProducer
 from .rpc import Barrier, RpcServer
 

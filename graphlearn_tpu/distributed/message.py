@@ -5,8 +5,6 @@ Counterpart of the reference's SampleMessage dict convention
 flat Dict[str, Tensor] with '#' control keys) used across channels and the
 server-client wire.
 """
-from typing import Optional
-
 import numpy as np
 
 from ..loader import Data

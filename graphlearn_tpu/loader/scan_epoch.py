@@ -61,6 +61,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.strict import strict_guards
 from ..utils.trace import record_dispatch
 from .node_loader import NodeLoader
 from .pipeline import (_RECOMPUTE_MSG, DistFusedEpochTrainer,
@@ -214,43 +215,49 @@ class ScanTrainer(FusedEpochTrainer):
       return state, empty, empty
 
     if self._seeds_dev is None:
-      self._seeds_dev = jnp.asarray(
+      self._seeds_dev = jax.device_put(
           np.asarray(self.loader.input_seeds, dtype=np.int32))
     # _epochs advances only on SUCCESS (below, with _call_count): a
     # failed epoch's re-run must redraw the SAME permutation, matching
     # the un-advanced sampler key stream
     perm_key = jax.random.fold_in(self._perm_key, self._epochs)
-    record_dispatch('epoch_seeds')
-    seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
-                                       full_steps)
 
     # graph arrays re-fetched each epoch: the padded-table reseed in
     # _begin_epoch must reach the chunks (lazy rebuild in _fused_args)
     fargs = self._sampler._fused_args()
     base_key = self._sampler._key
-    count0 = np.int32(self._sampler._call_count + 1)
-    ovf = jnp.zeros((), bool)
+    # chunk-position scalars enter as EXPLICIT device_puts: inside the
+    # strict_guards region (GLT_STRICT=1: transfer_guard('disallow') +
+    # checking_leaks) every implicit host->device transfer — a stray
+    # numpy arg, an eager op minting a constant — raises, so the epoch
+    # region provably contains nothing but all-device program dispatches
+    count0 = jax.device_put(np.int32(self._sampler._call_count + 1))
+    ovf = jax.device_put(np.zeros((), bool))
     losses, accs = [], []
     start = 0
-    while start < steps:
-      k = min(self.chunk_size, steps - start)
-      record_dispatch('scan_chunk')
-      state, ovf, loss_k, acc_k = self._chunk_fn(
-          state, ovf, fargs, self._feats, self._id2i, self._labels,
-          seed_mat, mask_mat, base_key, count0, np.int32(start), k)
-      losses.append(loss_k)
-      accs.append(acc_k)
-      start += k
+    with strict_guards():
+      record_dispatch('epoch_seeds')
+      seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
+                                         full_steps)
+      while start < steps:
+        k = min(self.chunk_size, steps - start)
+        record_dispatch('scan_chunk')
+        state, ovf, loss_k, acc_k = self._chunk_fn(
+            state, ovf, fargs, self._feats, self._id2i, self._labels,
+            seed_mat, mask_mat, base_key, count0,
+            jax.device_put(np.int32(start)), k)
+        losses.append(loss_k)
+        accs.append(acc_k)
+        start += k
+      if len(losses) > 1:
+        record_dispatch('metrics_concat')
+        losses, accs = self._concat_fn(losses, accs)
+      else:
+        losses, accs = losses[0], accs[0]
     # keep the host fold_in stream aligned with what the device consumed
     # (checkpoint/resume and any later per-step sampling continue it)
     self._sampler._call_count += steps
     self._epochs += 1
-
-    if len(losses) > 1:
-      record_dispatch('metrics_concat')
-      losses, accs = self._concat_fn(losses, accs)
-    else:
-      losses, accs = losses[0], accs[0]
 
     if guarded:
       # same contract as OverlappedTrainer: natural epoch end applies
@@ -340,12 +347,19 @@ class DistScanTrainer(DistFusedEpochTrainer):
     Replays DistLoader._index_blocks exactly for shuffle=False: blocks
     are row-major [steps, P, B] slices of the epoch order, and the
     short final block is padded by CYCLING the order (np.resize) with
-    the pad slots masked invalid."""
+    the pad slots masked invalid.
+
+    Outputs are committed to the chunk program's [P, ...] mesh sharding
+    HERE (out_shardings) — otherwise the matrices land on one device
+    and the first chunk dispatch pays a hidden device-to-device
+    reshard, which GLT_STRICT's transfer_guard('disallow') rejects."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
     batch = self._batch_size
     nparts = self._nparts
     shuffle = self.loader.shuffle
+    sharded = NamedSharding(self.mesh, P(self._axes))
 
     def epoch_seeds(seeds, key, steps):
       n = seeds.shape[0]
@@ -365,7 +379,8 @@ class DistScanTrainer(DistFusedEpochTrainer):
       return (seed_mat.transpose(1, 0, 2),
               mask_mat.transpose(1, 0, 2))
 
-    return jax.jit(epoch_seeds, static_argnums=(2,))
+    return jax.jit(epoch_seeds, static_argnums=(2,),
+                   out_shardings=(sharded, sharded))
 
   def _chunk_fn_for(self, k: int):
     """The scanned K-step shard_map program (built per static chunk
@@ -481,31 +496,38 @@ class DistScanTrainer(DistFusedEpochTrainer):
         self.loader._publish_feature_stats()
       return state, empty, empty
 
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(self.mesh, PartitionSpec())
     if self._seeds_dev is None:
-      self._seeds_dev = jnp.asarray(
-          np.asarray(self.loader.input_seeds, dtype=np.int32))
+      # committed to the mesh (replicated) at upload: the seed program
+      # runs on the mesh, and an uncommitted single-device array would
+      # be broadcast IMPLICITLY at its first dispatch — a hidden
+      # device-to-device transfer GLT_STRICT's transfer guard rejects
+      self._seeds_dev = jax.device_put(
+          np.asarray(self.loader.input_seeds, dtype=np.int32), repl)
     # _epochs advances only on SUCCESS (below, with _call_count): a
     # failed epoch's re-run must redraw the SAME permutation or the
     # chunk-granularity failover story (docs/failure_model.md) can't
     # reproduce the completed chunks' seed matrix
-    perm_key = jax.random.fold_in(self._perm_key, self._epochs)
-    record_dispatch('dist_epoch_seeds')
-    seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
-                                       full_steps)
+    perm_key = jax.device_put(
+        jax.random.fold_in(self._perm_key, self._epochs), repl)
 
-    base_key = self._sampler._key
-    count0 = np.int32(self._sampler._call_count + 1)
+    base_key = jax.device_put(self._sampler._key, repl)
     stats = ({t: self._feat[t]._stats_dev() for t in self._feat_types}
              if self.is_hetero else self._feat._stats_dev())
     # commit the replicated carry leaves explicitly: a fresh (host /
     # single-device) state and the chunk program's replicated outputs
     # must present the SAME sharding signature, or every epoch's first
-    # chunk retraces (sharding is part of the jit cache key)
-    from jax.sharding import NamedSharding, PartitionSpec
-    repl = NamedSharding(self.mesh, PartitionSpec())
+    # chunk retraces (sharding is part of the jit cache key). The
+    # chunk-position scalars are explicit device_puts too: inside the
+    # strict_guards region (GLT_STRICT=1: transfer_guard('disallow') +
+    # checking_leaks) any implicit host->device transfer raises, so the
+    # epoch region provably dispatches only all-device program args
+    count0 = jax.device_put(np.int32(self._sampler._call_count + 1),
+                            repl)
     params, opt_state, stepc, ovf = jax.device_put(
         (state.params, state.opt_state, state.step,
-         jnp.zeros((), bool)), repl)
+         np.zeros((), bool)), repl)
 
     def stats_back(tree):
       # hand the carried accumulators back to the stores AFTER EVERY
@@ -522,18 +544,27 @@ class DistScanTrainer(DistFusedEpochTrainer):
     losses, accs = [], []
     start = 0
     try:
-      while start < steps:
-        k = min(self.chunk_size, steps - start)
-        record_dispatch('dist_scan_chunk')
-        params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
-            self._chunk_fn_for(k)(
-                self._shard_tree, self._repl_tree, stats, params,
-                opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
-                count0, np.int32(start))
-        stats_back(stats)
-        losses.append(loss_k)
-        accs.append(acc_k)
-        start += k
+      with strict_guards():
+        record_dispatch('dist_epoch_seeds')
+        seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
+                                           full_steps)
+        while start < steps:
+          k = min(self.chunk_size, steps - start)
+          record_dispatch('dist_scan_chunk')
+          params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
+              self._chunk_fn_for(k)(
+                  self._shard_tree, self._repl_tree, stats, params,
+                  opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
+                  count0, jax.device_put(np.int32(start), repl))
+          stats_back(stats)
+          losses.append(loss_k)
+          accs.append(acc_k)
+          start += k
+        if len(losses) > 1:
+          record_dispatch('dist_metrics_concat')
+          losses, accs = self._concat_fn(losses, accs)
+        else:
+          losses, accs = losses[0], accs[0]
     except BaseException:
       # the in-flight chunk's donated stats input is gone; drop the
       # partial epoch's counts rather than leave a dead reference
@@ -544,12 +575,6 @@ class DistScanTrainer(DistFusedEpochTrainer):
     # (checkpoint/resume and any later per-step sampling continue it)
     self._sampler._call_count += steps
     self._epochs += 1
-
-    if len(losses) > 1:
-      record_dispatch('dist_metrics_concat')
-      losses, accs = self._concat_fn(losses, accs)
-    else:
-      losses, accs = losses[0], accs[0]
 
     state = self._train_state_cls(params, opt_state, stepc)
     try:

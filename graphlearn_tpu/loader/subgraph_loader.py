@@ -9,7 +9,7 @@ from typing import Optional
 
 from ..data import Dataset
 from ..sampler import NeighborSampler, NodeSamplerInput
-from .node_loader import NodeLoader, SeedBatcher
+from .node_loader import NodeLoader
 
 
 class SubGraphLoader(NodeLoader):

@@ -9,7 +9,7 @@ segment ops to efficient scatter-adds, and the masked-padding design means
 one compile for the whole epoch. Feature matmuls are [N, F] x [F, H] dense —
 MXU-shaped; keep hidden dims multiples of 128 for best tiling.
 """
-from typing import Any, Callable, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -7,7 +7,6 @@ over the padded batch: loss is masked cross-entropy on the seed-node slots
 (local indices [0, num_seed_nodes)), so the same compiled step serves every
 batch of an epoch.
 """
-import functools
 from typing import Any, NamedTuple
 
 import jax

@@ -25,7 +25,7 @@ from typing import Dict, Optional, Tuple, Union
 import numpy as np
 
 from ..typing import (EdgeType, FeaturePartitionData, GraphPartitionData,
-                      NodeType, as_str, to_edge_type)
+                      NodeType, as_str)
 
 
 class PartitionerBase:

@@ -23,7 +23,12 @@ class RandomNegativeSampler:
     self.graph = graph
     self.mode = mode
     self.edge_dir = edge_dir
+    # counter-addressed PRNG (never split-and-carry): call N's key is
+    # fold_in(base, N), so any stream position is reachable from
+    # (base_key, integer) alone — the replay discipline every sampler
+    # in this package follows (docs/failure_model.md)
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
+    self._call_count = 0
     self._sorted_indices, _ = ops.sort_csr_segments(
         np.asarray(graph.indptr), np.asarray(graph.indices))
 
@@ -33,7 +38,8 @@ class RandomNegativeSampler:
     full (non-strict mode, reference random_negative_sampler.cu)."""
     import jax
     g = self.graph
-    self._key, sub = jax.random.split(self._key)
+    self._call_count += 1
+    sub = jax.random.fold_in(self._key, self._call_count)
     rows, cols, mask = ops.random_negative_sample(
         g.indptr, self._sorted_indices, g.num_nodes, g.num_nodes,
         num_samples, sub, trials=trials, padding=padding)

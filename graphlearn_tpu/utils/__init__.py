@@ -8,6 +8,7 @@ from .exit_status import python_exit_status
 from .faults import FaultError, fault_point
 from .mixin import CastMixin
 from .singleton import Singleton
+from .strict import strict_enabled, strict_guards
 from .tensor import convert_to_array, id2idx, squeeze_dict
 from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, csr_to_csc, ind2ptr,
                    ptr2ind)

@@ -42,6 +42,23 @@ from typing import Dict, Optional
 
 _ENV_VAR = 'GLT_FAULTS'
 
+# The closed inventory of fault sites. graftlint's fault-point-coverage
+# rule cross-checks every ``fault_point('<name>')`` call site against
+# this frozenset AND the docs/failure_model.md fault-site table — adding
+# a site means registering it here and documenting it there, in the same
+# change. Names are '<layer>.<operation>', one name per code site.
+REGISTERED_SITES = frozenset({
+    'rpc.client.request',
+    'rpc.client.response',
+    'rpc.server.dispatch',
+    'channel.remote.fetch',
+    'channel.shm.send',
+    'server.create_producer',
+    'server.fetch',
+    'producer.worker.batch',
+    'heartbeat.probe',
+})
+
 
 class FaultError(RuntimeError):
   """Default exception raised by an armed 'raise' fault point."""

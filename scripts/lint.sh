@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Static-analysis gate: graftlint (the repo-specific hot-path invariant
+# checker, docs/static_analysis.md) + ruff (generic pyflakes/import
+# hygiene, [tool.ruff] in pyproject.toml). Run from anywhere; exits
+# non-zero on any finding. ruff is optional tooling — images without it
+# skip that half with a notice (the graftlint half, pure stdlib ast,
+# always runs; tests/test_analysis.py enforces the same zero-findings
+# invariant inside the tier-1 suite, ruff or not).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== graftlint =="
+python -m graphlearn_tpu.analysis.lint graphlearn_tpu/ || rc=1
+
+echo "== ruff =="
+if python -m ruff --version >/dev/null 2>&1; then
+  python -m ruff check graphlearn_tpu/ tests/ bench.py || rc=1
+elif command -v ruff >/dev/null 2>&1; then
+  ruff check graphlearn_tpu/ tests/ bench.py || rc=1
+else
+  echo "ruff not installed — skipping (config lives in pyproject.toml)"
+fi
+
+echo "== bench schema =="
+python bench.py --validate || rc=1
+
+exit "$rc"

@@ -101,6 +101,27 @@ def rng():
   return np.random.default_rng(0)
 
 
+# ------------------------------------------------------- strict guard rails
+# The scanned-epoch suites run with GLT_STRICT=1 by default: the epoch
+# program regions in loader.ScanTrainer / loader.DistScanTrainer then
+# execute under jax.transfer_guard('disallow') + jax.checking_leaks
+# (utils/strict.py), so a change that sneaks an implicit device<->host
+# transfer or a leaked tracer into a scan body fails these tests even
+# when its numerics are still correct — the runtime complement of the
+# graftlint static pass (docs/static_analysis.md). Export GLT_STRICT=0
+# to debug a failure with the guards off.
+
+_STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch')
+
+
+@pytest.fixture(autouse=True)
+def _strict_scanned_epochs(request, monkeypatch):
+  if request.node.module.__name__ in _STRICT_MODULES and \
+      os.environ.get('GLT_STRICT', '') == '':
+    monkeypatch.setenv('GLT_STRICT', '1')
+  yield
+
+
 # ------------------------------------------------------ wall-budget canary
 # The tier-1 harness kills the suite at GLT_TIER1_BUDGET_S (870 s,
 # ROADMAP.md) — and container-load variance is ±120 s/run, so a suite
