@@ -424,6 +424,136 @@ BENCH_ERROR_SECTIONS = (
     'hetero_step', 'hetero_ref', 'feature_exchange',
 )
 
+# The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
+# `bench.py --gate` regression-checks round over round (ms / seconds /
+# dispatch counts / wire MB; throughput keys are higher-is-better and
+# tracked in the trajectory table only). Declare a new latency/cost key
+# here IN THE SAME CHANGE that registers it, or the gate never sees it.
+BENCH_LOWER_IS_BETTER = frozenset({
+    'device_ms_per_batch', 'map_device_ms_per_batch',
+    'padded16_device_ms_per_batch', 'block_device_ms_per_batch',
+    'map_calibrated_device_ms_per_batch', 'dispatch_ms_per_batch',
+    'train_step_ms_f32', 'train_step_ms_bf16', 'train_step_ms_exact_bf16',
+    'epoch_time_s', 'epoch_time_s_exact', 'epoch_time_s_tree',
+    'epoch_time_s_scanned',
+    'epoch_dispatches', 'scan_epoch_wall_s', 'scan_epoch_device_trace_s',
+    'dist_epoch_dispatches', 'dist_epoch_wall_s',
+    'dist_scan_epoch_dispatches', 'dist_scan_epoch_wall_s',
+    'feature_exchange_mb_per_batch',
+    'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
+    'hetero_rgnn_step_ms_bf16', 'hetero_rgnn_train_program_ms',
+    'hetero_rgat_step_ms_bf16', 'hetero_rgat_train_program_ms',
+    'hetero_rgnn_ref_step_ms_bf16', 'hetero_rgnn_ref_train_program_ms',
+    'hetero_rgat_ref_step_ms_bf16', 'hetero_rgat_ref_train_program_ms',
+})
+assert BENCH_LOWER_IS_BETTER <= set(BENCH_KEY_REGISTRY), \
+    'gate keys must be registered bench keys'
+
+#: >20% worse on a declared lower-is-better key fails the gate.
+GATE_REGRESSION_THRESHOLD = 0.20
+
+
+def _default_bench_paths():
+  import glob as _glob
+  import os
+  here = os.path.dirname(os.path.abspath(__file__))
+  return sorted(_glob.glob(os.path.join(here, 'BENCH_*.json')))
+
+
+def _load_bench_record(path):
+  """(record, error) from a BENCH_*.json file (raw bench output, or
+  the driver wrapper whose 'parsed' field holds it) — the ONE unwrap
+  of the driver-wrapper contract, shared by --validate and --gate so
+  the two can't diverge on the same files. ``record`` is None when the
+  file is unreadable (``error`` says why) or when the wrapper carries
+  no parseable record (``error`` None — rc/tail tell that story)."""
+  try:
+    with open(path) as fh:
+      data = json.load(fh)
+  except (OSError, ValueError) as e:
+    return None, f'unreadable: {e}'
+  record = data.get('parsed', data) if isinstance(data, dict) else data
+  return (record if isinstance(record, dict) else None), None
+
+
+def _gate_value(record, key):
+  """The gateable numeric for ``key``, or None (missing / null /
+  non-numeric / bool — a failed section must read as 'no data', never
+  as a 0-regression or an infinite one)."""
+  v = record.get(key)
+  if isinstance(v, bool) or not isinstance(v, (int, float)):
+    return None
+  return float(v)
+
+
+def gate_bench_files(paths=(), threshold: float = GATE_REGRESSION_THRESHOLD
+                     ) -> int:
+  """--gate entry: regression-check the NEWEST BENCH_*.json against the
+  previous round over their shared lower-is-better keys, and print the
+  per-key trajectory across every round. Returns a process exit code
+  (1 on any >threshold regression).
+
+  Rounds whose record is missing/unparseable (a driver wrapper with no
+  'parsed' — e.g. a relay-down round) are skipped, so the gate always
+  compares the two most recent rounds WITH numbers; keys absent or
+  null on either side are skipped per key. No jax, no device."""
+  import os
+  paths = paths or _default_bench_paths()
+  rounds = []
+  for path in paths:
+    name = os.path.basename(path)
+    record, _ = _load_bench_record(path)
+    if record is None:
+      print(f'bench --gate: {name}: no parsed record (skipped)')
+      continue
+    if not any(_gate_value(record, k) is not None
+               for k in BENCH_LOWER_IS_BETTER):
+      # a parseable round with ZERO gateable numbers (relay-down
+      # fail-fast record) must not become the "newest round" — it
+      # would make every comparison vacuous AND shield the next real
+      # round from being gated against the last real numbers
+      print(f'bench --gate: {name}: no gateable keys (skipped)')
+      continue
+    rounds.append((name, record))
+  if not rounds:
+    print('bench --gate: no parseable BENCH records — nothing to gate')
+    return 0
+
+  # trajectory table: every lower-is-better key any round reported
+  keys = sorted(k for k in BENCH_LOWER_IS_BETTER
+                if any(_gate_value(r, k) is not None for _, r in rounds))
+  if keys:
+    width = max(len(k) for k in keys)
+    header = ' '.join(f'{name:>14}' for name, _ in rounds)
+    print(f'{"key (lower is better)":<{width}} {header}')
+    for k in keys:
+      cells = []
+      for _, r in rounds:
+        v = _gate_value(r, k)
+        cells.append(f'{v:>14.3f}' if v is not None else f'{"—":>14}')
+      print(f'{k:<{width}} {" ".join(cells)}')
+
+  if len(rounds) < 2:
+    print('bench --gate: fewer than two rounds with numbers — pass')
+    return 0
+  (prev_name, prev), (new_name, new) = rounds[-2], rounds[-1]
+  regressions = []
+  for k in keys:
+    old_v, new_v = _gate_value(prev, k), _gate_value(new, k)
+    if old_v is None or new_v is None or old_v <= 0:
+      continue
+    ratio = new_v / old_v
+    if ratio > 1.0 + threshold:
+      regressions.append((k, old_v, new_v, ratio))
+  for k, old_v, new_v, ratio in regressions:
+    print(f'bench --gate: REGRESSION {k}: {old_v:.3f} ({prev_name}) -> '
+          f'{new_v:.3f} ({new_name}) = {ratio:.2f}x '
+          f'(threshold {1 + threshold:.2f}x)')
+  print(f'bench --gate: {len(regressions)} regression(s) comparing '
+        f'{new_name} against {prev_name} over {len(keys)} tracked '
+        'key(s)')
+  return 1 if regressions else 0
+
 
 def _known_bench_key(key: str) -> bool:
   if key in BENCH_KEY_REGISTRY:
@@ -456,21 +586,14 @@ def validate_bench_files(paths) -> int:
   """--validate entry: check saved BENCH_*.json records (raw bench
   output, or the driver wrapper whose 'parsed' field holds it) against
   BENCH_KEY_REGISTRY. Prints findings; returns a process exit code."""
-  import glob as _glob
-  import os
-  if not paths:
-    here = os.path.dirname(os.path.abspath(__file__))
-    paths = sorted(_glob.glob(os.path.join(here, 'BENCH_*.json')))
+  paths = paths or _default_bench_paths()
   total = 0
   for path in paths:
-    try:
-      with open(path) as fh:
-        data = json.load(fh)
-    except (OSError, ValueError) as e:
-      print(f'{path}: unreadable: {e}')
+    record, err = _load_bench_record(path)
+    if err:
+      print(f'{path}: {err}')
       total += 1
       continue
-    record = data.get('parsed', data) if isinstance(data, dict) else data
     if record is None:
       # a driver wrapper whose run produced no parseable line: nothing
       # to schema-check (rc/tail carry the failure story)
@@ -988,6 +1111,10 @@ if __name__ == '__main__':
     # schema check only: no jax, no device, no axon probe
     args = [a for a in sys.argv[1:] if a != '--validate']
     sys.exit(validate_bench_files(args))
+  if '--gate' in sys.argv[1:]:
+    # round-over-round regression gate: no jax, no device
+    args = [a for a in sys.argv[1:] if a != '--gate']
+    sys.exit(gate_bench_files(args))
   try:
     if os.environ.get('PALLAS_AXON_POOL_IPS') and not _axon_relay_up():
       # clearly down: fail fast with a parseable record instead of

@@ -76,6 +76,12 @@ class Config:
   # rule fault-point-coverage inputs (package-relative / repo-relative)
   fault_registry_module: str = 'utils/faults.py'
   failure_doc: str = 'docs/failure_model.md'
+  # rule metric-registry inputs: the closed metric-name frozenset, its
+  # documentation table, and the modules exempt from call-site checks
+  # (the metrics package itself registers/loops over names as data)
+  metrics_registry_module: str = 'metrics/registry_names.py'
+  observability_doc: str = 'docs/observability.md'
+  metrics_exempt_modules: Tuple[str, ...] = ('metrics/',)
   # resolved at run time from the linted paths unless set explicitly
   repo_root: Optional[str] = None
 
@@ -107,7 +113,8 @@ def in_scope(relpath: str, patterns: Sequence[str]) -> bool:
 # ------------------------------------------------------------------ pragmas
 
 PRAGMA_RULES = ('host-sync', 'prng-discipline', 'dispatch-instrumentation',
-                'compat-shard-map', 'fault-point-coverage')
+                'compat-shard-map', 'fault-point-coverage',
+                'metric-registry')
 _PRAGMA_MARK = 'graftlint:'
 
 
@@ -274,8 +281,10 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 # ------------------------------------------------------------------- runner
 
 def _checkers():
-  from . import compat_import, dispatch, fault_points, host_sync, prng
-  return (host_sync, prng, dispatch, compat_import, fault_points)
+  from . import (compat_import, dispatch, fault_points, host_sync,
+                 metric_names, prng)
+  return (host_sync, prng, dispatch, compat_import, fault_points,
+          metric_names)
 
 
 def run_lint(paths: Sequence[str], config: Optional[Config] = None,

@@ -36,6 +36,11 @@ _RULE_DOCS = {
         'fault_point sites must be literal, unique, in '
         'utils/faults.py REGISTERED_SITES, and documented in '
         'docs/failure_model.md',
+    'metric-registry':
+        'metric names (counter_inc / metrics.inc/observe/set_gauge/'
+        'counter/gauge/histogram) must be string literals registered '
+        'in metrics/registry_names.py REGISTERED_METRICS and '
+        'documented in docs/observability.md',
 }
 
 

@@ -27,6 +27,12 @@ def init_client(num_servers: int, num_clients: int, client_rank: int,
   return _client
 
 
+def get_client() -> Optional[RpcClient]:
+  """The initialized RpcClient, or None (metrics.scrape_all uses this
+  to discover which server ranks are reachable)."""
+  return _client
+
+
 def request_server(server_rank: int, func, *args, **kwargs):
   """Reference: dist_client.py:79-88. `func` may be a name or a DistServer
   method (its __name__ is used)."""

@@ -66,6 +66,7 @@ class DistLoader(OverflowGuardMixin):
     self.seed = seed   # kept: DistScanTrainer derives its perm key here
     self._rng = np.random.default_rng(seed)
     self.num_partitions = data.num_partitions
+    self._flight_epochs = 0   # epochs RECORDED (metrics/flight.py)
 
   def __len__(self):
     g = self.num_partitions * self.batch_size
@@ -108,8 +109,33 @@ class DistLoader(OverflowGuardMixin):
         mask = (np.arange(g) < n_valid).reshape(shape)
       yield idx.reshape(shape), mask
 
+  # -- epoch flight records (metrics/flight.py; docs/observability.md):
+  # every per-step loader epoch appends ONE JSONL record to GLT_RUN_LOG
+  # — steps yielded, wall, dispatch/feature/resilience counter deltas.
+  # Pure host bookkeeping around the existing loop (the feature fields
+  # come from the publish_stats fetch the epoch already pays).
+
+  def _flight_begin(self):
+    from ..metrics import flight
+    return flight.epoch_begin()
+
+  def _flight_end(self, tok, steps: int, completed: bool):
+    from ..metrics import flight
+    flight.end_for(self, tok, steps=steps, completed=completed,
+                   config=self._flight_config())
+
+  def _flight_config(self) -> dict:
+    """Static epoch configuration (fingerprinted in flight records)."""
+    return dict(loader=type(self).__name__, batch_size=self.batch_size,
+                shuffle=self.shuffle, drop_last=self.drop_last,
+                num_partitions=self.num_partitions, seed=self.seed,
+                num_neighbors=getattr(self.sampler, 'num_neighbors',
+                                      None))
+
   def __iter__(self):
     from ..utils import step_annotation
+    tok = self._flight_begin()
+    steps, completed = 0, False
     guarded, recompute = self._overflow_epoch_start()
     try:
       for i, (idx, mask) in enumerate(self._index_blocks()):
@@ -128,12 +154,15 @@ class DistLoader(OverflowGuardMixin):
             if guarded:
               self._accumulate_overflow(out)
           yield self._collate_fn(out)
+          steps += 1
+      completed = True
       if guarded and not recompute:
         self._finish_epoch_overflow()
     finally:
       # also on early break/close: the on-device int32 accumulator must
       # be drained per epoch or it eventually wraps
       self._publish_feature_stats()
+      self._flight_end(tok, steps, completed)
 
   def _publish_feature_stats(self):
     """Surface the feature-store hit/miss counters into utils.trace at
@@ -256,22 +285,41 @@ class MpDistNeighborLoader:
     return self._expected
 
   def __iter__(self):
+    from ..metrics import flight
+    tok = flight.epoch_begin()
     self.producer.produce_all()
     received = 0
-    while received < self._expected:
-      try:
-        msg = self.channel.recv(timeout_ms=self.health_check_interval_ms)
-      except self._timeout_error:
-        # crashed worker -> restart + bit-identical replay (raises only
-        # once the producer's restart budget is exhausted), rather than
-        # spinning on an empty channel forever
-        self.producer.check_worker_health()
-        if self.producer.is_all_sampling_completed() and \
-            self.channel.empty():
-          break
-        continue
-      received += 1
-      yield self._message_to_data(msg)
+    try:
+      while received < self._expected:
+        try:
+          msg = self.channel.recv(
+              timeout_ms=self.health_check_interval_ms)
+        except self._timeout_error:
+          # crashed worker -> restart + bit-identical replay (raises
+          # only once the producer's restart budget is exhausted),
+          # rather than spinning on an empty channel forever
+          self.producer.check_worker_health()
+          if self.producer.is_all_sampling_completed() and \
+              self.channel.empty():
+            break
+          continue
+        received += 1
+        yield self._message_to_data(msg)
+    finally:
+      cfg = self.producer.config
+      flight.end_for(
+          self, tok, steps=received,
+          completed=received >= self._expected,
+          config=dict(loader=type(self).__name__,
+                      batch_size=cfg.batch_size, shuffle=cfg.shuffle,
+                      num_neighbors=cfg.num_neighbors,
+                      num_workers=self.producer.num_workers))
+
+  def worker_metrics(self):
+    """Merged metric snapshot across this loader's mp sampling workers
+    (see DistMpSamplingProducer.worker_metrics); None before the first
+    epoch-end publish."""
+    return self.producer.worker_metrics()
 
   def shutdown(self):
     self.producer.shutdown()
@@ -534,16 +582,41 @@ class _RemoteLoaderBase:
     return buffered
 
   def __iter__(self):
-    import time as _time
-
-    from ..channel import QueueTimeoutError
-    from ..channel.remote_channel import PeerDeadError
+    from ..metrics import flight
     # Ordering matters: kill any previous epoch's pullers BEFORE
     # restarting the server producers (a stale puller would consume
     # new-epoch messages into its dead queue), and only then start the
     # new pullers.
     self.channel.stop(join=True)
     self._epoch += 1
+    tok = flight.epoch_begin()
+    received, completed = 0, False
+    try:
+      for data in self._epoch_messages():
+        yield data
+        received += 1
+      completed = True
+    finally:
+      # the flight record is the postmortem trail for THIS epoch:
+      # failover/retry counter deltas, batches delivered, wall — one
+      # JSONL line (docs/observability.md), nothing on the hot path
+      cfg = self._config
+      flight.end_for(
+          self, tok, epoch=self._epoch, steps=received,
+          completed=completed,
+          config=dict(loader=type(self).__name__,
+                      batch_size=cfg.batch_size, shuffle=cfg.shuffle,
+                      num_neighbors=cfg.num_neighbors,
+                      servers=list(self.server_ranks)),
+          extra={'expected': self._expected,
+                 'dead_ranks': {str(r): c for r, c in
+                                self._dead_ranks.items()}})
+
+  def _epoch_messages(self):
+    import time as _time
+
+    from ..channel import QueueTimeoutError
+    from ..channel.remote_channel import PeerDeadError
     self._acked = {}
     self._pair_batches = {}
     self._handled_pairs = set()
@@ -764,6 +837,8 @@ class DistLinkNeighborLoader(DistLoader):
 
   def __iter__(self):
     from ..sampler import EdgeSamplerInput
+    tok = self._flight_begin()
+    steps, completed = 0, False
     guarded, recompute = self._overflow_epoch_start()
     try:
       for idx, mask in self._index_blocks():
@@ -786,10 +861,13 @@ class DistLinkNeighborLoader(DistLoader):
           if guarded:
             self._accumulate_overflow(out)
         yield self._collate_fn(out)
+        steps += 1
+      completed = True
       if guarded and not recompute:
         self._finish_epoch_overflow()
     finally:
       self._publish_feature_stats()
+      self._flight_end(tok, steps, completed)
 
 
 class DistSubGraphLoader(DistLoader):
@@ -817,14 +895,19 @@ class DistSubGraphLoader(DistLoader):
     self.max_degree = max_degree
 
   def __iter__(self):
+    tok = self._flight_begin()
+    steps, completed = 0, False
     try:
       for idx, mask in self._index_blocks():
         out = self.sampler.subgraph(self.input_seeds[idx],
                                     seed_mask=mask,
                                     max_degree=self.max_degree)
         yield self._collate_fn(out)
+        steps += 1
+      completed = True
     finally:
       self._publish_feature_stats()
+      self._flight_end(tok, steps, completed)
 
 
 class DistNeighborLoader(DistLoader):

@@ -30,7 +30,8 @@ class MpCommand(Enum):
 
 def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
                           task_queue, channel, done_counter,
-                          progress=None, resume_calls: int = 0):
+                          progress=None, resume_calls: int = 0,
+                          metrics_q=None):
   """Subprocess body (reference: dist_sampling_producer.py:53-151).
 
   Self-healing contract: after every batch lands in the channel the
@@ -98,9 +99,11 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
     n_seeds = rows_.shape[0]
   else:
     n_seeds = seeds.shape[0]
+  from graphlearn_tpu import metrics
   from graphlearn_tpu.utils.faults import fault_point
   import os as _os
   import queue as _queue
+  import time as _time
   parent = _os.getppid()
   while True:
     try:
@@ -132,6 +135,7 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
       # chaos harness site: armed 'exit' here (before the sample/send)
       # kills the worker at an exact batch index with nothing in flight
       fault_point('producer.worker.batch')
+      t_batch = _time.perf_counter()
       if is_link:
         if idx.shape[0] < bs:
           # pad the final short batch cyclically so every batch keeps the
@@ -174,6 +178,12 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
           y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
         msg = output_to_message(out, x, y)
       channel.send(msg)
+      # worker-local observability: this subprocess's own registry; it
+      # reaches the trainer through the metrics_q snapshot below (and
+      # DistServer.get_metrics / metrics.scrape_all from there)
+      metrics.inc('producer.batches')
+      metrics.observe('producer.sample_ms',
+                      (_time.perf_counter() - t_batch) * 1e3)
       batch_no += 1
       if progress is not None:
         # published AFTER the send. Tradeoff for an UNCONTROLLED crash
@@ -190,6 +200,14 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
           calls_arr[rank] = sampler._call_count
     with done_counter.get_lock():
       done_counter.value += 1
+    if metrics_q is not None:
+      # publish the CUMULATIVE worker snapshot at epoch end over the
+      # producer's queue plumbing — latest-wins per rank on the other
+      # side, so a lost/duplicated frame costs nothing
+      try:
+        metrics_q.put_nowait((rank, metrics.snapshot()))
+      except Exception:  # noqa: BLE001 - observability must not kill work
+        pass
 
 
 class DistMpSamplingProducer:
@@ -266,7 +284,7 @@ class DistMpSamplingProducer:
         target=_sampling_worker_loop,
         args=(w, self._handle, self.config, self._worker_seeds(w), q,
               self.channel, self._done, (self._sent, self._calls),
-              resume_calls),
+              resume_calls, self._metrics_q),
         daemon=True)
     p.start()
     self._procs[w] = p
@@ -280,6 +298,12 @@ class DistMpSamplingProducer:
     # restart path needs to replay a dead worker exactly
     self._sent = ctx.Array('q', self.num_workers)
     self._calls = ctx.Array('q', self.num_workers)
+    # worker metric snapshots ride their own small queue (epoch-end
+    # cadence, latest-wins) — NEVER the data channel, whose message
+    # count is the epoch-completion contract
+    self._metrics_q = ctx.Queue()
+    self._worker_snaps = {}
+    self._metrics_drain_lock = threading.Lock()
     self._last_orders = [None] * self.num_workers
     g = self.dataset.graph
     nf = self.dataset.node_features
@@ -368,6 +392,33 @@ class DistMpSamplingProducer:
       if order is not None and sent < self._expected_for_worker(w):
         # mid-epoch death: replay the unfinished tail of its seed order
         self._queues[w].put((MpCommand.SAMPLE_ALL, (order, sent)))
+
+  def worker_metrics(self):
+    """Merged metric snapshot across this producer's mp workers, or
+    None before any worker has published (workers push cumulative
+    snapshots at epoch end over ``_metrics_q``; latest-wins per rank —
+    a respawned worker's fresh registry simply restarts its series).
+    The drain is serialized under a lock: concurrent callers (the
+    owning loader + DistServer.get_metrics RPC-handler threads) racing
+    get_nowait against the per-rank dict write could otherwise land an
+    OLDER frame over a newer one and make the cumulative series step
+    backwards until the next epoch-end publish."""
+    import queue as _queue
+    q = getattr(self, '_metrics_q', None)
+    if q is None:
+      return None
+    with self._metrics_drain_lock:
+      while True:
+        try:
+          rank, snap = q.get_nowait()
+        except (_queue.Empty, OSError, ValueError):
+          break
+        self._worker_snaps[rank] = snap
+      if not self._worker_snaps:
+        return None
+      snaps = list(self._worker_snaps.values())
+    from ..metrics import merge_snapshots
+    return merge_snapshots(snaps)
 
   def num_expected(self) -> int:
     bs = self.config.batch_size

@@ -177,6 +177,7 @@ class DistServer:
                                 ) -> Tuple[Optional[dict], bool]:
     """(message|None, end_of_epoch). Reference: dist_server.py:149-166."""
     fault_point('server.fetch')
+    t0 = time.perf_counter()
     # one atomic preamble: existence check, touch, count check, and the
     # fetch-lock lookup must see a consistent producer state — a racing
     # destroy between them would otherwise KeyError (opaque remote
@@ -205,6 +206,11 @@ class DistServer:
     with self._lock:
       self._received[producer_id] += 1
       end = self._received[producer_id] >= self._expected[producer_id]
+    # delivered-fetch latency distribution: the serving-tier p50/p99
+    # substrate (ROADMAP item 1); empty polls/timeouts are excluded so
+    # the histogram measures delivery, not the poll cadence
+    from .. import metrics
+    metrics.observe('server.fetch_ms', (time.perf_counter() - t0) * 1e3)
     return msg, end
 
   def destroy_sampling_producer(self, producer_id: int):
@@ -244,6 +250,24 @@ class DistServer:
     get failed over for no reason. len() is atomic under the GIL."""
     return dict(ok=True, time=time.time(),
                 n_producers=len(self._producers))
+
+  def get_metrics(self) -> dict:
+    """Scrape endpoint (metrics.scrape_all): this server PROCESS's
+    metric snapshot plus each live producer's merged mp-worker
+    snapshot, keyed by producer id. READ-ONLY and side-effect-free —
+    idempotent by construction, so clients scrape it with retry under
+    the fault-injection registry. Like heartbeat, the snapshot itself
+    takes no self._lock (the registry has its own); only the producer
+    table copy does."""
+    from ..metrics import snapshot
+    out = {'server': snapshot(), 'producers': {}}
+    with self._lock:
+      producers = dict(self._producers)
+    for pid, producer in producers.items():
+      workers = getattr(producer, 'worker_metrics', lambda: None)()
+      if workers:
+        out['producers'][pid] = workers
+    return out
 
   # -- misc (reference: dist_server.py:60-102) -----------------------------
 
@@ -310,6 +334,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
           'destroy_sampling_producer': s.destroy_sampling_producer,
           'get_dataset_meta': s.get_dataset_meta,
           'heartbeat': s.heartbeat,
+          'get_metrics': s.get_metrics,
           'exit': s.exit,
           'client_barrier': barrier.arrive,
       })

@@ -258,7 +258,10 @@ class RpcClient:
   def _attempt(self, rank: int, func: str, args, kwargs,
                timeout: Optional[float]):
     """One request/response round trip on the pooled connection."""
+    import time as _time
+
     from ..utils.faults import fault_point
+    t0 = _time.perf_counter()
     try:
       fault_point('rpc.client.request')
       sock = self._conn(rank, connect_timeout=timeout)
@@ -282,6 +285,14 @@ class RpcClient:
     if not resp['ok']:
       raise RuntimeError(
           f'remote error from rank {rank}: {resp["error"]}')
+    # SUCCESSFUL round trips feed the control/stream-plane latency
+    # histogram — the p50/p99 every remote-batch consumer actually pays
+    # per RPC. Failures (including ok=False remote errors, often
+    # fast-failing) surface through resilience.* counters instead of
+    # dragging the latency distribution down
+    from .. import metrics
+    metrics.observe('rpc.client.request_ms',
+                    (_time.perf_counter() - t0) * 1e3)
     return resp['result']
 
   def request_sync(self, rank: int, func: str, *args,

@@ -263,30 +263,47 @@ class NodeLoader(OverflowGuardMixin):
       self._epochs_started = getattr(self, '_epochs_started', 0) + 1
 
   def __iter__(self):
+    from ..metrics import flight
     from ..utils import step_annotation
     self._begin_epoch()
+    tok = flight.epoch_begin()
+    steps, completed = 0, False
     guarded, recompute = self._overflow_epoch_start()
-    for i, idx in enumerate(self._batcher):
-      with step_annotation('glt_batch', i):
-        seeds = self.input_seeds[idx]
-        inp = NodeSamplerInput(seeds, self.input_type)
-        if recompute:
-          key = self.sampler._next_key()
-          out = self.sampler.sample_from_nodes(inp,
-                                               batch_cap=self.batch_size,
-                                               key=key)
-          if self._batch_overflowed(out):
-            self.overflow_recomputes += 1
-            out = self._replay_sampler().sample_from_nodes(
+    try:
+      for i, idx in enumerate(self._batcher):
+        with step_annotation('glt_batch', i):
+          seeds = self.input_seeds[idx]
+          inp = NodeSamplerInput(seeds, self.input_type)
+          if recompute:
+            key = self.sampler._next_key()
+            out = self.sampler.sample_from_nodes(
                 inp, batch_cap=self.batch_size, key=key)
-        else:
-          out = self.sampler.sample_from_nodes(inp,
-                                               batch_cap=self.batch_size)
-          if guarded:
-            self._accumulate_overflow(out)
-        yield self._collate_fn(out)
-    if guarded and not recompute:
-      self._finish_epoch_overflow()
+            if self._batch_overflowed(out):
+              self.overflow_recomputes += 1
+              out = self._replay_sampler().sample_from_nodes(
+                  inp, batch_cap=self.batch_size, key=key)
+          else:
+            out = self.sampler.sample_from_nodes(
+                inp, batch_cap=self.batch_size)
+            if guarded:
+              self._accumulate_overflow(out)
+          yield self._collate_fn(out)
+          steps += 1
+      completed = True
+      if guarded and not recompute:
+        self._finish_epoch_overflow()
+    finally:
+      # one flight record per per-step epoch (metrics/flight.py) —
+      # host-side counter deltas only, nothing dispatched or fetched
+      flight.end_for(
+          self, tok, steps=steps, completed=completed,
+          config=dict(loader=type(self).__name__,
+                      batch_size=self.batch_size,
+                      shuffle=self._batcher.shuffle,
+                      drop_last=self._batcher.drop_last,
+                      seed=self._batcher.seed,
+                      num_neighbors=getattr(self.sampler,
+                                            'num_neighbors', None)))
 
   # -- collate (reference: node_loader.py:85-113) --------------------------
   #

@@ -169,6 +169,8 @@ class OverlappedTrainer(FusedEpochTrainer):
     ``losses`` a list of device scalars (one per step) — fetch once,
     after the epoch, to keep the hot loop pipelined."""
     import jax.numpy as jnp
+
+    from ..metrics import flight
     from ..utils.trace import record_dispatch
     # _seed_batches walks loader._batcher directly (bypassing
     # NodeLoader.__iter__), so the per-epoch padded-table reseed must be
@@ -181,41 +183,57 @@ class OverlappedTrainer(FusedEpochTrainer):
     if recompute:
       raise ValueError(_RECOMPUTE_MSG)
     self.loader._begin_epoch()
+    flight_tok = flight.epoch_begin()
     losses = []
-    batch = None
-    ovf = jnp.zeros((), bool)   # flags of batches actually trained
-    pending = None              # flag of the in-flight (sampled) batch
+    completed = False
     truncated = False
-    for padded, mask in self._seed_batches():
-      if batch is None:
-        batch, pending = self._dispatch_prime(padded, mask)
-        continue
-      record_dispatch('fused_step')
-      state, loss, _, batch, ovf, pending = self._fused_fn(
-          state, batch, ovf, pending, self._sampler._fused_args(),
-          self._feats, self._id2i, self._labels, jnp.asarray(padded),
-          jnp.asarray(mask), self._sampler._next_key())
-      losses.append(loss)
-      if max_steps is not None and len(losses) >= max_steps:
-        truncated = True
-        break
-    if batch is not None and not truncated:
-      # natural epoch end: flush the last sampled batch with a plain
-      # train step. A max_steps break drops the pending batch instead —
-      # exactly max_steps optimizer updates, step-exact for benchmarks
-      # and LR schedules.
-      record_dispatch('train_step')
-      state, loss, _ = self._train_step(state, batch)
-      losses.append(loss)
-      ovf = jnp.logical_or(ovf, pending)
-    if guarded:
-      # hand the device-accumulated flag to the loader's guard: natural
-      # epoch end applies overflow_policy ('raise'/'warn'); a max_steps
-      # break leaves it for loader.check_overflow(). Only trained
-      # batches count — a dropped prefetch's flag is discarded with it.
-      self.loader._ovf_accum = ovf
-      if not truncated:
-        self.loader._finish_epoch_overflow()
+    try:
+      batch = None
+      ovf = jnp.zeros((), bool)   # flags of batches actually trained
+      pending = None              # flag of the in-flight (sampled) batch
+      for padded, mask in self._seed_batches():
+        if batch is None:
+          batch, pending = self._dispatch_prime(padded, mask)
+          continue
+        record_dispatch('fused_step')
+        state, loss, _, batch, ovf, pending = self._fused_fn(
+            state, batch, ovf, pending, self._sampler._fused_args(),
+            self._feats, self._id2i, self._labels, jnp.asarray(padded),
+            jnp.asarray(mask), self._sampler._next_key())
+        losses.append(loss)
+        if max_steps is not None and len(losses) >= max_steps:
+          truncated = True
+          break
+      if batch is not None and not truncated:
+        # natural epoch end: flush the last sampled batch with a plain
+        # train step. A max_steps break drops the pending batch instead
+        # — exactly max_steps optimizer updates, step-exact for
+        # benchmarks and LR schedules.
+        record_dispatch('train_step')
+        state, loss, _ = self._train_step(state, batch)
+        losses.append(loss)
+        ovf = jnp.logical_or(ovf, pending)
+      completed = True
+      if guarded:
+        # hand the device-accumulated flag to the loader's guard:
+        # natural epoch end applies overflow_policy ('raise'/'warn'); a
+        # max_steps break leaves it for loader.check_overflow(). Only
+        # trained batches count — a dropped prefetch's flag is
+        # discarded with it.
+        self.loader._ovf_accum = ovf
+        if not truncated:
+          self.loader._finish_epoch_overflow()
+    finally:
+      # per-epoch flight record (metrics/flight.py) — host deltas only;
+      # a mid-epoch failure still records, with completed=False
+      flight.end_for(
+          self, flight_tok, emitter=self._NAME, steps=len(losses),
+          completed=completed,
+          config=dict(trainer=self._NAME, batch_size=self._batch_size,
+                      fanouts=list(self._sampler.num_neighbors),
+                      num_classes=self.num_classes,
+                      seed=self.loader._batcher.seed),
+          extra={'truncated': truncated})
     return state, losses
 
 

@@ -201,19 +201,66 @@ class ScanTrainer(FusedEpochTrainer):
     seeds later steps would have seen)."""
     import jax
     import jax.numpy as jnp
+
+    from ..metrics import flight
     guarded, recompute = self.loader._overflow_epoch_start()
     if recompute:
       raise ValueError(_RECOMPUTE_MSG)
     self.loader._begin_epoch()
+    flight_tok = flight.epoch_begin()
+    epoch_no = self._epochs
     full_steps = self._epoch_steps()
     steps = full_steps
     truncated = False
     if max_steps is not None and max_steps < steps:
       steps, truncated = max_steps, True
     if steps <= 0:
+      # zero-batch epochs still record (the per-step loop writes a
+      # steps=0 line) so flight epoch counts line up across drivers
       empty = jnp.zeros((0,), jnp.float32)
+      flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
+                       steps=0, config=self._flight_config(),
+                       extra={'chunk_size': self.chunk_size,
+                              'truncated': truncated})
       return state, empty, empty
 
+    completed = False
+    # reset BEFORE the body: a failure in its staging prologue (fused
+    # args rebuild, carry device_puts) must read as 0 steps dispatched,
+    # not the previous epoch's stale count
+    self._steps_dispatched = 0
+    try:
+      state, losses, accs, ovf = self._run_epoch_body(
+          state, steps, full_steps)
+      completed = True
+      if guarded:
+        # same contract as OverlappedTrainer: natural epoch end applies
+        # overflow_policy; a max_steps break leaves the
+        # device-accumulated flag to loader.check_overflow()
+        self.loader._ovf_accum = ovf
+        if not truncated:
+          self.loader._finish_epoch_overflow()
+    finally:
+      # one JSONL flight record per epoch (metrics/flight.py): pure
+      # host counter deltas + wall — written OUTSIDE strict_guards,
+      # zero extra dispatches, zero device fetches. A mid-scan failure
+      # still records (completed=False), with the un-advanced epoch
+      # number the re-run will redraw and the steps the scan actually
+      # dispatched (chunk-granular), matching the per-step emitters'
+      # delivered-batch semantics
+      flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
+                       steps=(steps if completed else
+                              getattr(self, '_steps_dispatched', 0)),
+                       completed=completed,
+                       config=self._flight_config(),
+                       extra={'chunk_size': self.chunk_size,
+                              'truncated': truncated})
+    return state, losses, accs
+
+  def _run_epoch_body(self, state, steps, full_steps):
+    """The epoch program proper: seed draw + scanned chunks. Split out
+    so run_epoch owns only the guard/flight bracketing."""
+    import jax
     if self._seeds_dev is None:
       self._seeds_dev = jax.device_put(
           np.asarray(self.loader.input_seeds, dtype=np.int32))
@@ -249,6 +296,7 @@ class ScanTrainer(FusedEpochTrainer):
         losses.append(loss_k)
         accs.append(acc_k)
         start += k
+        self._steps_dispatched = start
       if len(losses) > 1:
         record_dispatch('metrics_concat')
         losses, accs = self._concat_fn(losses, accs)
@@ -258,15 +306,17 @@ class ScanTrainer(FusedEpochTrainer):
     # (checkpoint/resume and any later per-step sampling continue it)
     self._sampler._call_count += steps
     self._epochs += 1
+    return state, losses, accs, ovf
 
-    if guarded:
-      # same contract as OverlappedTrainer: natural epoch end applies
-      # overflow_policy; a max_steps break leaves the device-accumulated
-      # flag to loader.check_overflow()
-      self.loader._ovf_accum = ovf
-      if not truncated:
-        self.loader._finish_epoch_overflow()
-    return state, losses, accs
+  def _flight_config(self) -> dict:
+    """Static epoch-program configuration, fingerprinted into flight
+    records so a postmortem can group epochs by config across runs."""
+    return dict(trainer=self._NAME, batch_size=self._batch_size,
+                chunk_size=self.chunk_size,
+                fanouts=list(self._sampler.num_neighbors),
+                shuffle=self._shuffle, drop_last=self._drop_last,
+                num_classes=self.num_classes,
+                seed=self.loader._batcher.seed)
 
 
 class DistScanTrainer(DistFusedEpochTrainer):
@@ -475,9 +525,13 @@ class DistScanTrainer(DistFusedEpochTrainer):
     would have seen)."""
     import jax
     import jax.numpy as jnp
+
+    from ..metrics import flight
     guarded, recompute = self.loader._overflow_epoch_start()
     if recompute:   # unreachable after __init__'s check; kept for parity
       raise ValueError(_RECOMPUTE_MSG)
+    flight_tok = flight.epoch_begin()
+    epoch_no = self._epochs
     full_steps = len(self.loader)
     steps = full_steps
     truncated = False
@@ -494,7 +548,56 @@ class DistScanTrainer(DistFusedEpochTrainer):
           self.loader._finish_epoch_overflow()
       finally:
         self.loader._publish_feature_stats()
+        # zero-batch epochs still record, like the per-step loop's
+        # steps=0 line, so flight epoch counts line up across drivers
+        flight.epoch_end(flight_tok, emitter=self._NAME,
+                         epoch=epoch_no, steps=0,
+                         config=self._flight_config(),
+                         extra={'chunk_size': self.chunk_size,
+                                'truncated': truncated})
       return state, empty, empty
+
+    completed = False
+    # reset BEFORE the body: a failure in its staging prologue (the
+    # replicated-carry device_puts, program retraces) must read as 0
+    # steps dispatched, not the previous epoch's stale count
+    self._steps_dispatched = 0
+    try:
+      state, losses, accs, ovf = self._run_epoch_body(
+          state, steps, full_steps)
+      completed = True
+      if guarded:
+        # same contract as the local trainers: natural epoch end
+        # applies overflow_policy; a max_steps break leaves the flag to
+        # loader.check_overflow()
+        self.loader._ovf_accum = ovf
+        if not truncated:
+          self.loader._finish_epoch_overflow()
+    finally:
+      # also when the epoch fails mid-scan or the overflow guard raises
+      # — the per-step loop's finally-publish contract (the accumulator
+      # must drain per epoch; a dropped partial-epoch accumulator
+      # publishes zeros). Flight record AFTER publish_stats: the
+      # feature fields must bit-match the freshly published
+      # dist_feature.* counters. Host deltas only — outside
+      # strict_guards, zero extra dispatches; a failed epoch records
+      # completed=False under the un-advanced epoch number its re-run
+      # will redraw
+      self.loader._publish_feature_stats()
+      flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
+                       steps=(steps if completed else
+                              getattr(self, '_steps_dispatched', 0)),
+                       completed=completed,
+                       config=self._flight_config(),
+                       extra={'chunk_size': self.chunk_size,
+                              'truncated': truncated})
+    return state, losses, accs
+
+  def _run_epoch_body(self, state, steps, full_steps):
+    """The mesh epoch program proper: replicated carry staging + seed
+    draw + scanned chunks. Split out so run_epoch owns only the
+    guard/publish/flight bracketing."""
+    import jax
 
     from jax.sharding import NamedSharding, PartitionSpec
     repl = NamedSharding(self.mesh, PartitionSpec())
@@ -560,6 +663,7 @@ class DistScanTrainer(DistFusedEpochTrainer):
           losses.append(loss_k)
           accs.append(acc_k)
           start += k
+          self._steps_dispatched = start
         if len(losses) > 1:
           record_dispatch('dist_metrics_concat')
           losses, accs = self._concat_fn(losses, accs)
@@ -575,18 +679,17 @@ class DistScanTrainer(DistFusedEpochTrainer):
     # (checkpoint/resume and any later per-step sampling continue it)
     self._sampler._call_count += steps
     self._epochs += 1
+    return (self._train_state_cls(params, opt_state, stepc),
+            losses, accs, ovf)
 
-    state = self._train_state_cls(params, opt_state, stepc)
-    try:
-      if guarded:
-        # same contract as the local trainers: natural epoch end
-        # applies overflow_policy; a max_steps break leaves the flag to
-        # loader.check_overflow()
-        self.loader._ovf_accum = ovf
-        if not truncated:
-          self.loader._finish_epoch_overflow()
-    finally:
-      # also when the overflow guard raises — the per-step loop's
-      # finally-publish contract (the accumulator must drain per epoch)
-      self.loader._publish_feature_stats()
-    return state, losses, accs
+  def _flight_config(self) -> dict:
+    """Static epoch-program configuration for flight-record grouping
+    (mesh shape included: a resharded restart is a different config)."""
+    return dict(trainer=self._NAME, batch_size=self._batch_size,
+                chunk_size=self.chunk_size,
+                fanouts=self._sampler.num_neighbors,
+                shuffle=self.loader.shuffle,
+                num_partitions=self._nparts,
+                mesh={a: self.mesh.shape[a] for a in self._axes},
+                hetero=self.is_hetero, num_classes=self.num_classes,
+                seed=self.loader.seed)

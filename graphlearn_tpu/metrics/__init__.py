@@ -1,0 +1,71 @@
+"""graphlearn_tpu.metrics: the unified observability layer.
+
+Three pieces (docs/observability.md):
+
+* a typed, thread-safe, process-local metric registry — Counter /
+  Gauge / Histogram with fixed log-spaced buckets and p50/p95/p99
+  estimation (``registry``); ``utils.trace.counter_inc`` and friends
+  are thin compatibility shims over it, so every existing counter
+  call site feeds the same store;
+* cross-process scraping — ``scrape_all()`` assembles role-labelled
+  snapshots from this process, registered local sources, and every
+  connected sampling server (``DistServer.get_metrics`` RPC +
+  producer worker snapshots), ``merge_scrape`` folds them into one
+  cluster view;
+* the epoch flight recorder (``flight``) — one JSONL record per epoch
+  to ``GLT_RUN_LOG`` for postmortem diffing of long runs.
+
+The package is ZERO-DEPENDENCY (pure stdlib): mp sampling workers,
+bench tooling and the static analyzer's fixtures all import it
+without pulling jax. Metric names form a closed namespace —
+``registry_names.REGISTERED_METRICS`` — enforced by graftlint's
+``metric-registry`` rule.
+
+Idiomatic call forms (the forms the lint rule checks)::
+
+    from graphlearn_tpu import metrics
+    metrics.inc('resilience.retry')
+    metrics.observe('rpc.client.request_ms', dt_ms)
+    metrics.set_gauge('serving.queue_depth', n)
+    metrics.snapshot()           # this process
+    metrics.scrape_all()         # the cluster, role-labelled
+"""
+from . import flight
+from .registry import (BUCKET_SCHEMA, HIST_BOUNDS, Counter, Gauge,
+                       Histogram, MetricRegistry, default_registry,
+                       merge_snapshots, quantile_from_state)
+from .registry_names import REGISTERED_METRICS
+from .scrape import (merge_scrape, register_source, scrape_all,
+                     unregister_source)
+
+
+def counter(name: str) -> Counter:
+  return default_registry().counter(name)
+
+
+def gauge(name: str) -> Gauge:
+  return default_registry().gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+  return default_registry().histogram(name)
+
+
+def inc(name: str, n: int = 1):
+  default_registry().inc(name, n)
+
+
+def set_gauge(name: str, value: float):
+  default_registry().set_gauge(name, value)
+
+
+def observe(name: str, value: float):
+  default_registry().observe(name, value)
+
+
+def snapshot() -> dict:
+  return default_registry().snapshot()
+
+
+def reset(prefix: str = ''):
+  default_registry().reset(prefix)

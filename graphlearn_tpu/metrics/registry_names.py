@@ -1,0 +1,43 @@
+"""The closed inventory of metric names this package emits.
+
+graftlint's ``metric-registry`` rule (analysis/metric_names.py) parses
+this frozenset FROM SOURCE — it never imports the package — and checks
+every metric-emitting call site in ``graphlearn_tpu/`` against it:
+names must be string literals (or f-strings whose literal head matches
+a ``<prefix>.*`` wildcard entry below), and every entry must be
+documented in the docs/observability.md naming table. Adding a metric
+means registering it here and documenting it there, in the same change
+— the same closed-namespace discipline as utils/faults.py
+REGISTERED_SITES.
+
+Names are ``<subsystem>.<event>`` (one dot minimum; histograms end in
+a unit suffix like ``_ms``). Wildcard entries ``<prefix>.*`` cover
+families whose tails are minted at runtime (per-fault-site counters,
+the feature stores' published stat keys).
+"""
+
+REGISTERED_METRICS = frozenset({
+    # resilience events (distributed/resilience.py + consumers)
+    'resilience.retry',
+    'resilience.server_dead',
+    'resilience.failover',
+    'resilience.failover_seeds',
+    'resilience.worker_restart',
+    'resilience.producer_reaped',
+    # fault injection: one counter per armed site (utils/faults.py)
+    'fault.*',
+    # per-epoch feature-store stats published by publish_stats
+    # (distributed/dist_feature.py; label stores publish under
+    # dist_label so the headline dist_feature parity stays clean)
+    'dist_feature.*',
+    'dist_label.*',
+    # mp sampling workers (distributed/dist_sampling_producer.py)
+    'producer.batches',
+    'producer.sample_ms',
+    # RPC plane latencies (distributed/rpc.py, dist_server.py) — the
+    # p50/p99 substrate the serving tier gates on (ROADMAP item 1)
+    'rpc.client.request_ms',
+    'server.fetch_ms',
+    # scrape plumbing (metrics/scrape.py)
+    'metrics.scrape_error',
+})

@@ -165,20 +165,34 @@ _dispatch_counter: Optional[DispatchCounter] = None
 
 
 @contextlib.contextmanager
-def count_dispatches() -> Iterator[DispatchCounter]:
+def count_dispatches(propagate: bool = False) -> Iterator[DispatchCounter]:
   """Count instrumented program dispatches in the enclosed region.
 
   Yields the active DispatchCounter; read ``.total`` / ``.counts`` after
-  the block. Nesting restores the outer counter on exit (the inner
-  region's dispatches are NOT added to the outer count — each counter
-  owns its own region).
-  """
+  the block. Nesting restores the outer counter on exit; by default the
+  inner region's dispatches are NOT added to the outer count (each
+  counter owns its own region), which makes a nested bench region a
+  silent blind spot in the outer budget — pass ``propagate=True`` to
+  fold the inner region's per-site counts into the enclosing counter on
+  exit (a no-op at top level)."""
   global _dispatch_counter
   prev, _dispatch_counter = _dispatch_counter, DispatchCounter()
   try:
     yield _dispatch_counter
   finally:
-    _dispatch_counter = prev
+    inner, _dispatch_counter = _dispatch_counter, prev
+    if propagate and prev is not None:
+      for name, n in inner.counts.items():
+        prev.counts[name] = prev.counts.get(name, 0) + n
+
+
+def dispatch_snapshot() -> Optional[dict]:
+  """Copy of the active count_dispatches region's per-site counts, or
+  None when no region is active — the flight recorder's read hook
+  (metrics/flight.py diffs two snapshots into per-epoch deltas without
+  ever owning the region)."""
+  return dict(_dispatch_counter.counts) \
+      if _dispatch_counter is not None else None
 
 
 def record_dispatch(name: str = 'program'):
@@ -214,60 +228,84 @@ def wrap_dispatch(fn: Callable, name: Optional[str] = None) -> Callable:
 # DistFeature.publish_stats() at EPOCH granularity — the counters ride
 # the lookup program between publishes, so the hot loop never pays a
 # device->host fetch for observability (PERF.md rules).
-# Process-local; increments come from many threads at once
-# (heartbeat probes, pullers, RPC handler threads), and a dict
-# read-modify-write can interleave at bytecode boundaries, so a lock
-# guards the add. Read with counters()/counter_get, zero with
-# reset_counters().
-import threading as _threading
+#
+# These four are COMPATIBILITY SHIMS over the typed metric registry
+# (graphlearn_tpu/metrics/registry.py, which subsumed the dict that
+# used to live here): every call site keeps working, and the counters
+# now appear in metrics.snapshot() / scrape_all() / the epoch flight
+# recorder alongside gauges and histograms. Thread-safety moved with
+# the store (the registry locks every mutation). Lazy import: metrics
+# is a sibling package and utils must stay importable first.
 
-_counters: dict = {}
-_counters_lock = _threading.Lock()
+_metric_registry = None
+
+
+def _registry():
+  global _metric_registry
+  if _metric_registry is None:
+    from ..metrics.registry import default_registry
+    _metric_registry = default_registry()
+  return _metric_registry
 
 
 def counter_inc(name: str, n: int = 1):
   """Add ``n`` to the named event counter (creating it at 0)."""
-  with _counters_lock:
-    _counters[name] = _counters.get(name, 0) + n
+  _registry().inc(name, n)
 
 
 def counter_get(name: str) -> int:
-  with _counters_lock:
-    return _counters.get(name, 0)
+  return _registry().counter_value(name)
 
 
 def counters(prefix: str = '') -> dict:
   """Snapshot of counters, optionally filtered by name prefix."""
-  with _counters_lock:
-    return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+  return _registry().counters(prefix)
 
 
 def reset_counters(prefix: str = ''):
-  """Zero counters matching ``prefix`` (all by default)."""
-  with _counters_lock:
-    for k in list(_counters):
-      if k.startswith(prefix):
-        del _counters[k]
+  """Drop counters matching ``prefix`` (all by default). Shim note:
+  this clears COUNTERS only, exactly the old dict semantics — gauges
+  and histograms are reset through metrics.reset()."""
+  _registry().reset_counters(prefix)
 
 
 _active = False
 
 
 def maybe_start_trace(env_var: str = 'GLT_PROFILE_DIR') -> Optional[str]:
-  """Start a trace if ``env_var`` names a directory; returns the dir."""
+  """Start a trace if ``env_var`` names a directory; returns the dir.
+
+  Exception-safe: a ``start_trace`` that raises (unwritable dir, a
+  profiler session another tool left open) must leave ``_active``
+  False AND best-effort-close any half-opened profiler session —
+  otherwise the next maybe_start_trace either silently no-ops for the
+  rest of the run or trips over the orphaned session."""
   global _active
   logdir = os.environ.get(env_var)
   if logdir and not _active:
     import jax
-    jax.profiler.start_trace(logdir)
+    try:
+      jax.profiler.start_trace(logdir)
+    except BaseException:
+      _active = False
+      try:       # close a partially-started session so a later start
+        jax.profiler.stop_trace()   # isn't wedged by the orphan
+      except Exception:  # noqa: BLE001 - cleanup of a failed start
+        pass
+      raise
     _active = True
     return logdir
   return None
 
 
 def stop_trace():
+  """Stop the maybe_start_trace() session. Exception-safe: ``_active``
+  is cleared FIRST — a stop_trace that raises (trace-write failure)
+  must not leave the flag stuck True, where every later
+  maybe_start_trace would silently no-op and the run would quietly
+  produce no traces at all."""
   global _active
   if _active:
     import jax
-    jax.profiler.stop_trace()
     _active = False
+    jax.profiler.stop_trace()

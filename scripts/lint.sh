@@ -26,4 +26,10 @@ fi
 echo "== bench schema =="
 python bench.py --validate || rc=1
 
+echo "== bench trajectory gate =="
+# >20% round-over-round regression on a declared lower-is-better key
+# (BENCH_LOWER_IS_BETTER) fails the gate; rounds without numbers are
+# skipped, so a relay-down round never masks or fakes a regression
+python bench.py --gate || rc=1
+
 exit "$rc"

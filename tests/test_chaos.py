@@ -69,13 +69,16 @@ def _chaos_server_main(rank, q, ready, faults_spec=None):
 
 
 @pytest.mark.slow  # tier-1 budget: injected-fetch failover variants stay
-def test_sigkill_server_mid_epoch_failover():
+def test_sigkill_server_mid_epoch_failover(monkeypatch, tmp_path):
   """Acceptance: 2 sampling servers, SIGKILL one mid-epoch — the remote
   loader detects the death (TCP reset / heartbeat), redistributes the
   victim's unacked seeds to the survivor, and completes the epoch with
   the exact expected batch count and full seed coverage. A second epoch
   then runs against the degraded cluster (dead rank failed over at
-  epoch start)."""
+  epoch start). With GLT_RUN_LOG armed, the degraded epoch's flight
+  record carries the failover counters (observability acceptance)."""
+  run_log = tmp_path / 'chaos.jsonl'
+  monkeypatch.setenv('GLT_RUN_LOG', str(run_log))
   ctx = mp.get_context('spawn')
   q = ctx.Queue()
   ready = ctx.Event()
@@ -122,6 +125,21 @@ def test_sigkill_server_mid_epoch_failover():
     assert trace.counter_get('resilience.failover') >= 1
     # within the retry/deadline budget, not the 180 s socket timeout
     assert elapsed < 120, f'epoch took {elapsed:.0f}s'
+    # the SIGKILL-failover epoch's flight record shows the failover:
+    # one JSONL line, resilience deltas matching the live counters
+    from graphlearn_tpu.metrics import flight
+    recs = flight.read_records(str(run_log))
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec['emitter'] == 'RemoteDistNeighborLoader'
+    assert rec['completed'] is True and rec['steps'] == expected
+    assert rec['resilience']['resilience.failover'] == \
+        trace.counter_get('resilience.failover')
+    # 0-valued increments produce no delta (a kill landing after every
+    # victim seed was acked redistributes nothing) — compare via get
+    assert rec['resilience'].get('resilience.failover_seeds', 0) == \
+        trace.counter_get('resilience.failover_seeds')
+    assert '1' in rec['dead_ranks']
 
     # epoch 2 on the degraded cluster: dead rank's full share fails
     # over at epoch start, batch count and coverage still exact
@@ -163,12 +181,17 @@ def _start_inproc_server(dataset, secret=None):
   return s, rpc
 
 
-def test_injected_fetch_failure_triggers_failover():
+def test_injected_fetch_failure_triggers_failover(monkeypatch, tmp_path):
   """The channel.remote.fetch fault site stands in for a dropped
   connection: one fetch raises, the (server, producer) pair is declared
   dead, and the loader completes the epoch by failing the pair's
-  unacked seeds over to the surviving server — no real process dies."""
+  unacked seeds over to the surviving server — no real process dies.
+  Tier-1 flight-record representative: the failover epoch's JSONL
+  record carries the resilience counters (the slow SIGKILL variant
+  asserts the same for a real process death)."""
   from graphlearn_tpu.distributed import dist_client
+  run_log = tmp_path / 'failover.jsonl'
+  monkeypatch.setenv('GLT_RUN_LOG', str(run_log))
   ds = make_dataset()
   pairs = [_start_inproc_server(ds) for _ in range(2)]
   try:
@@ -193,6 +216,12 @@ def test_injected_fetch_failure_triggers_failover():
     assert sorted(seen) == list(range(N))
     assert trace.counter_get('fault.channel.remote.fetch') == 1
     assert trace.counter_get('resilience.failover') == 1
+    from graphlearn_tpu.metrics import flight
+    rec = flight.read_records(str(run_log))[-1]
+    assert rec['emitter'] == 'RemoteDistNeighborLoader'
+    assert rec['completed'] is True and rec['steps'] == expected
+    assert rec['resilience']['resilience.failover'] == 1
+    assert rec['fault']['fault.channel.remote.fetch'] == 1
     loader.shutdown()
   finally:
     faults.disarm()
@@ -218,6 +247,7 @@ def make_hetero_dataset():
   return ds
 
 
+@pytest.mark.slow  # tier-1 budget: the homo injected-fetch failover stays
 def test_injected_fetch_failure_failover_hetero():
   """Failover for TYPED seeds: the replacement producers must re-ship
   NodeSamplerInputs with the input type, or the surviving server's

@@ -164,6 +164,7 @@ def test_dist_scan_matches_per_step_homo_8dev():
                   chunk=2)
 
 
+@pytest.mark.slow  # tier-1 budget: homo_8dev stays the equivalence rep
 def test_dist_scan_matches_per_step_hetero():
   """Typed engine equivalence on a 2-partition mesh: the scanned chunk
   inlines _hetero_engine + per-ntype cached feature lookups (one stats

@@ -469,6 +469,7 @@ def test_server_client_hetero_end_to_end():
   assert not server.is_alive()
 
 
+@pytest.mark.slow  # tier-1 budget: mp neighbor/hetero/link stay tier-1
 def test_mp_dist_hetero_link_loader():
   """HETERO LINK sampling through the mp producers (round 5): typed
   seed edges ((src,rel,dst), [2,E]) ride the LinkLoader tuple
