@@ -400,6 +400,13 @@ BENCH_KEY_REGISTRY = {
     # RUN_MEAN_IMPL decision pair (VERDICT r5)
     'run_mean_impl_reshape_ms': 'e2e step ms with RUN_MEAN_IMPL=reshape',
     'run_mean_impl_window_ms': 'e2e step ms with RUN_MEAN_IMPL=window',
+    # serving tier (PR 7): offline materialization + online endpoint
+    'embed_epoch_wall_s': 'full-graph layer-wise materialization wall s',
+    'embed_epoch_dispatches': 'materialization dispatches, all layers',
+    'serving_qps_per_chip': 'ServingEngine sustained lookups/s per chip',
+    'serving_p50_ms': 'serving.total_ms p50 under the bench load',
+    'serving_p99_ms': 'serving.total_ms p99 under the bench load',
+    'serving_config': 'graph/bucket/load shape of the serving figures',
     # hetero train steps
     'hetero_rgnn_step_ms_bf16': 'RGNN (sage) e2e step ms',
     'hetero_rgnn_train_program_ms': 'RGNN train program device ms',
@@ -421,7 +428,7 @@ BENCH_KEY_REGISTRY = {
 # run_mean_impl_reshape_ms_error)
 BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
-    'hetero_step', 'hetero_ref', 'feature_exchange',
+    'hetero_step', 'hetero_ref', 'feature_exchange', 'serving',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -441,6 +448,8 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'dist_scan_epoch_dispatches', 'dist_scan_epoch_wall_s',
     'feature_exchange_mb_per_batch',
     'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
+    'embed_epoch_wall_s', 'embed_epoch_dispatches',
+    'serving_p50_ms', 'serving_p99_ms',
     'hetero_rgnn_step_ms_bf16', 'hetero_rgnn_train_program_ms',
     'hetero_rgat_step_ms_bf16', 'hetero_rgat_train_program_ms',
     'hetero_rgnn_ref_step_ms_bf16', 'hetero_rgnn_ref_train_program_ms',
@@ -1091,8 +1100,95 @@ def main():
     result['feature_exchange_mb_per_batch'] = None
     result['feature_exchange_error'] = f'{type(e).__name__}: {e}'[:200]
 
-  # the ONLY device->host fetch in the bench, after every trace is
-  # captured (PERF.md: the first fetch degrades later dispatches).
+  # ---- serving tier (PR 7): offline materialization + online QPS ----
+  # LAST measured section by design: the serving path fetches rows per
+  # batch (that IS the product — e2e latency includes the fetch), and
+  # on the axon runtime the first fetch degrades later dispatches
+  # (PERF.md), so nothing dispatch-sensitive may run after this point.
+  # A smaller dedicated graph keeps the padded full-neighbor table
+  # bounded; the config key records the shape.
+  try:
+    import threading
+
+    from graphlearn_tpu import metrics as glt_metrics
+    from graphlearn_tpu.models import GraphSAGE
+    from graphlearn_tpu.serving import EmbeddingMaterializer, ServingEngine
+    sv_n, sv_deg, sv_f = 200_000, 8, 64
+    sv_rng = np.random.default_rng(11)
+    sv_rows = np.repeat(np.arange(sv_n), sv_deg)
+    sv_cols = sv_rng.integers(0, sv_n, sv_rows.shape[0])
+    sv_ds = glt.data.Dataset()
+    sv_ds.init_graph(np.stack([sv_rows, sv_cols]), graph_mode='CPU',
+                     num_nodes=sv_n)
+    sv_ds.init_node_features(
+        sv_rng.standard_normal((sv_n, sv_f)).astype(np.float32))
+    sv_model = GraphSAGE(hidden_dim=128, out_dim=64, num_layers=2)
+    sv_x0 = sv_ds.node_features.feature_array[:64]
+    sv_ei0 = np.stack([np.arange(64, dtype=np.int32),
+                       np.arange(64, dtype=np.int32)])
+    sv_params = sv_model.init(jax.random.PRNGKey(0), sv_x0, sv_ei0,
+                              np.ones(64, bool))
+    mat = EmbeddingMaterializer(sv_ds, sv_model, sv_params,
+                                block_size=1024, chunk_size=16,
+                                neighbor_cap=sv_deg)
+    from graphlearn_tpu.utils import count_dispatches
+    with count_dispatches() as sv_dc:
+      t0 = time.perf_counter()
+      sv_emb = mat.materialize()
+      jax.block_until_ready(sv_emb)
+      sv_wall = time.perf_counter() - t0
+    result['embed_epoch_wall_s'] = round(sv_wall, 3)
+    result['embed_epoch_dispatches'] = sv_dc.total
+    # online endpoint: sustained concurrent lookups for ~2s
+    glt_metrics.reset('serving')
+    engine = ServingEngine(mat.embedding_store(),
+                           buckets=(64, 256, 1024), max_wait_ms=1.0)
+    sv_stop = time.perf_counter() + 2.0
+    sv_done = []
+    sv_errs = []
+
+    def sv_client(seed):
+      # exceptions must reach the section's error record — a dead
+      # client thread would otherwise record 7/8 traffic as a clean
+      # (regressed-looking) QPS/latency round
+      try:
+        crng = np.random.default_rng(seed)
+        n_ok = 0
+        while time.perf_counter() < sv_stop:
+          ids = crng.integers(0, sv_n, 16)
+          engine.lookup(ids)
+          n_ok += 1
+        sv_done.append(n_ok)
+      except BaseException as e:  # noqa: BLE001
+        sv_errs.append(e)
+
+    with engine:
+      sv_t0 = time.perf_counter()
+      threads = [threading.Thread(target=sv_client, args=(i,))
+                 for i in range(8)]
+      for th in threads:
+        th.start()
+      for th in threads:
+        th.join()
+      sv_span = time.perf_counter() - sv_t0
+    if sv_errs:
+      raise RuntimeError(f'{len(sv_errs)}/8 serving clients failed: '
+                         f'{sv_errs[0]!r}')
+    n_req = sum(sv_done)
+    n_chips = max(len(jax.devices()), 1)
+    result['serving_qps_per_chip'] = round(n_req / sv_span / n_chips, 1)
+    pct = glt_metrics.histogram('serving.total_ms').percentiles()
+    result['serving_p50_ms'] = round(pct['p50'], 3)
+    result['serving_p99_ms'] = round(pct['p99'], 3)
+    result['serving_config'] = (
+        f'N={sv_n}, deg={sv_deg}, F={sv_f}, 2-layer SAGE h128->64, '
+        'block 1024 x K16; 8 clients x 16-id lookups, buckets '
+        '(64, 256, 1024), max_wait 1ms')
+  except Exception as e:
+    result['serving_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # the final device->host fetch, after every trace is captured
+  # (PERF.md: the first fetch degrades later dispatches).
   # null (not false) when the ref runs never produced a loader — a
   # failed run must not read as 'ran clean, no truncation'
   try:

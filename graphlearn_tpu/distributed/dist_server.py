@@ -271,6 +271,25 @@ class DistServer:
 
   # -- misc (reference: dist_server.py:60-102) -----------------------------
 
+  def register_serving_engine(self, engine):
+    """Attach an online embedding endpoint (serving.ServingEngine) so
+    remote clients can look embeddings up through the ``serve`` RPC —
+    the server-client topology's inference plane (docs/serving.md)."""
+    self._serving = engine
+
+  def serve(self, ids):
+    """Embedding lookup RPC: ids -> [n, F] numpy rows. Routed through
+    the engine's admission queue, so remote traffic batches with local
+    traffic into the same calibrated bucket programs. READ-ONLY and
+    idempotent by construction (like get_metrics) — clients call it
+    with ``idempotent=True`` and it retries safely under the fault
+    registry (docs/failure_model.md)."""
+    engine = getattr(self, '_serving', None)
+    if engine is None:
+      raise RuntimeError('no serving engine registered on this server '
+                         '(DistServer.register_serving_engine)')
+    return engine.serve_numpy(np.asarray(ids, np.int64))
+
   def get_dataset_meta(self):
     g = self.dataset.graph
     if isinstance(g, dict):     # hetero: per-etype counts
@@ -335,6 +354,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
           'get_dataset_meta': s.get_dataset_meta,
           'heartbeat': s.heartbeat,
           'get_metrics': s.get_metrics,
+          'serve': s.serve,
           'exit': s.exit,
           'client_barrier': barrier.arrive,
       })

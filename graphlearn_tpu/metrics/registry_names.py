@@ -40,4 +40,13 @@ REGISTERED_METRICS = frozenset({
     'server.fetch_ms',
     # scrape plumbing (metrics/scrape.py)
     'metrics.scrape_error',
+    # online serving endpoint (serving/engine.py) — the end-to-end
+    # latency/throughput surface bench.py --gate regression-tracks
+    'serving.requests',
+    'serving.batches',
+    'serving.refreshed',
+    'serving.queue_wait_ms',
+    'serving.batch_fill',
+    'serving.compute_ms',
+    'serving.total_ms',
 })

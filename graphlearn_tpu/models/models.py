@@ -451,8 +451,21 @@ class GraphSAGE(nn.Module):
   fanouts: Any = None
 
   @nn.compact
-  def __call__(self, x, edge_index, edge_mask, train: bool = False):
+  def __call__(self, x, edge_index, edge_mask, train: bool = False,
+               layers=None):
     layered = self.hop_node_offsets is not None
+    if layers is not None:
+      # layer slice (serving tier): run only conv layers [lo, hi) of the
+      # SAME forward definition — the full-graph materializer and the
+      # final-layer refresh call this, so trained and served models can
+      # never drift (models.train.make_layer_slice_fn). Slices keep the
+      # full-width segment path: the layered/dense forwards are batch-
+      # layout optimizations that have no meaning on full-graph blocks.
+      assert not layered and not self.tree_dense and not self.merge_dense, (
+          'layer slices run the plain segment forward — build the '
+          'serving model without hop offsets / dense flags')
+      lo, hi = layers
+      assert 0 <= lo <= hi <= self.num_layers, (layers, self.num_layers)
     if self.tree_dense:
       assert layered, 'tree_dense requires hop_node/edge_offsets'
       assert self.aggr == 'mean', 'tree_dense implements mean aggregation'
@@ -481,6 +494,8 @@ class GraphSAGE(nn.Module):
           'models.train.tree_hop_offsets for tree batches, '
           'merge_hop_offsets for exact-dedup batches')
     for i in range(self.num_layers):
+      if layers is not None and not (layers[0] <= i < layers[1]):
+        continue   # homo convs carry explicit names (conv{i}): safe skip
       dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
       if layered:
         hops_used = self.num_layers - i
@@ -527,8 +542,11 @@ class GCN(nn.Module):
   dtype: Any = None
 
   @nn.compact
-  def __call__(self, x, edge_index, edge_mask, train: bool = False):
+  def __call__(self, x, edge_index, edge_mask, train: bool = False,
+               layers=None):
     for i in range(self.num_layers):
+      if layers is not None and not (layers[0] <= i < layers[1]):
+        continue   # layer slice (see GraphSAGE): explicit conv{i} names
       dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
       x = GCNConv(dim, dtype=self.dtype, name=f'conv{i}')(
           x, edge_index, edge_mask)
@@ -558,8 +576,16 @@ class GAT(nn.Module):
   fanouts: Any = None
 
   @nn.compact
-  def __call__(self, x, edge_index, edge_mask, train: bool = False):
+  def __call__(self, x, edge_index, edge_mask, train: bool = False,
+               layers=None):
     layered = self.hop_node_offsets is not None
+    if layers is not None:
+      # layer slice (see GraphSAGE): serving's full-graph blocks run the
+      # plain segment forward only
+      assert not layered and not self.tree_dense and not self.merge_dense, (
+          'layer slices run the plain segment forward — build the '
+          'serving model without hop offsets / dense flags')
+      assert 0 <= layers[0] <= layers[1] <= self.num_layers
     if self.tree_dense:
       assert layered and self.fanouts is not None, (
           'tree_dense GAT requires hop offsets + the true fanouts')
@@ -579,6 +605,8 @@ class GAT(nn.Module):
           'models.train.tree_hop_offsets for tree batches, '
           'merge_hop_offsets for exact-dedup batches')
     for i in range(self.num_layers):
+      if layers is not None and not (layers[0] <= i < layers[1]):
+        continue   # explicit conv{i} names: safe skip
       last = i == self.num_layers - 1
       dim = self.out_dim if last else self.hidden_dim
       heads = 1 if last else self.heads
@@ -982,10 +1010,26 @@ class RGNN(nn.Module):
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict,
-               train: bool = False):
+               train: bool = False, layers=None, embed: bool = True,
+               head=None):
     hier = self.hop_node_offsets is not None
     hop_edge_offsets = thaw_etype_items(self.hop_edge_offsets)
     assert not (self.tree_dense and self.merge_dense)
+    if layers is not None:
+      # layer slice (serving tier; see GraphSAGE): conv layers [lo, hi)
+      # of the SAME forward definition. ``embed`` gates the per-type
+      # input Dense (the materializer runs it as its own row-local
+      # pass), ``head`` gates the final lin_out (None = the full
+      # forward's out_ntype behavior). Skipped layers still CONSTRUCT
+      # their conv modules: the per-etype convs are auto-named in
+      # construction order (SAGEConv_0, ...), so skipping construction
+      # would silently rebind a later layer onto an earlier layer's
+      # params — flax assigns names at construction, not call
+      # (tests/test_serving.py pins the slice-vs-full parity).
+      assert not hier and not self.tree_dense and not self.merge_dense, (
+          'layer slices run the plain segment forward — build the '
+          'serving model without hop offsets / dense flags')
+      assert 0 <= layers[0] <= layers[1] <= self.num_layers
     if self.tree_dense or self.merge_dense:
       assert hier and self.tree_records is not None, (
           'RGNN dense paths require hop offsets + tree_records '
@@ -994,9 +1038,10 @@ class RGNN(nn.Module):
       check_hetero_offsets(x_dict, edge_index_dict,
                            self.hop_node_offsets, hop_edge_offsets,
                            self.num_layers)
-    x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
-                          name=f'embed_{t}')(x)
-              for t, x in x_dict.items()}
+    if embed:
+      x_dict = {t: nn.Dense(self.hidden_dim, dtype=self.dtype,
+                            name=f'embed_{t}')(x)
+                for t, x in x_dict.items()}
     # reference structure (examples/igbh/rgnn.py:37-56): with a predict
     # type, every conv layer keeps hidden_dim and a final Linear maps
     # to out_dim; GAT uses dim // heads per head with concat on EVERY
@@ -1033,15 +1078,23 @@ class RGNN(nn.Module):
             name=f'hetero{i}')(x_in, em,
                                ei if mode == 'merge' else None)
       else:
+        # constructed even for layers a slice skips: construction order
+        # assigns the per-etype convs' auto-names (see the layers note
+        # above) — only the CALL is skipped
         convs = {tuple(et): SAGEConv(conv_dim, dtype=self.dtype)
                  if self.conv == 'sage'
                  else GATConv(conv_dim, heads=self.heads, concat=True,
                               dtype=self.dtype)
                  for et in self.etypes}
+        if layers is not None and not (layers[0] <= i < layers[1]):
+          continue
         x_dict = HeteroConv(convs, name=f'hetero{i}')(x_in, ei, em)
       if not last:
         x_dict = {t: nn.relu(v) for t, v in x_dict.items()}
-    if lin_out:
+    if head is None:
+      head = lin_out
+    if head:
+      assert lin_out, 'head=True requires out_ntype'
       return nn.Dense(self.out_dim, dtype=self.dtype,
                       name='lin_out')(x_dict[self.out_ntype])
     return x_dict

@@ -28,6 +28,40 @@ def create_train_state(model, rng, sample_batch, lr: float = 3e-3,
   return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
 
 
+def make_forward_fn(model):
+  """THE forward definition: ``(params, batch) -> model output`` over
+  the flat batch dict (homo arrays or hetero per-type dicts — the model
+  owns the signature). Training loss (:func:`make_loss_fn`), evaluation
+  (:func:`make_eval_counts`), link prediction, the serving tier's
+  full-graph layer materialization and its final-layer refresh
+  (graphlearn_tpu/serving/) ALL resolve through this one function, so a
+  trained checkpoint and the embeddings served from it can never drift.
+  Extra keyword arguments pass through to ``model.apply`` (the layer
+  slice below uses this)."""
+
+  def forward(params, batch, **kwargs):
+    return model.apply(params, batch['x'], batch['edge_index'],
+                       batch['edge_mask'], **kwargs)
+
+  return forward
+
+
+def make_layer_slice_fn(model, lo: int, hi: int, **fixed):
+  """Layer-slice view of :func:`make_forward_fn`: run only conv layers
+  ``[lo, hi)`` of the SAME forward definition (``layers=(lo, hi)`` on
+  the model call — models supporting it: GraphSAGE/GCN/GAT/RGNN).
+  ``fixed`` forwards extra static call kwargs (RGNN's ``embed``/
+  ``head``). This is the serving tier's materialization/refresh hook:
+  layer l of the offline embedding program and the online final-layer
+  refresh are slices of the training forward, not re-implementations."""
+  fwd = make_forward_fn(model)
+
+  def slice_fwd(params, batch):
+    return fwd(params, batch, layers=(lo, hi), **fixed)
+
+  return slice_fwd
+
+
 def make_loss_fn(model, num_classes: int):
   """Masked seed-slot cross-entropy ``(params, batch) -> (loss, acc)``
   — ONE definition shared by the local jitted step and the distributed
@@ -35,11 +69,12 @@ def make_loss_fn(model, num_classes: int):
   scanned-vs-per-step bit-equivalence bar can never drift on the loss.
   Works for homo batches (array x/edge_index/edge_mask) and hetero
   batches (per-type dicts, seed-type logits/y) alike — the model owns
-  the signature."""
+  the signature (the forward resolves through make_forward_fn, the same
+  definition the serving tier materializes from)."""
+  forward = make_forward_fn(model)
 
   def loss_fn(params, batch):
-    logits = model.apply(params, batch['x'], batch['edge_index'],
-                         batch['edge_mask'])
+    logits = forward(params, batch)
     logits = logits.astype(jnp.float32)  # loss in f32 under bf16 compute
     # seed slots lead both buffers; y may be seed-block-sized
     # (seed_labels_only loaders) or full-buffer-sized — either way only
@@ -87,10 +122,11 @@ def make_eval_counts(model):
   accuracy can be accumulated without host fetches (PERF.md rules) and
   aggregated exactly across uneven batches."""
 
+  forward = make_forward_fn(model)
+
   @jax.jit
   def eval_counts(params, batch):
-    logits = model.apply(params, batch['x'], batch['edge_index'],
-                         batch['edge_mask'])
+    logits = forward(params, batch)
     # common prefix (see make_train_step loss_fn)
     n = min(logits.shape[0], batch['y'].shape[0])
     seed_mask = jnp.arange(n) < batch['num_seed_nodes']
@@ -136,10 +172,10 @@ def make_link_train_step(model, tx):
   (1 for positives, 0 for the sampled negatives — the reference's
   unsupervised SAGE objective, examples/graph_sage_unsup_ppi.py loss).
   Pairs with -1 indices (masked negatives / pad seeds) are excluded."""
+  forward = make_forward_fn(model)
 
   def loss_fn(params, batch):
-    h = model.apply(params, batch['x'], batch['edge_index'],
-                    batch['edge_mask']).astype(jnp.float32)
+    h = forward(params, batch).astype(jnp.float32)
     eli = batch['edge_label_index']
     lab = batch['edge_label'].astype(jnp.float32)
     valid = (eli[0] >= 0) & (eli[1] >= 0)

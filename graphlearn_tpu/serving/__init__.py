@@ -1,0 +1,27 @@
+"""graphlearn_tpu.serving: the inference tier (docs/serving.md).
+
+Two halves (ROADMAP item 1):
+
+* **Offline**: :class:`EmbeddingMaterializer` — layer-wise full-graph
+  embedding materialization as a closed set of scanned fixed-shape
+  programs (the ScanTrainer chunk pattern, no sampling), each layer's
+  output becoming the next layer's feature store (O(N·F) memory).
+* **Online**: :class:`ServingEngine` — admission batching into
+  calibrated padded buckets over an :class:`EmbeddingStore` (single
+  replica) or :class:`DistEmbeddingStore` (DistFeature-backed sharded
+  store with the replicated hot-embedding cache), with final-layer-only
+  refresh for stale nodes and ``serving.*`` latency histograms.
+
+Both halves resolve the model forward through
+``models.train.make_forward_fn`` / ``make_layer_slice_fn`` — the same
+definition training optimizes, so trained and served models cannot
+drift.
+"""
+from .engine import DEFAULT_BUCKETS, ServingEngine
+from .materialize import EmbeddingMaterializer, padded_neighbors
+from .store import DistEmbeddingStore, EmbeddingStore
+
+__all__ = [
+    'DEFAULT_BUCKETS', 'DistEmbeddingStore', 'EmbeddingMaterializer',
+    'EmbeddingStore', 'ServingEngine', 'padded_neighbors',
+]

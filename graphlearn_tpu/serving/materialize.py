@@ -1,0 +1,655 @@
+"""Offline layer-wise full-graph embedding materialization.
+
+The serving tier's offline half (ROADMAP item 1; DCI, arxiv 2503.01281,
+is the workload-aware inference exemplar): compute EVERY node's layer-l
+embedding layer by layer, so the online endpoint answers lookups from a
+precomputed table instead of running a sampled multi-hop forward per
+request. GNNSampler (arxiv 2108.11571) argues inference hot paths should
+run on hardware-matched static shapes — here the whole pass is a closed
+set of fixed-shape programs:
+
+  * **No sampling.** Each node aggregates over its FULL neighbor list,
+    padded to a static width ``W`` (the max stored degree, or an
+    explicit ``neighbor_cap`` for approximate serving) — the
+    ``padded_neighbors`` table, built once per graph.
+  * **Contiguous row blocks.** A layer pass walks the node table in
+    ``block_size`` blocks; each block's forward consumes
+    ``[B + B*W, F]`` rows sliced/gathered from the PREVIOUS layer's
+    store and writes ``[B, F_out]`` rows into the next store — the
+    ScanTrainer chunk pattern verbatim: a ``lax.scan`` over K blocks
+    per dispatch, chunk position entering as a device scalar so every
+    full chunk reuses one executable.
+  * **Donated buffers, O(N·F) memory.** The output store rides the
+    scan carry and is donated across chunk dispatches; layer l's output
+    BECOMES layer l+1's feature store, so peak HBM is two stores (the
+    one being read and the one being written), never O(N·F·L).
+  * **Dispatch budget**: one store-init program + ceil(blocks/K) chunk
+    programs per layer — within the ``ceil(chunks) + 2``-per-layer
+    budget tests assert under ``GLT_STRICT`` (utils/strict.py), where
+    the whole pass runs under ``jax.transfer_guard('disallow')``.
+
+The per-layer forward is NOT a re-implementation: it calls
+``models.train.make_layer_slice_fn`` — a slice of the exact forward
+definition training optimizes (``make_forward_fn``), so trained and
+served models cannot drift. Heterogeneous graphs (RGNN) materialize
+per-type stores with per-edge-type padded adjacencies; the per-type
+embed projection and the final ``lin_out`` head run as their own
+row-local passes.
+
+Each layer pass appends one flight record (``metrics.flight``) when
+``GLT_RUN_LOG`` is set — materialization epochs diff like training
+epochs.
+"""
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..models import train as train_lib
+from ..typing import reverse_edge_type
+from ..utils.strict import strict_guards
+from ..utils.trace import record_dispatch
+
+
+def padded_neighbors(topo, neighbor_cap: Optional[int] = None):
+  """[N, W] int32 padded full-neighbor table from a stored Topology.
+
+  Row ``v`` holds v's stored neighbor list (the same grouping the
+  samplers draw from: out-edges for ``edge_dir='out'``, in-edges for
+  ``'in'``), padded with -1 to ``W = max degree`` (or ``neighbor_cap``,
+  which TRUNCATES heavier nodes — approximate serving for degree-skewed
+  graphs; exact parity requires the full width). Built once per graph
+  on the host; the device copy is the materializer's only O(N·W) input.
+  """
+  indptr = np.asarray(topo.indptr, np.int64)
+  indices = np.asarray(topo.indices, np.int64)
+  n = indptr.shape[0] - 1
+  deg = np.diff(indptr)
+  w = int(deg.max()) if neighbor_cap is None else int(neighbor_cap)
+  w = max(w, 1)
+  nbr = np.full((n, w), -1, np.int32)
+  if indices.size:
+    key = np.repeat(np.arange(n), deg)
+    off = np.arange(indices.shape[0]) - np.repeat(indptr[:-1], deg)
+    keep = off < w
+    nbr[key[keep], off[keep]] = indices[keep]
+  return nbr
+
+
+def _block_edges(b: int, w: int) -> np.ndarray:
+  """The constant [2, b*w] block-graph COO: each of the block's ``b``
+  target slots (node rows [0, b)) receives from its ``w`` neighbor
+  slots (node rows [b, b + b*w), in row-major order) — the layout every
+  chunk shares, uploaded once."""
+  row = b + np.arange(b * w, dtype=np.int32)
+  col = np.repeat(np.arange(b, dtype=np.int32), w)
+  return np.stack([row, col])
+
+
+class EmbeddingMaterializer:
+  """Layer-wise full-graph embedding program over a Dataset + trained
+  params.
+
+  Args:
+    dataset: the (homogeneous or heterogeneous) ``data.Dataset`` whose
+      graph/features to materialize over.
+    model: the TRAINED model (GraphSAGE/GAT homo, RGNN hetero) — built
+      WITHOUT hop offsets / dense flags (layer slices run the plain
+      segment forward; the layered forms are sampled-batch layout
+      optimizations). GCN is rejected: its symmetric degree norm is a
+      function of the edge_index the conv sees, which block subgraphs
+      cannot reproduce.
+    params: the trained flax params.
+    block_size: B, rows per block (the static self-row width of the
+      block forward).
+    chunk_size: K, blocks per scanned dispatch.
+    neighbor_cap: optional per-node neighbor truncation (approximate
+      serving; None = exact full-neighbor width).
+
+  ``materialize()`` returns the final-layer output table; per-type /
+  penultimate stores stay on the instance for the online refresh path
+  (:meth:`refresh_rows`).
+  """
+
+  _NAME = 'EmbeddingMaterializer'
+
+  def __init__(self, dataset, model, params, *, block_size: int = 128,
+               chunk_size: int = 8, neighbor_cap: Optional[int] = None):
+    if block_size < 1 or chunk_size < 1:
+      raise ValueError('block_size and chunk_size must be >= 1')
+    self.model = model
+    self.params = params
+    self.block_size = int(block_size)
+    self.chunk_size = int(chunk_size)
+    self.neighbor_cap = neighbor_cap
+    self.is_hetero = bool(dataset.is_hetero)
+    self.num_layers = int(model.num_layers)
+    self._chunk_fns: Dict[Any, Any] = {}
+    self._init_fns: Dict[Any, Any] = {}
+    self._refresh_fns: Dict[int, Any] = {}
+    self._embeddings = None
+    self._penultimate = None
+    if self.is_hetero:
+      self._init_hetero(dataset)
+    else:
+      self._init_homo(dataset)
+
+  # ------------------------------------------------------------- setup
+
+  def _pad_rows(self, arr: np.ndarray) -> np.ndarray:
+    """Pad a [N, ...] host table up to the block multiple (pad rows are
+    never read back: neighbor ids always reference real rows < N)."""
+    n = arr.shape[0]
+    n_pad = -(-n // self.block_size) * self.block_size
+    if n_pad == n:
+      return arr
+    out = np.zeros((n_pad,) + arr.shape[1:], arr.dtype)
+    out[:n] = arr
+    return out
+
+  def _feat_rows(self, feature) -> np.ndarray:
+    """Id-ordered [N, F] float rows from a Feature store (cpu_get
+    resolves any hotness reorder, so row i is node i)."""
+    return np.asarray(
+        feature.cpu_get(np.arange(feature.size, dtype=np.int64)),
+        np.float32)
+
+  def _init_homo(self, dataset):
+    from ..models.models import GCN
+    if isinstance(self.model, GCN):
+      # GCNConv derives its symmetric degree norm FROM the edge_index it
+      # is given; in a block subgraph every neighbor slot has local
+      # out-degree 1, so the norm would silently diverge from the
+      # full-graph forward (1/sqrt(2) vs 1/sqrt(deg_out+1)) — no
+      # local-block program can reproduce it without global degree
+      # tables the conv does not accept
+      raise ValueError(
+          'GCN materialization is unsupported: GCNConv normalizes by '
+          'degrees of the edge_index it sees, which a block subgraph '
+          'cannot reproduce — serve GraphSAGE/GAT (homo) or RGNN '
+          '(hetero) models')
+    if dataset.node_features is None:
+      raise ValueError('materialization needs node features')
+    topo = dataset.graph.topo
+    self.num_nodes = int(topo.num_nodes)
+    nbr = padded_neighbors(topo, self.neighbor_cap)
+    self._w = nbr.shape[1]
+    self._nbr_np = self._pad_rows(nbr)
+    # pad rows keep -1 everywhere already (np.zeros would alias node 0)
+    self._nbr_np[self.num_nodes:] = -1
+    self._x0_np = self._pad_rows(self._feat_rows(dataset.node_features))
+    self._ei_np = _block_edges(self.block_size, self._w)
+    self._dev = None   # uploaded lazily in materialize()
+
+  def _init_hetero(self, dataset):
+    from ..models.models import RGNN
+    if not isinstance(self.model, RGNN):
+      raise ValueError('hetero materialization covers RGNN models')
+    feats = dataset.node_features
+    if not isinstance(feats, dict) or not feats:
+      raise ValueError('hetero materialization needs per-type features')
+    self.edge_dir = dataset.edge_dir
+    self._etypes = list(dataset.graph.keys())
+    # stored etype (u, r, v): edge_dir='out' groups by src u (key/target
+    # type of the aggregation) expanding to v neighbors, and batches key
+    # the message-flow edges by reverse_edge_type — exactly the
+    # sampler's convention (sampler/neighbor_sampler.py
+    # _hetero_sample_from_nodes docstring)
+    self._key_t = {et: (et[0] if self.edge_dir == 'out' else et[2])
+                   for et in self._etypes}
+    self._res_t = {et: (et[2] if self.edge_dir == 'out' else et[0])
+                   for et in self._etypes}
+    self._out_et = {et: (reverse_edge_type(et) if self.edge_dir == 'out'
+                         else et)
+                    for et in self._etypes}
+    self.num_nodes = {t: int(f.size) for t, f in feats.items()}
+    self._x0_np = {t: self._pad_rows(self._feat_rows(f))
+                   for t, f in feats.items()}
+    self._nbr_np, self._w = {}, {}
+    for et in self._etypes:
+      nbr = padded_neighbors(dataset.graph[et].topo, self.neighbor_cap)
+      kt = self._key_t[et]
+      n_t = self.num_nodes.get(kt)
+      if n_t is None:
+        raise ValueError(f'etype {et}: key type {kt!r} has no features')
+      if nbr.shape[0] < n_t:   # isolated tail nodes the topo never saw
+        nbr = np.concatenate(
+            [nbr, np.full((n_t - nbr.shape[0], nbr.shape[1]), -1,
+                          np.int32)])
+      nbr = self._pad_rows(nbr[:n_t])
+      nbr[n_t:] = -1
+      self._nbr_np[et] = nbr
+      self._w[et] = nbr.shape[1]
+    # types that ever receive messages; others keep their embed output
+    # but never advance (mirrors HeteroConv dropping non-target types)
+    self._targets = {self._key_t[et] for et in self._etypes}
+    self._dev = None
+
+  # ---------------------------------------------------------- programs
+
+  def _upload(self):
+    """One-time explicit device upload of the static tables — everything
+    the chunk programs consume enters as an all-device argument, so the
+    strict_guards region (transfer_guard('disallow')) stays clean."""
+    import jax
+    if self._dev is not None:
+      return self._dev
+    if self.is_hetero:
+      self._dev = dict(
+          nbr={et: jax.device_put(v) for et, v in self._nbr_np.items()},
+          x0={t: jax.device_put(v) for t, v in self._x0_np.items()})
+    else:
+      self._dev = dict(nbr=jax.device_put(self._nbr_np),
+                       x0=jax.device_put(self._x0_np),
+                       ei=jax.device_put(self._ei_np))
+    return self._dev
+
+  def _homo_slice(self, layer: int):
+    return train_lib.make_layer_slice_fn(self.model, layer, layer + 1)
+
+  def _init_fn(self, key, shape, dtype):
+    """Jitted zero-store builder (ONE dispatch per layer pass)."""
+    if key not in self._init_fns:
+      import jax
+      import jax.numpy as jnp
+      self._init_fns[key] = jax.jit(
+          lambda: jnp.zeros(shape, dtype))
+    return self._init_fns[key]
+
+  def _out_spec(self, slice_fn, in_specs):
+    """(rows-dtype, feature-dim) of a layer slice via eval_shape — no
+    model-specific width arithmetic to drift."""
+    import jax
+    out = jax.eval_shape(slice_fn, self.params, in_specs)
+    return out
+
+  def _homo_chunk_fn(self, layer: int, k: int):
+    """The scanned K-block program of homo layer ``layer``: slice self
+    rows + gather full neighbor rows from the previous store, run the
+    layer slice of the training forward, write the block into the
+    donated output store."""
+    key = ('homo', layer, k)
+    if key in self._chunk_fns:
+      return self._chunk_fns[key]
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    b, w = self.block_size, self._w
+    slice_fn = self._homo_slice(layer)
+
+    def chunk(params, prev, out, nbr, ei, start):
+      def body(out, g):
+        base = g * b
+        self_rows = lax.dynamic_slice_in_dim(prev, base, b)
+        nbr_blk = lax.dynamic_slice_in_dim(nbr, base, b)
+        em = (nbr_blk >= 0).reshape(-1)
+        nbr_rows = prev[jnp.maximum(nbr_blk.reshape(-1), 0)]
+        batch = dict(x=jnp.concatenate([self_rows, nbr_rows]),
+                     edge_index=ei, edge_mask=em)
+        h = slice_fn(params, batch)
+        return lax.dynamic_update_slice(out, h[:b].astype(out.dtype),
+                                        (base, 0)), None
+      out, _ = lax.scan(body, out, start + lax.iota(jnp.int32, k))
+      return out
+
+    fn = jax.jit(chunk, donate_argnums=(2,))
+    self._chunk_fns[key] = fn
+    return fn
+
+  def _run_layer_pass(self, pass_key, n_pad, out_shape, out_dtype,
+                      dispatch_chunk, layer_label):
+    """Shared pass driver: store init + scanned chunks under
+    strict_guards, flight-recorded like a training epoch. The dispatch
+    budget is 1 + ceil(blocks/K) — within the asserted
+    ceil(chunks) + 2 per layer."""
+    import jax
+    from ..metrics import flight
+    nblocks = n_pad // self.block_size
+    tok = flight.epoch_begin()
+    completed = False
+    chunks = 0
+    try:
+      with strict_guards():
+        record_dispatch('embed_store_init')
+        out = self._init_fn((pass_key, 'init'), out_shape, out_dtype)()
+        start = 0
+        while start < nblocks:
+          k = min(self.chunk_size, nblocks - start)
+          record_dispatch('embed_chunk')
+          out = dispatch_chunk(out, k,
+                               jax.device_put(np.int32(start)))
+          start += k
+          chunks += 1
+      completed = True
+    finally:
+      flight.end_for(
+          self, tok, emitter=self._NAME, steps=nblocks,
+          completed=completed, config=self._flight_config(),
+          extra={'pass': str(layer_label), 'chunks': chunks})
+    return out
+
+  def _flight_config(self) -> dict:
+    return dict(emitter=self._NAME, block_size=self.block_size,
+                chunk_size=self.chunk_size, hetero=self.is_hetero,
+                num_layers=self.num_layers,
+                neighbor_cap=self.neighbor_cap)
+
+  # ------------------------------------------------------------- homo
+
+  def _materialize_homo(self):
+    import jax
+    dev = self._upload()
+    prev = dev['x0']
+    n_pad = prev.shape[0]
+    b = self.block_size
+    for layer in range(self.num_layers):
+      slice_fn = self._homo_slice(layer)
+      spec = self._out_spec(slice_fn, dict(
+          x=jax.ShapeDtypeStruct((b + b * self._w, prev.shape[1]),
+                                 prev.dtype),
+          edge_index=jax.ShapeDtypeStruct((2, b * self._w), np.int32),
+          edge_mask=jax.ShapeDtypeStruct((b * self._w,), bool)))
+
+      def dispatch(out, k, start, _layer=layer):
+        return self._homo_chunk_fn(_layer, k)(
+            self.params, prev, out, dev['nbr'], dev['ei'], start)
+
+      if layer == self.num_layers - 1:
+        self._penultimate = prev
+      out = self._run_layer_pass(('homo', layer), n_pad,
+                                 (n_pad, spec.shape[-1]), spec.dtype,
+                                 dispatch, layer)
+      prev = out
+    self._embeddings = prev
+    return prev
+
+  # ------------------------------------------------------------ hetero
+
+  def _hetero_layout(self, t, live_ets):
+    """Static per-(target type, live etypes) block layout: the order
+    and offsets of each result type's buffer segments, plus the
+    constant per-out-etype edge arrays. Self rows of type ``t`` lead
+    t's buffer; each etype's ``B*W`` neighbor rows append to its result
+    type's buffer in etype order."""
+    b = self.block_size
+    widths = {t: b}
+    offsets = {}
+    for et in live_ets:
+      r = self._res_t[et]
+      offsets[et] = widths.get(r, 0)
+      widths[r] = offsets[et] + b * self._w[et]
+    ei = {}
+    for et in live_ets:
+      w = self._w[et]
+      row = offsets[et] + np.arange(b * w, dtype=np.int32)
+      col = np.repeat(np.arange(b, dtype=np.int32), w)
+      ei[self._out_et[et]] = np.stack([row, col])
+    return offsets, ei
+
+  def _hetero_chunk_fn(self, t, layer, live_ets, k):
+    """Scanned K-block program of hetero conv layer ``layer`` for
+    target type ``t``: per-etype neighbor gathers from the per-type
+    stores, one RGNN layer slice (embed=False, head=False), block
+    write into t's donated output store."""
+    key = ('het', t, layer, tuple(live_ets), k)
+    if key in self._chunk_fns:
+      return self._chunk_fns[key]
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    b = self.block_size
+    _, ei_np = self._hetero_layout(t, live_ets)
+    ei_dev = {oet: jax.device_put(v) for oet, v in ei_np.items()}
+    slice_fn = train_lib.make_layer_slice_fn(
+        self.model, layer, layer + 1, embed=False, head=False)
+    res_order = []            # segment order per result-type buffer
+    for et in live_ets:
+      res_order.append((et, self._res_t[et]))
+
+    def chunk(params, stores, out, nbrs, start):
+      def body(out, g):
+        base = g * b
+        parts = {t: [lax.dynamic_slice_in_dim(stores[t], base, b)]}
+        masks = {}
+        for et, r in res_order:
+          blk = lax.dynamic_slice_in_dim(nbrs[et], base, b)
+          masks[self._out_et[et]] = (blk >= 0).reshape(-1)
+          rows = stores[r][jnp.maximum(blk.reshape(-1), 0)]
+          parts.setdefault(r, []).append(rows)
+        x = {r: (jnp.concatenate(v) if len(v) > 1 else v[0])
+             for r, v in parts.items()}
+        batch = dict(x=x, edge_index=ei_dev, edge_mask=masks)
+        h = slice_fn(params, batch)[t]
+        return lax.dynamic_update_slice(out, h[:b].astype(out.dtype),
+                                        (base, 0)), None
+      out, _ = lax.scan(body, out, start + lax.iota(jnp.int32, k))
+      return out
+
+    fn = jax.jit(chunk, donate_argnums=(2,))
+    self._chunk_fns[key] = fn
+    return fn
+
+  def _hetero_rowlocal_fn(self, t, tag, slice_fn, k):
+    """Scanned K-block program of a row-local pass (the per-type embed
+    projection, the final lin_out head): no neighbors, one Dense per
+    block."""
+    key = ('hetrow', t, tag, k)
+    if key in self._chunk_fns:
+      return self._chunk_fns[key]
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    b = self.block_size
+
+    def chunk(params, src, out, start):
+      def body(out, g):
+        base = g * b
+        rows = lax.dynamic_slice_in_dim(src, base, b)
+        h = slice_fn(params, dict(x={t: rows}, edge_index={},
+                                  edge_mask={}))
+        if isinstance(h, dict):
+          h = h[t]
+        return lax.dynamic_update_slice(out, h.astype(out.dtype),
+                                        (base, 0)), None
+      out, _ = lax.scan(body, out, start + lax.iota(jnp.int32, k))
+      return out
+
+    fn = jax.jit(chunk, donate_argnums=(2,))
+    self._chunk_fns[key] = fn
+    return fn
+
+  def _materialize_hetero(self):
+    import jax
+    dev = self._upload()
+    b = self.block_size
+    embed_fn = train_lib.make_layer_slice_fn(self.model, 0, 0,
+                                             embed=True, head=False)
+    stores = {}
+    # pass 0: per-type embed projection (row-local)
+    for t, x0 in dev['x0'].items():
+      spec = self._out_spec(
+          lambda p, bt: embed_fn(p, bt)[t],
+          dict(x={t: jax.ShapeDtypeStruct((b, x0.shape[1]), x0.dtype)},
+               edge_index={}, edge_mask={}))
+
+      def dispatch(out, k, start, _t=t, _x0=x0):
+        return self._hetero_rowlocal_fn(
+            _t, 'embed', embed_fn, k)(self.params, _x0, out, start)
+
+      stores[t] = self._run_layer_pass(
+          ('embed', t), x0.shape[0], (x0.shape[0], spec.shape[-1]),
+          spec.dtype, dispatch, f'embed/{t}')
+    # conv layers: per target type, over the etypes whose result type
+    # is still live (mirrors HeteroConv's type dropping)
+    for layer in range(self.num_layers):
+      new_stores = {}
+      for t in sorted(self._targets):
+        if t not in stores:
+          continue
+        live = tuple(et for et in self._etypes
+                     if self._key_t[et] == t and self._res_t[et] in stores)
+        if not live:
+          continue
+        slice_fn = train_lib.make_layer_slice_fn(
+            self.model, layer, layer + 1, embed=False, head=False)
+        _, ei_np = self._hetero_layout(t, live)
+        widths = {t: b}
+        for et in live:
+          r = self._res_t[et]
+          widths[r] = widths.get(r, b if r == t else 0) + b * self._w[et]
+        spec = self._out_spec(
+            lambda p, bt: slice_fn(p, bt)[t],
+            dict(x={r: jax.ShapeDtypeStruct((widths[r],
+                                             stores[r].shape[1]),
+                                            stores[r].dtype)
+                    for r in widths if r in stores},
+                 edge_index={oet: jax.ShapeDtypeStruct(v.shape, np.int32)
+                             for oet, v in ei_np.items()},
+                 edge_mask={oet: jax.ShapeDtypeStruct((v.shape[1],),
+                                                      bool)
+                            for oet, v in ei_np.items()}))
+        n_pad = stores[t].shape[0]
+
+        def dispatch(out, k, start, _t=t, _layer=layer, _live=live,
+                     _stores=stores):
+          return self._hetero_chunk_fn(_t, _layer, _live, k)(
+              self.params, _stores, out, dev['nbr'], start)
+
+        new_stores[t] = self._run_layer_pass(
+            ('het', t, layer), n_pad, (n_pad, spec.shape[-1]),
+            spec.dtype, dispatch, f'{layer}/{t}')
+      if layer == self.num_layers - 1:
+        self._penultimate = stores
+      stores = new_stores
+    self.stores = stores
+    # head: lin_out over the output type (row-local), when the model
+    # has one — otherwise the per-type stores ARE the result
+    out_t = getattr(self.model, 'out_ntype', None)
+    if out_t is None:
+      self._embeddings = stores
+      return stores
+    if out_t not in stores:
+      raise ValueError(f'out_ntype {out_t!r} received no messages')
+    head_fn = train_lib.make_layer_slice_fn(
+        self.model, self.num_layers, self.num_layers, embed=False,
+        head=True)
+    src = stores[out_t]
+    spec = self._out_spec(
+        head_fn, dict(x={out_t: jax.ShapeDtypeStruct((b, src.shape[1]),
+                                                     src.dtype)},
+                      edge_index={}, edge_mask={}))
+
+    def dispatch(out, k, start):
+      return self._hetero_rowlocal_fn(
+          out_t, 'head', head_fn, k)(self.params, src, out, start)
+
+    self._embeddings = self._run_layer_pass(
+        ('head', out_t), src.shape[0], (src.shape[0], spec.shape[-1]),
+        spec.dtype, dispatch, f'head/{out_t}')
+    return self._embeddings
+
+  # -------------------------------------------------------------- API
+
+  def materialize(self):
+    """Run the full layer-by-layer pass. Returns the final output table
+    (homo: [N_pad, out_dim] device array; hetero: the ``lin_out`` table
+    of ``out_ntype``, or the per-type store dict when the model has no
+    head). Rows past ``num_nodes`` are block padding — never read."""
+    if self.is_hetero:
+      return self._materialize_hetero()
+    return self._materialize_homo()
+
+  @property
+  def embeddings(self):
+    if self._embeddings is None:
+      raise RuntimeError('call materialize() first')
+    return self._embeddings
+
+  def embedding_store(self):
+    """The materialized table wrapped as a serving ``EmbeddingStore``
+    with the REAL node count — use this (not a bare
+    ``EmbeddingStore(table)``) so the table's block-padding rows stay
+    behind the engine's id validation instead of being servable as
+    node ids (homo only; hetero stores are per type)."""
+    from .store import EmbeddingStore
+    if self.is_hetero:
+      raise ValueError('hetero materialization produces per-type '
+                       'stores — wrap the one you serve explicitly: '
+                       'EmbeddingStore(table, num_nodes=N_type)')
+    if self._embeddings is None:
+      raise RuntimeError('call materialize() first')
+    return EmbeddingStore(self._embeddings, num_nodes=self.num_nodes)
+
+  def dist_embedding_store(self, mesh, **kwargs):
+    """The materialized table as a sharded ``DistEmbeddingStore`` over
+    ``mesh``, with the real node count passed for you (block-pad rows
+    must not become servable ids — see :meth:`embedding_store`).
+    ``kwargs`` forward to ``DistEmbeddingStore.build`` (split_ratio /
+    cache_rows / hotness / wire_dtype / bucket_frac)."""
+    from .store import DistEmbeddingStore
+    if self.is_hetero:
+      raise ValueError('hetero materialization produces per-type '
+                       'stores — build the one you serve explicitly '
+                       'with DistEmbeddingStore.build(table, mesh, '
+                       'num_nodes=N_type, ...)')
+    if self._embeddings is None:
+      raise RuntimeError('call materialize() first')
+    return DistEmbeddingStore.build(self._embeddings, mesh,
+                                    num_nodes=self.num_nodes, **kwargs)
+
+  # ------------------------------------------------------------ refresh
+
+  def _refresh_fn_for(self, cap: int):
+    """Jitted final-layer-only recompute for a [cap] id bucket: gather
+    the stale nodes' penultimate rows + their full neighbor rows, run
+    the LAST layer slice of the training forward. Homo only (the
+    hetero head/type bookkeeping lives server-side for now)."""
+    if cap in self._refresh_fns:
+      return self._refresh_fns[cap]
+    import jax
+    import jax.numpy as jnp
+    w = self._w
+    last = self.num_layers - 1
+    slice_fn = self._homo_slice(last)
+    ei = jax.device_put(_block_edges(cap, w))
+
+    def refresh(params, prev, nbr, ids, mask):
+      safe = jnp.maximum(ids, 0)
+      self_rows = prev[safe]
+      nbr_blk = jnp.where(mask[:, None], nbr[safe], -1)
+      em = (nbr_blk >= 0).reshape(-1)
+      nbr_rows = prev[jnp.maximum(nbr_blk.reshape(-1), 0)]
+      batch = dict(x=jnp.concatenate([self_rows, nbr_rows]),
+                   edge_index=ei, edge_mask=em)
+      return slice_fn(params, batch)[:cap]
+
+    fn = jax.jit(refresh)
+    self._refresh_fns[cap] = fn
+    return fn
+
+  def refresh_rows(self, ids) -> np.ndarray:
+    """Final-layer-only refresh: recompute the CURRENT last-layer
+    embedding rows for ``ids`` from the penultimate store (one bucket
+    program per padded capacity — the online engine's stale-node hook).
+    Returns [len(ids), F_out] host rows."""
+    if self.is_hetero:
+      raise NotImplementedError(
+          'final-layer refresh is homogeneous-only for now — '
+          'rematerialize hetero stores offline (docs/serving.md)')
+    if self._penultimate is None:
+      raise RuntimeError('call materialize() first')
+    import jax.numpy as jnp
+    from .store import pow2_cap
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    if ids.size == 0:
+      # never touch _embeddings here: the caller may have handed that
+      # table to an EmbeddingStore whose refresh write-back DONATED it
+      return np.zeros((0, int(self.model.out_dim)), np.float32)
+    cap = pow2_cap(ids.size)
+    padded = np.full((cap,), -1, np.int32)
+    padded[:ids.size] = ids
+    mask = padded >= 0
+    record_dispatch('serve_refresh')
+    rows = self._refresh_fn_for(cap)(
+        self.params, self._penultimate, self._upload()['nbr'],
+        jnp.asarray(padded), jnp.asarray(mask))
+    return np.asarray(rows)[:ids.size]

@@ -111,7 +111,8 @@ def rng():
 # graftlint static pass (docs/static_analysis.md). Export GLT_STRICT=0
 # to debug a failure with the guards off.
 
-_STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch')
+_STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
+                   'test_serving')
 
 
 @pytest.fixture(autouse=True)

@@ -345,7 +345,8 @@ def test_worker_kill_bit_identical_replay(monkeypatch):
     loader.shutdown()
 
 
-def test_worker_giveup_after_restart_budget(monkeypatch):
+@pytest.mark.slow   # tier-1 wall budget: restart-and-replay stays as
+def test_worker_giveup_after_restart_budget(monkeypatch):   # the rep
   """Satellite: a deterministically-crashing worker exhausts the
   restart budget and surfaces a RuntimeError instead of restart-looping
   forever."""

@@ -160,7 +160,8 @@ def test_mp_dist_neighbor_loader():
     loader.shutdown()
 
 
-def test_mp_dist_link_loader():
+@pytest.mark.slow   # tier-1 wall budget: mp neighbor + mp hetero stay
+def test_mp_dist_link_loader():   # as the mp-producer family's reps
   """LINK sampling through the mp producer path: batches stream with
   edge_label_index/edge_label metadata and positives relocate to the
   seed edge pairs."""
@@ -424,7 +425,8 @@ def _hetero_server_main(port_queue):
   glt_mod.distributed.wait_and_shutdown_server(timeout=120)
 
 
-def test_server_client_hetero_end_to_end():
+@pytest.mark.slow   # tier-1 wall budget: the homo e2e above + the mp
+def test_server_client_hetero_end_to_end():   # hetero loader stay as reps
   """Remote (server-client) HETERO node loading (round 5): the server's
   mp workers run the typed engine and stream HeteroData messages back
   over RPC — typed seeds ship as NodeSamplerInput('user', ...) and
