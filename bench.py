@@ -26,6 +26,7 @@ the GLT-CUDA A100 scale read off that figure (~40M sampled edges/s for this
 config). Prints ONE JSON line.
 """
 import json
+import os
 import shutil
 import time
 
@@ -383,6 +384,17 @@ BENCH_KEY_REGISTRY = {
     'scan_epoch_wall_s': 'scanned epoch wall seconds',
     'scan_epoch_device_trace_s': 'scanned epoch device-trace seconds',
     'epoch_time_s_scanned': 'products-scale scanned epoch projection',
+    # program observatory (PR 8, metrics/programs.py): compile/retrace
+    # accounting over the scanned-epoch section (reset at its start;
+    # cost attribution captured under GLT_PROGRAM_COST)
+    'compile_count': 'XLA compiles across the scanned-epoch section',
+    'compile_time_s_total': 'summed compile wall s (section scope)',
+    'retrace_count': 'compiles beyond the first per site — a retrace '
+                     'regression multiplies epoch wall clock',
+    'program_flops_total': 'cost_analysis flops summed over compiled '
+                           'programs (null without GLT_PROGRAM_COST)',
+    'program_peak_hbm_mb': 'max per-program peak-HBM estimate, MB '
+                           '(args+out+temps-aliased; null w/o cost)',
     # scanned DISTRIBUTED epoch (PR 4)
     'dist_epoch_dispatches': 'per-step collocated dist epoch dispatches',
     'dist_epoch_wall_s': 'per-step collocated dist epoch wall seconds',
@@ -444,6 +456,9 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'epoch_time_s', 'epoch_time_s_exact', 'epoch_time_s_tree',
     'epoch_time_s_scanned',
     'epoch_dispatches', 'scan_epoch_wall_s', 'scan_epoch_device_trace_s',
+    # retraces and compile seconds regress silently; the gate catches a
+    # round-over-round jump (a new chunk length, a dtype drift)
+    'retrace_count', 'compile_time_s_total',
     'dist_epoch_dispatches', 'dist_epoch_wall_s',
     'dist_scan_epoch_dispatches', 'dist_scan_epoch_wall_s',
     'feature_exchange_mb_per_batch',
@@ -911,10 +926,24 @@ def main():
     sstate, stx = train_lib.create_train_state(
         scan_model, jax.random.PRNGKey(0), first)
     scan_k = 8
-    trainer = glt.loader.ScanTrainer(scan_loader, scan_model, stx,
-                                     E2E_CLASSES, chunk_size=scan_k)
-    sstate, losses, _ = trainer.run_epoch(sstate)      # compile epoch
-    jax.block_until_ready(losses)
+    # program observatory over this section: reset, then arm cost
+    # attribution for the compile epoch (one extra HOST-side AOT
+    # compile per new executable — never a dispatch; the measured
+    # epoch below runs with it disarmed and fully steady-state)
+    from graphlearn_tpu.metrics import programs as _programs
+    _programs.reset()
+    _prev_cost = os.environ.get('GLT_PROGRAM_COST')
+    os.environ['GLT_PROGRAM_COST'] = '1'
+    try:
+      trainer = glt.loader.ScanTrainer(scan_loader, scan_model, stx,
+                                       E2E_CLASSES, chunk_size=scan_k)
+      sstate, losses, _ = trainer.run_epoch(sstate)      # compile epoch
+      jax.block_until_ready(losses)
+    finally:
+      if _prev_cost is None:
+        os.environ.pop('GLT_PROGRAM_COST', None)
+      else:
+        os.environ['GLT_PROGRAM_COST'] = _prev_cost
     with count_dispatches() as dc:
       t0 = time.perf_counter()
       sstate, losses, _ = trainer.run_epoch(sstate)
@@ -953,6 +982,16 @@ def main():
     else:
       result['scan_epoch_device_trace_s'] = None
       result['epoch_time_s_scanned'] = None
+    # observatory aggregates AFTER the measured + traced epochs: a
+    # steady-state section reports its compile-epoch compiles and ZERO
+    # further retraces — retrace_count regressing round-over-round is
+    # exactly what the gate is for (a new chunk length, a dtype drift)
+    agg = _programs.aggregate()
+    result['compile_count'] = agg['compile_count']
+    result['compile_time_s_total'] = agg['compile_time_s_total']
+    result['retrace_count'] = agg['retrace_count']
+    result['program_flops_total'] = agg['program_flops_total']
+    result['program_peak_hbm_mb'] = agg['program_peak_hbm_mb']
   except Exception as e:
     result['scan_epoch_error'] = f'{type(e).__name__}: {e}'[:200]
 

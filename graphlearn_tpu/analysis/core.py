@@ -114,7 +114,7 @@ def in_scope(relpath: str, patterns: Sequence[str]) -> bool:
 
 PRAGMA_RULES = ('host-sync', 'prng-discipline', 'dispatch-instrumentation',
                 'compat-shard-map', 'fault-point-coverage',
-                'metric-registry')
+                'metric-registry', 'span-registry')
 _PRAGMA_MARK = 'graftlint:'
 
 
@@ -282,9 +282,9 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 
 def _checkers():
   from . import (compat_import, dispatch, fault_points, host_sync,
-                 metric_names, prng)
+                 metric_names, prng, span_names)
   return (host_sync, prng, dispatch, compat_import, fault_points,
-          metric_names)
+          metric_names, span_names)
 
 
 def run_lint(paths: Sequence[str], config: Optional[Config] = None,

@@ -41,6 +41,10 @@ _RULE_DOCS = {
         'counter/gauge/histogram) must be string literals registered '
         'in metrics/registry_names.py REGISTERED_METRICS and '
         'documented in docs/observability.md',
+    'span-registry':
+        'span names (spans.span/begin/emit) must be string literals '
+        'registered in metrics/registry_names.py REGISTERED_SPANS and '
+        'documented in the docs/observability.md span table',
 }
 
 

@@ -178,16 +178,18 @@ def check_package(modules: List[ParsedModule], config: Config):
   return out
 
 
-def _parse_registry(mod: Optional[ParsedModule]):
-  """(entries, lineno) from `REGISTERED_METRICS = frozenset({...})`,
-  or (None, 0) when unavailable."""
+def _parse_registry(mod: Optional[ParsedModule],
+                    name: str = 'REGISTERED_METRICS'):
+  """(entries, lineno) from ``<name> = frozenset({...})``, or
+  (None, 0) when unavailable. Shared with the span-registry rule
+  (``name='REGISTERED_SPANS'``) — same file, same parse."""
   if mod is None:
     return None, 0
   for node in ast.walk(mod.tree):
     if not isinstance(node, ast.Assign):
       continue
     names = [t.id for t in node.targets if isinstance(t, ast.Name)]
-    if 'REGISTERED_METRICS' not in names:
+    if name not in names:
       continue
     try:
       value = ast.literal_eval(node.value)

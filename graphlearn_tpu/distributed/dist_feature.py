@@ -422,7 +422,9 @@ class DistFeature:
       mask = ids >= 0
     b = ids.shape[1]
     if b not in self._fns:
-      self._fns[b] = self._build_fn(b)
+      from ..metrics import programs
+      self._fns[b] = programs.instrument(self._build_fn(b),
+                                         'dist_feature.get')
     trace.record_dispatch('dist_feature.get')
     return self._fns[b](ids, mask)
 

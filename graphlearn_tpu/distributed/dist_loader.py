@@ -116,12 +116,18 @@ class DistLoader(OverflowGuardMixin):
   # come from the publish_stats fetch the epoch already pays).
 
   def _flight_begin(self):
-    from ..metrics import flight
-    return flight.epoch_begin()
+    from ..metrics import flight, spans
+    # one epoch.run span per epoch alongside the flight record: both
+    # carry the process run_id, so a flight line, a scrape and the
+    # epoch's span tree join on one id (docs/observability.md)
+    return (flight.epoch_begin(),
+            spans.begin('epoch.run', emitter=type(self).__name__))
 
   def _flight_end(self, tok, steps: int, completed: bool):
-    from ..metrics import flight
-    flight.end_for(self, tok, steps=steps, completed=completed,
+    from ..metrics import flight, spans
+    flight_tok, span_tok = tok
+    spans.end(span_tok, steps=steps, completed=completed)
+    flight.end_for(self, flight_tok, steps=steps, completed=completed,
                    config=self._flight_config())
 
   def _flight_config(self) -> dict:
@@ -134,9 +140,12 @@ class DistLoader(OverflowGuardMixin):
 
   def __iter__(self):
     from ..utils import step_annotation
+    # overflow-policy state resolves BEFORE the span/flight bracket: a
+    # raise from it must not leak the attached epoch.run span (which
+    # would mis-parent every later span on this thread)
+    guarded, recompute = self._overflow_epoch_start()
     tok = self._flight_begin()
     steps, completed = 0, False
-    guarded, recompute = self._overflow_epoch_start()
     try:
       for i, (idx, mask) in enumerate(self._index_blocks()):
         with step_annotation('glt_dist_batch', i):
@@ -160,9 +169,13 @@ class DistLoader(OverflowGuardMixin):
         self._finish_epoch_overflow()
     finally:
       # also on early break/close: the on-device int32 accumulator must
-      # be drained per epoch or it eventually wraps
-      self._publish_feature_stats()
-      self._flight_end(tok, steps, completed)
+      # be drained per epoch or it eventually wraps. The publish is a
+      # device fetch that can raise — the span/flight close must
+      # survive it (inner finally), or the attached epoch span leaks
+      try:
+        self._publish_feature_stats()
+      finally:
+        self._flight_end(tok, steps, completed)
 
   def _publish_feature_stats(self):
     """Surface the feature-store hit/miss counters into utils.trace at
@@ -285,11 +298,16 @@ class MpDistNeighborLoader:
     return self._expected
 
   def __iter__(self):
-    from ..metrics import flight
+    from ..metrics import flight, spans
     tok = flight.epoch_begin()
-    self.producer.produce_all()
+    # the epoch span is CURRENT while produce_all ships the epoch
+    # commands, so worker spans (producer.epoch/batch) parent under it;
+    # produce_all runs INSIDE the try — a raise there must still end
+    # the attached span (and now also records the failed epoch)
+    sp = spans.begin('epoch.run', emitter=type(self).__name__)
     received = 0
     try:
+      self.producer.produce_all()
       while received < self._expected:
         try:
           msg = self.channel.recv(
@@ -306,6 +324,8 @@ class MpDistNeighborLoader:
         received += 1
         yield self._message_to_data(msg)
     finally:
+      spans.end(sp, steps=received,
+                completed=received >= self._expected)
       cfg = self.producer.config
       flight.end_for(
           self, tok, steps=received,
@@ -487,7 +507,6 @@ class _RemoteLoaderBase:
     surviving servers. Returns buffered messages that were drained while
     abandoning the pair (already acked; caller yields them). Idempotent
     per pair per epoch."""
-    from ..utils import trace
     if (rank, pid) in self._handled_pairs:
       return []
     # feasibility FIRST, before any state mutation: when this loader
@@ -506,6 +525,24 @@ class _RemoteLoaderBase:
           'failover is disabled (RemoteDistSamplingWorkerOptions'
           '.failover=False)')
     self._handled_pairs.add((rank, pid))
+    # the failover span is the epoch tree's resilience annotation: the
+    # degraded chunk of work — dead rank, cause, redistributed seed
+    # count — hangs off this epoch's epoch.run span, and the replacement
+    # producers' RPCs (and their workers' spans) parent under it
+    from ..metrics import spans
+    fo_span = spans.begin('loader.failover', rank=rank,
+                          cause=str(cause)[:200])
+    try:
+      return self._handle_dead_pair_spanned(rank, pid, cause, part,
+                                            fo_span)
+    except BaseException as e:
+      fo_span.attrs['error'] = f'{type(e).__name__}: {e}'
+      raise
+    finally:
+      spans.end(fo_span)
+
+  def _handle_dead_pair_spanned(self, rank, pid, cause, part, fo_span):
+    from ..utils import trace
     self._live_pairs.discard((rank, pid))
     self._dead_ranks[rank] = cause
     self._heartbeat.mark_dead(rank, cause)
@@ -528,6 +565,8 @@ class _RemoteLoaderBase:
           'cannot complete the epoch')
     trace.counter_inc('resilience.failover')
     trace.counter_inc('resilience.failover_seeds', int(unacked.shape[0]))
+    fo_span.attrs.update(seeds=int(unacked.shape[0]),
+                         survivors=list(survivors))
     import logging
     logging.getLogger('graphlearn_tpu.loader').warning(
         'server rank %d dead (%s): redistributing %d unacked seeds '
@@ -582,7 +621,7 @@ class _RemoteLoaderBase:
     return buffered
 
   def __iter__(self):
-    from ..metrics import flight
+    from ..metrics import flight, spans
     # Ordering matters: kill any previous epoch's pullers BEFORE
     # restarting the server producers (a stale puller would consume
     # new-epoch messages into its dead queue), and only then start the
@@ -590,6 +629,12 @@ class _RemoteLoaderBase:
     self.channel.stop(join=True)
     self._epoch += 1
     tok = flight.epoch_begin()
+    # the epoch span stays current across _epoch_messages, so the
+    # start_new_epoch_sampling RPCs (and through them the servers'
+    # producer workers) and any failover spans parent under it — one
+    # joinable tree per epoch across client, server and producers
+    sp = spans.begin('epoch.run', emitter=type(self).__name__,
+                     epoch=self._epoch)
     received, completed = 0, False
     try:
       for data in self._epoch_messages():
@@ -597,6 +642,8 @@ class _RemoteLoaderBase:
         received += 1
       completed = True
     finally:
+      spans.end(sp, steps=received, completed=completed,
+                dead_ranks=len(self._dead_ranks))
       # the flight record is the postmortem trail for THIS epoch:
       # failover/retry counter deltas, batches delivered, wall — one
       # JSONL line (docs/observability.md), nothing on the hot path
@@ -837,9 +884,12 @@ class DistLinkNeighborLoader(DistLoader):
 
   def __iter__(self):
     from ..sampler import EdgeSamplerInput
+    # overflow-policy prologue BEFORE the span/flight bracket: a raise
+    # from it must not leak the attached epoch.run span (same ordering
+    # as DistLoader.__iter__)
+    guarded, recompute = self._overflow_epoch_start()
     tok = self._flight_begin()
     steps, completed = 0, False
-    guarded, recompute = self._overflow_epoch_start()
     try:
       for idx, mask in self._index_blocks():
         inputs = EdgeSamplerInput(
@@ -866,8 +916,11 @@ class DistLinkNeighborLoader(DistLoader):
       if guarded and not recompute:
         self._finish_epoch_overflow()
     finally:
-      self._publish_feature_stats()
-      self._flight_end(tok, steps, completed)
+      # device-fetch publish can raise: close span + flight regardless
+      try:
+        self._publish_feature_stats()
+      finally:
+        self._flight_end(tok, steps, completed)
 
 
 class DistSubGraphLoader(DistLoader):
@@ -906,8 +959,11 @@ class DistSubGraphLoader(DistLoader):
         steps += 1
       completed = True
     finally:
-      self._publish_feature_stats()
-      self._flight_end(tok, steps, completed)
+      # device-fetch publish can raise: close span + flight regardless
+      try:
+        self._publish_feature_stats()
+      finally:
+        self._flight_end(tok, steps, completed)
 
 
 class DistNeighborLoader(DistLoader):

@@ -117,7 +117,18 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
       continue
     if cmd == MpCommand.STOP:
       break
-    epoch_seed_order, start_batch = payload
+    # payload: (epoch order, replay start batch, span wire-context) —
+    # the ctx joins this worker's spans to the driving client's trace
+    # (a replayed command after a respawn carries the SAME ctx, so the
+    # replacement incarnation's spans land in the same tree, orphan-
+    # free). Two-tuple payloads from older callers still work.
+    epoch_seed_order, start_batch = payload[0], payload[1]
+    span_ctx = payload[2] if len(payload) > 2 else None
+    from graphlearn_tpu.metrics import spans
+    epoch_ctx = spans.adopt(span_ctx)
+    epoch_ctx.__enter__()
+    epoch_span = spans.begin('producer.epoch', worker=rank,
+                             start_batch=start_batch)
     n = n_seeds
     bs = cfg.batch_size
     batch_no = 0
@@ -135,6 +146,7 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
       # chaos harness site: armed 'exit' here (before the sample/send)
       # kills the worker at an exact batch index with nothing in flight
       fault_point('producer.worker.batch')
+      batch_span = spans.begin('producer.batch', batch=batch_no)
       t_batch = _time.perf_counter()
       if is_link:
         if idx.shape[0] < bs:
@@ -184,6 +196,7 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
       metrics.inc('producer.batches')
       metrics.observe('producer.sample_ms',
                       (_time.perf_counter() - t_batch) * 1e3)
+      spans.end(batch_span)
       batch_no += 1
       if progress is not None:
         # published AFTER the send. Tradeoff for an UNCONTROLLED crash
@@ -198,14 +211,22 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
         with sent_arr.get_lock():
           sent_arr[rank] = batch_no
           calls_arr[rank] = sampler._call_count
+    spans.end(epoch_span, batches=batch_no)
+    epoch_ctx.__exit__(None, None, None)
     with done_counter.get_lock():
       done_counter.value += 1
     if metrics_q is not None:
       # publish the CUMULATIVE worker snapshot at epoch end over the
       # producer's queue plumbing — latest-wins per rank on the other
-      # side, so a lost/duplicated frame costs nothing
+      # side, so a lost/duplicated frame costs nothing. The snapshot
+      # carries this worker's span ring + the epoch's trace id as
+      # extra keys: DistServer.get_metrics (and worker_metrics) expose
+      # them so a scrape recovers producer spans by id alone
       try:
-        metrics_q.put_nowait((rank, metrics.snapshot()))
+        snap = metrics.snapshot()
+        snap['spans'] = spans.export(limit=spans.SCRAPE_EXPORT_LIMIT)
+        snap['run_id'] = (span_ctx or {}).get('trace') or spans.run_id()
+        metrics_q.put_nowait((rank, snap))
       except Exception:  # noqa: BLE001 - observability must not kill work
         pass
 
@@ -305,6 +326,7 @@ class DistMpSamplingProducer:
     self._worker_snaps = {}
     self._metrics_drain_lock = threading.Lock()
     self._last_orders = [None] * self.num_workers
+    self._last_ctx = [None] * self.num_workers
     g = self.dataset.graph
     nf = self.dataset.node_features
     self._handle = dict(
@@ -325,6 +347,7 @@ class DistMpSamplingProducer:
   def produce_all(self):
     """Kick one epoch of sampling on all workers
     (reference: :227-240)."""
+    from ..metrics import spans
     with self._done.get_lock():
       self._done.value = 0
     with self._sent.get_lock():
@@ -332,12 +355,19 @@ class DistMpSamplingProducer:
         self._sent[w] = 0
     if hasattr(self.channel, 'reset'):
       self.channel.reset()
+    # the epoch command carries the CALLER's span context (the client's
+    # epoch span when produce_all was reached through an RPC whose
+    # handler adopted it) so worker spans join the driving trace; kept
+    # per worker for replay — a respawned incarnation must land its
+    # spans in the SAME tree
+    ctx = spans.wire_context()
     for w in range(self.num_workers):
       n = self._splits[w].shape[0]
       order = (self._rng.permutation(n) if self.config.shuffle
                else np.arange(n))
       self._last_orders[w] = order
-      self._queues[w].put((MpCommand.SAMPLE_ALL, (order, 0)))
+      self._last_ctx[w] = ctx
+      self._queues[w].put((MpCommand.SAMPLE_ALL, (order, 0, ctx)))
 
   def is_all_sampling_completed(self) -> bool:
     with self._done.get_lock():
@@ -391,7 +421,10 @@ class DistMpSamplingProducer:
       order = self._last_orders[w]
       if order is not None and sent < self._expected_for_worker(w):
         # mid-epoch death: replay the unfinished tail of its seed order
-        self._queues[w].put((MpCommand.SAMPLE_ALL, (order, sent)))
+        # under the SAME span context — the respawned incarnation's
+        # spans join the original epoch's tree (no orphans)
+        self._queues[w].put((MpCommand.SAMPLE_ALL,
+                             (order, sent, self._last_ctx[w])))
 
   def worker_metrics(self):
     """Merged metric snapshot across this producer's mp workers, or
@@ -418,7 +451,17 @@ class DistMpSamplingProducer:
         return None
       snaps = list(self._worker_snaps.values())
     from ..metrics import merge_snapshots
-    return merge_snapshots(snaps)
+    merged = merge_snapshots(snaps)
+    # span rings don't merge — concatenate them (and carry a run_id)
+    # so get_metrics / scrape_all expose producer spans per role
+    span_rows = [s for snap in snaps for s in snap.get('spans', ())]
+    if span_rows:
+      merged['spans'] = span_rows
+    for snap in snaps:
+      if snap.get('run_id'):
+        merged['run_id'] = snap['run_id']
+        break
+    return merged
 
   def num_expected(self) -> int:
     bs = self.config.batch_size

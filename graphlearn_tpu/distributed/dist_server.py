@@ -259,8 +259,14 @@ class DistServer:
     the fault-injection registry. Like heartbeat, the snapshot itself
     takes no self._lock (the registry has its own); only the producer
     table copy does."""
-    from ..metrics import snapshot
-    out = {'server': snapshot(), 'producers': {}}
+    from ..metrics import snapshot, spans
+    srv = snapshot()
+    # run_id + the span ring ride the snapshot (extra keys, ignored by
+    # merge_snapshots): the scraping client recovers this server's
+    # spans — and the producers' worker spans below — by id alone
+    srv['run_id'] = spans.run_id()
+    srv['spans'] = spans.export(limit=spans.SCRAPE_EXPORT_LIMIT)
+    out = {'server': srv, 'producers': {}}
     with self._lock:
       producers = dict(self._producers)
     for pid, producer in producers.items():

@@ -137,15 +137,24 @@ class RpcServer:
             client_nonce = _recv_exact(sock, 32)
             sock.sendall(_hmac_of(outer._secret, client_nonce,
                                   role=b'server'))
+          from ..metrics import spans
           from ..utils.faults import fault_point
           while True:
             req = _recv_frame(sock)
             # armed 'delay' simulates a hung server (liveness-test
             # territory); 'raise' tears the connection down mid-stream
             fault_point('rpc.server.dispatch')
+            # adopt the caller's span context for the handler: spans it
+            # opens (and anything it propagates onward — mp producer
+            # commands, serving submits) join the caller's trace, so
+            # one request id recovers the whole cross-process tree
+            ctx = req.get('ctx')
             try:
-              fn = outer._handlers[req['func']]
-              result = fn(*req.get('args', ()), **req.get('kwargs', {}))
+              with spans.adopt(ctx), \
+                  spans.span('rpc.server.handle', func=req['func']):
+                fn = outer._handlers[req['func']]
+                result = fn(*req.get('args', ()),
+                            **req.get('kwargs', {}))
               _send_frame(sock, {'ok': True, 'result': result})
             except Exception as e:  # noqa: BLE001 - errors cross the wire
               _send_frame(sock, {'ok': False,
@@ -260,14 +269,21 @@ class RpcClient:
     """One request/response round trip on the pooled connection."""
     import time as _time
 
+    from ..metrics import spans
     from ..utils.faults import fault_point
     t0 = _time.perf_counter()
+    # one client span per round trip, carrying the current trace (or
+    # this process's run_id) over the wire in the frame's ctx field —
+    # the server adopts it for the handler, so client and server spans
+    # of one request join on the same id
+    sp = spans.begin('rpc.client.request', rank=rank, func=func)
     try:
       fault_point('rpc.client.request')
       sock = self._conn(rank, connect_timeout=timeout)
       if timeout is not None:
         sock.settimeout(timeout)
-      _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs})
+      _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs,
+                         'ctx': {'trace': sp.trace, 'span': sp.span_id}})
       resp = _recv_frame(sock)
       fault_point('rpc.client.response')
       if timeout is not None:
@@ -275,16 +291,21 @@ class RpcClient:
     except socket.timeout as e:
       # normalize to TimeoutError so retry_on and callers see one type
       self._drop_conn(rank)
+      spans.end(sp, ok=False, error='timeout')
       raise TimeoutError(
           f'rpc to rank {rank} func {func!r} timed out after '
           f'{timeout}s') from e
-    except (ConnectionError, EOFError, OSError):
+    except BaseException as e:
       # a broken pooled connection must not poison the next attempt
-      self._drop_conn(rank)
+      if isinstance(e, (ConnectionError, EOFError, OSError)):
+        self._drop_conn(rank)
+      spans.end(sp, ok=False, error=type(e).__name__)
       raise
     if not resp['ok']:
+      spans.end(sp, ok=False, error='remote')
       raise RuntimeError(
           f'remote error from rank {rank}: {resp["error"]}')
+    spans.end(sp, ok=True)
     # SUCCESSFUL round trips feed the control/stream-plane latency
     # histogram — the p50/p99 every remote-batch consumer actually pays
     # per RPC. Failures (including ok=False remote errors, often

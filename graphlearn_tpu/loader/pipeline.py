@@ -143,8 +143,11 @@ class OverlappedTrainer(FusedEpochTrainer):
     # donate the consumed batch buffers (state update buffers are small
     # relative to the 938k-slot batch; donation keeps HBM flat at two
     # batches in flight)
-    self._prime_fn = jax.jit(_sample_collate)
-    self._fused_fn = jax.jit(_fused, donate_argnums=(1,))
+    from ..metrics import programs
+    self._prime_fn = programs.instrument(jax.jit(_sample_collate),
+                                         'prime')
+    self._fused_fn = programs.instrument(
+        jax.jit(_fused, donate_argnums=(1,)), 'fused_step')
 
   # ---------------------------------------------------------------- loop
 
@@ -507,9 +510,11 @@ class DistFusedEpochTrainer:
     ``(state, loss, acc)`` — loss/acc replicated device scalars."""
     import jax.numpy as jnp
 
+    from ..metrics import programs
     from ..utils.trace import record_dispatch
     if self._step_fn is None:
-      self._step_fn = self._build_step_fn()
+      self._step_fn = programs.instrument(self._build_step_fn(),
+                                          'dist_train_step')
     if self.is_hetero:
       y = batch.y[self._input_type]
       nseed = jnp.asarray(batch.num_sampled_nodes[self._input_type])[:, 0]

@@ -61,6 +61,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..metrics import programs, spans
 from ..utils.strict import strict_guards
 from ..utils.trace import record_dispatch
 from .node_loader import NodeLoader
@@ -106,9 +107,17 @@ class ScanTrainer(FusedEpochTrainer):
                                         0xFFFFFFFF)
     self._epochs = 0        # folds into the perm key: fresh shuffle/epoch
     self._seeds_dev = None  # input seeds, uploaded once
-    self._seed_fn = self._build_seed_fn()
-    self._chunk_fn = self._build_chunk_fn()
-    self._concat_fn = self._build_concat_fn()
+    # program-observatory instrumentation under the record_dispatch
+    # site names: compile/retrace detection (+ signature diffs) rides
+    # every dispatch as one host-side cache-size read — the "ONE
+    # executable per chunk length" contract becomes observable, and
+    # retrace_budget can enforce it (metrics/programs.py)
+    self._seed_fn = programs.instrument(self._build_seed_fn(),
+                                        'epoch_seeds')
+    self._chunk_fn = programs.instrument(self._build_chunk_fn(),
+                                         'scan_chunk')
+    self._concat_fn = programs.instrument(self._build_concat_fn(),
+                                          'metrics_concat')
 
   # ------------------------------------------------------------- programs
 
@@ -214,10 +223,19 @@ class ScanTrainer(FusedEpochTrainer):
     truncated = False
     if max_steps is not None and max_steps < steps:
       steps, truncated = max_steps, True
+    # the epoch span is current for the whole program region: chunk
+    # spans (and any spans the model hooks open) parent under it.
+    # Begun AFTER the step arithmetic so every path below (zero-step
+    # return, try/finally) provably ends it — an attached span leaked
+    # by a prologue exception would mis-parent the thread's spans for
+    # the rest of the process
+    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                             epoch=epoch_no)
     if steps <= 0:
       # zero-batch epochs still record (the per-step loop writes a
       # steps=0 line) so flight epoch counts line up across drivers
       empty = jnp.zeros((0,), jnp.float32)
+      spans.end(epoch_span, steps=0, completed=True)
       flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
                        steps=0, config=self._flight_config(),
                        extra={'chunk_size': self.chunk_size,
@@ -248,6 +266,10 @@ class ScanTrainer(FusedEpochTrainer):
       # number the re-run will redraw and the steps the scan actually
       # dispatched (chunk-granular), matching the per-step emitters'
       # delivered-batch semantics
+      spans.end(epoch_span,
+                steps=(steps if completed else
+                       getattr(self, '_steps_dispatched', 0)),
+                completed=completed)
       flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
                        steps=(steps if completed else
                               getattr(self, '_steps_dispatched', 0)),
@@ -289,10 +311,13 @@ class ScanTrainer(FusedEpochTrainer):
       while start < steps:
         k = min(self.chunk_size, steps - start)
         record_dispatch('scan_chunk')
-        state, ovf, loss_k, acc_k = self._chunk_fn(
-            state, ovf, fargs, self._feats, self._id2i, self._labels,
-            seed_mat, mask_mat, base_key, count0,
-            jax.device_put(np.int32(start)), k)
+        # chunk-level span: host clocks only (the dispatch is async, so
+        # dur is dispatch wall, not device compute — PERF.md's point)
+        with spans.span('epoch.chunk', start=start, k=k):
+          state, ovf, loss_k, acc_k = self._chunk_fn(
+              state, ovf, fargs, self._feats, self._id2i, self._labels,
+              seed_mat, mask_mat, base_key, count0,
+              jax.device_put(np.int32(start)), k)
         losses.append(loss_k)
         accs.append(acc_k)
         start += k
@@ -385,9 +410,11 @@ class DistScanTrainer(DistFusedEpochTrainer):
     self._seeds_dev = None  # input seeds, uploaded once
     self._shard_tree, self._repl_tree, self._sc_body = \
         self._make_sample_collate()
-    self._seed_fn = self._build_seed_fn()
+    self._seed_fn = programs.instrument(self._build_seed_fn(),
+                                        'dist_epoch_seeds')
     self._chunk_fns = {}    # k (static chunk length) -> program
-    self._concat_fn = self._build_concat_fn()
+    self._concat_fn = programs.instrument(self._build_concat_fn(),
+                                          'dist_metrics_concat')
 
   # ------------------------------------------------------------- programs
 
@@ -497,7 +524,8 @@ class DistScanTrainer(DistFusedEpochTrainer):
     # donate the train state + the overflow/stats carries (args 3-6 +
     # 2); the graph/feature tables and seed matrix are reused across
     # chunks and must NOT be donated
-    jfn = jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6))
+    jfn = programs.instrument(jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6)),
+                              'dist_scan_chunk')
     self._chunk_fns[k] = jfn
     return jfn
 
@@ -537,6 +565,10 @@ class DistScanTrainer(DistFusedEpochTrainer):
     truncated = False
     if max_steps is not None and max_steps < steps:
       steps, truncated = max_steps, True
+    # begun after the step arithmetic: every path below ends the span
+    # (zero-step finally, main finally) — see ScanTrainer.run_epoch
+    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                             epoch=epoch_no)
     if steps <= 0:
       # mirror the per-step loop's zero-batch epoch (DistLoader.__iter__
       # closes the overflow guard and STILL publishes in its finally):
@@ -547,14 +579,22 @@ class DistScanTrainer(DistFusedEpochTrainer):
         if guarded and not truncated:
           self.loader._finish_epoch_overflow()
       finally:
-        self.loader._publish_feature_stats()
-        # zero-batch epochs still record, like the per-step loop's
-        # steps=0 line, so flight epoch counts line up across drivers
-        flight.epoch_end(flight_tok, emitter=self._NAME,
-                         epoch=epoch_no, steps=0,
-                         config=self._flight_config(),
-                         extra={'chunk_size': self.chunk_size,
-                                'truncated': truncated})
+        # publish BEFORE the flight record (feature fields must
+        # bit-match the freshly published counters) but never at the
+        # cost of the record or the attached span: a raising fetch
+        # must still end both (a leaked attached span mis-parents
+        # every later span on this thread)
+        try:
+          self.loader._publish_feature_stats()
+        finally:
+          # zero-batch epochs still record, like the per-step loop's
+          # steps=0 line, so flight epoch counts line up across drivers
+          spans.end(epoch_span, steps=0, completed=True)
+          flight.epoch_end(flight_tok, emitter=self._NAME,
+                           epoch=epoch_no, steps=0,
+                           config=self._flight_config(),
+                           extra={'chunk_size': self.chunk_size,
+                                  'truncated': truncated})
       return state, empty, empty
 
     completed = False
@@ -582,15 +622,24 @@ class DistScanTrainer(DistFusedEpochTrainer):
       # dist_feature.* counters. Host deltas only — outside
       # strict_guards, zero extra dispatches; a failed epoch records
       # completed=False under the un-advanced epoch number its re-run
-      # will redraw
-      self.loader._publish_feature_stats()
-      flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
-                       steps=(steps if completed else
-                              getattr(self, '_steps_dispatched', 0)),
-                       completed=completed,
-                       config=self._flight_config(),
-                       extra={'chunk_size': self.chunk_size,
-                              'truncated': truncated})
+      # will redraw. The publish is itself a device fetch that can
+      # raise against a broken device — the span and flight record
+      # (the postmortem trail for exactly that failure) must still
+      # close, so they sit in an inner finally
+      try:
+        self.loader._publish_feature_stats()
+      finally:
+        spans.end(epoch_span,
+                  steps=(steps if completed else
+                         getattr(self, '_steps_dispatched', 0)),
+                  completed=completed)
+        flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
+                         steps=(steps if completed else
+                                getattr(self, '_steps_dispatched', 0)),
+                         completed=completed,
+                         config=self._flight_config(),
+                         extra={'chunk_size': self.chunk_size,
+                                'truncated': truncated})
     return state, losses, accs
 
   def _run_epoch_body(self, state, steps, full_steps):
@@ -654,11 +703,12 @@ class DistScanTrainer(DistFusedEpochTrainer):
         while start < steps:
           k = min(self.chunk_size, steps - start)
           record_dispatch('dist_scan_chunk')
-          params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
-              self._chunk_fn_for(k)(
-                  self._shard_tree, self._repl_tree, stats, params,
-                  opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
-                  count0, jax.device_put(np.int32(start), repl))
+          with spans.span('epoch.chunk', start=start, k=k):
+            params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
+                self._chunk_fn_for(k)(
+                    self._shard_tree, self._repl_tree, stats, params,
+                    opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
+                    count0, jax.device_put(np.int32(start), repl))
           stats_back(stats)
           losses.append(loss_k)
           accs.append(acc_k)

@@ -13,7 +13,14 @@ Three pieces (docs/observability.md):
   producer worker snapshots), ``merge_scrape`` folds them into one
   cluster view;
 * the epoch flight recorder (``flight``) — one JSONL record per epoch
-  to ``GLT_RUN_LOG`` for postmortem diffing of long runs.
+  to ``GLT_RUN_LOG`` for postmortem diffing of long runs;
+* the program observatory (``programs``) — compile/retrace detection
+  with signature diffs and opt-in XLA cost attribution at every
+  instrumented dispatch site, plus the ``retrace_budget`` guard rail;
+* correlated spans (``spans``) — host-clock begin/end records with a
+  ``run_id``/request-id context propagated over RPC metadata, the mp
+  worker snapshot queue and ``ServingEngine.submit``, recoverable
+  across processes from ``scrape_all()`` + ``GLT_SPAN_LOG``.
 
 The package is ZERO-DEPENDENCY (pure stdlib): mp sampling workers,
 bench tooling and the static analyzer's fixtures all import it
@@ -30,11 +37,14 @@ Idiomatic call forms (the forms the lint rule checks)::
     metrics.snapshot()           # this process
     metrics.scrape_all()         # the cluster, role-labelled
 """
-from . import flight
+from . import flight, programs, spans
+from .programs import (ProgramRegistry, RetraceBudgetExceeded,
+                       default_program_registry, instrument,
+                       retrace_budget)
 from .registry import (BUCKET_SCHEMA, HIST_BOUNDS, Counter, Gauge,
                        Histogram, MetricRegistry, default_registry,
                        merge_snapshots, quantile_from_state)
-from .registry_names import REGISTERED_METRICS
+from .registry_names import REGISTERED_METRICS, REGISTERED_SPANS
 from .scrape import (merge_scrape, register_source, scrape_all,
                      unregister_source)
 

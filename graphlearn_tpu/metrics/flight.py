@@ -28,6 +28,7 @@ import hashlib
 import json
 import logging
 import os
+import threading
 import time
 from typing import Optional
 
@@ -36,6 +37,84 @@ SCHEMA = 1
 
 logger = logging.getLogger('graphlearn_tpu.flight')
 _warned_paths = set()   # one write-failure warning per path, not per epoch
+
+
+class JsonlAppender:
+  """Append JSON records to a JSONL trail, tolerating an unwritable
+  path with ONE warning (records are then dropped — observability must
+  never kill work). Shared by the flight and span recorders.
+
+  ``keep_open=True`` holds a flushed append handle between records —
+  the span recorder emits per-RPC/per-request, where a fresh
+  open/close per record would tax the very latencies being measured.
+  The flight recorder writes once per epoch and keeps the default
+  (per-record open), preserving recreate-the-file-under-it semantics.
+  A path change (tests pointing the env var at a fresh tmp dir)
+  reopens transparently."""
+
+  def __init__(self, env_var: str, keep_open: bool = False):
+    self._env_var = env_var
+    self._keep_open = keep_open
+    self._lock = threading.Lock()
+    self._path: Optional[str] = None
+    self._fh = None
+
+  def append(self, path: str, rec: dict) -> bool:
+    line = json.dumps(rec, sort_keys=True) + '\n'
+    try:
+      with self._lock:
+        if not self._keep_open:
+          with open(path, 'a', encoding='utf-8') as fh:
+            fh.write(line)
+          return True
+        if self._fh is None or self._path != path:
+          if self._fh is not None:
+            try:
+              self._fh.close()
+            except OSError:
+              pass
+          self._fh = open(path, 'a', encoding='utf-8')
+          self._path = path
+        self._fh.write(line)
+        self._fh.flush()   # readers (tests, tail -f) see records live
+      return True
+    except OSError as e:
+      with self._lock:
+        self._fh = None
+        self._path = None
+      if path not in _warned_paths:
+        _warned_paths.add(path)
+        logger.warning('%s=%s is unwritable (%s) — records for this '
+                       'path are being dropped', self._env_var, path, e)
+      return False
+
+
+def read_jsonl(path: Optional[str],
+               kind: Optional[str] = None) -> list:
+  """Parse a JSONL trail back into record dicts, optionally filtered
+  by their ``kind`` field. Unparseable lines are skipped — a run
+  killed mid-write must not take the rest of the log with it. Shared
+  by flight.read_records and spans.read_log."""
+  if not path or not os.path.exists(path):
+    return []
+  out = []
+  with open(path, encoding='utf-8') as fh:
+    for line in fh:
+      line = line.strip()
+      if not line:
+        continue
+      try:
+        rec = json.loads(line)
+      except ValueError:
+        continue
+      if kind is not None and not (isinstance(rec, dict) and
+                                   rec.get('kind') == kind):
+        continue
+      out.append(rec)
+  return out
+
+
+_appender = JsonlAppender(ENV_VAR)
 
 
 def run_log_path() -> Optional[str]:
@@ -72,11 +151,13 @@ def epoch_begin() -> Optional[dict]:
   if not path:
     return None
   from ..utils import trace
+  from . import programs
   from .registry import default_registry
   return {'path': path,
           't0': time.perf_counter(),
           'counters': default_registry().counters(),
-          'dispatch': trace.dispatch_snapshot()}
+          'dispatch': trace.dispatch_snapshot(),
+          'programs': programs.flight_snapshot()}
 
 
 def _delta(now: dict, base: dict) -> dict:
@@ -100,6 +181,7 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
   if token is None:
     return None
   from ..utils import trace
+  from . import programs, spans
   from .registry import default_registry
   wall = time.perf_counter() - token['t0']
   cdelta = _delta(default_registry().counters(), token['counters'])
@@ -108,6 +190,18 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
     dispatch = None
   else:
     dispatch = _delta(d_now, token['dispatch'])
+  # program-observatory delta: which sites compiled/dispatched THIS
+  # epoch (host bookkeeping only — epoch 1 shows the compiles, a
+  # steady-state epoch shows pure dispatch counts, and a retrace
+  # mid-run shows up as a compiles delta on an old site)
+  prog_base = token.get('programs') or {}
+  prog = {}
+  for site, now in programs.flight_snapshot().items():
+    base = prog_base.get(site, {})
+    d = {k: round(v - base.get(k, 0), 6) for k, v in now.items()
+         if v != base.get(k, 0)}
+    if d:
+      prog[site] = d
 
   def split(*prefixes):
     return {k: v for k, v in cdelta.items()
@@ -120,6 +214,9 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
   record = {
       'schema': SCHEMA,
       'kind': 'epoch',
+      # run_id joins this record to metric scrapes and span trees from
+      # the same run (spans.run_id — GLT_RUN_ID or minted per process)
+      'run_id': spans.run_id(),
       'emitter': emitter,
       'epoch': int(epoch),
       'steps': int(steps),
@@ -131,6 +228,7 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
       'feature': feature,
       'resilience': resilience,
       'fault': fault,
+      'programs': prog,
       'counters': {k: v for k, v in cdelta.items() if k not in known},
       'config': _jsonable(config or {}),
       'config_fingerprint': config_fingerprint(config or {}),
@@ -139,15 +237,7 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
   }
   if extra:
     record.update(_jsonable(extra))
-  try:
-    with open(token['path'], 'a', encoding='utf-8') as fh:
-      fh.write(json.dumps(record, sort_keys=True) + '\n')
-  except OSError as e:
-    if token['path'] not in _warned_paths:
-      _warned_paths.add(token['path'])
-      logger.warning('GLT_RUN_LOG=%s is unwritable (%s) — flight '
-                     'records for this path are being dropped',
-                     token['path'], e)
+  _appender.append(token['path'], record)
   return record
 
 
@@ -173,17 +263,4 @@ def read_records(path: Optional[str] = None) -> list:
   """Parse a flight log back into record dicts (postmortem tooling /
   tests). Unparseable lines are skipped — a run killed mid-write must
   not take the rest of the log with it."""
-  path = path or run_log_path()
-  if not path or not os.path.exists(path):
-    return []
-  out = []
-  with open(path, encoding='utf-8') as fh:
-    for line in fh:
-      line = line.strip()
-      if not line:
-        continue
-      try:
-        out.append(json.loads(line))
-      except ValueError:
-        continue
-  return out
+  return read_jsonl(path or run_log_path())

@@ -49,4 +49,39 @@ REGISTERED_METRICS = frozenset({
     'serving.batch_fill',
     'serving.compute_ms',
     'serving.total_ms',
+    # program observatory (metrics/programs.py): compiles/retraces at
+    # instrumented dispatch sites; per-site detail lives in the
+    # ProgramRegistry (flight 'programs' field), not the metric store
+    'program.compiles',
+    'program.retraces',
+    'program.compile_ms',
+    'program.retrace_budget_exceeded',
+})
+
+# The closed inventory of SPAN names (metrics/spans.py) — the same
+# contract as metrics: literal at every spans.span/begin/emit call
+# site, registered here, documented in the docs/observability.md span
+# table. Enforced by graftlint's ``span-registry`` rule; the baseline
+# stays empty.
+REGISTERED_SPANS = frozenset({
+    # RPC plane (distributed/rpc.py): one client span per round trip,
+    # one server span per handled request — the cross-process seam
+    'rpc.client.request',
+    'rpc.server.handle',
+    # epoch drivers (loader/scan_epoch.py, distributed/dist_loader.py)
+    'epoch.run',
+    'epoch.chunk',
+    # remote-loader failover (distributed/dist_loader.py): carries the
+    # resilience annotations for the degraded epoch's span tree
+    'loader.failover',
+    # mp sampling workers (distributed/dist_sampling_producer.py)
+    'producer.epoch',
+    'producer.batch',
+    # online serving (serving/engine.py): the queue→batch→compute→
+    # respond tree one request yields (docs/serving.md)
+    'serving.request',
+    'serving.queue',
+    'serving.batch',
+    'serving.compute',
+    'serving.respond',
 })

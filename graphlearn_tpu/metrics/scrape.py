@@ -71,9 +71,17 @@ def scrape_all(include_local: bool = True,
   (blackholed, no RST) server must degrade to its error entry in
   seconds, not stall every healthy server's snapshot behind a dead
   connect. Pass None to fall back to the retry policy's budget."""
+  from . import spans as _spans
   out: Dict[str, dict] = {}
   if include_local:
-    out[_local_role()] = default_registry().snapshot()
+    snap = default_registry().snapshot()
+    # run_id + span ring ride the snapshot as extra keys (ignored by
+    # merge_snapshots): a scrape, a flight record and a span tree from
+    # the same run join on run_id, and spans.from_scrape() recovers a
+    # request's spans from the scrape result by id alone
+    snap['run_id'] = _spans.run_id()
+    snap['spans'] = _spans.export(limit=_spans.SCRAPE_EXPORT_LIMIT)
+    out[_local_role()] = snap
   with _sources_lock:
     sources = dict(_sources)
   for role, fn in sources.items():

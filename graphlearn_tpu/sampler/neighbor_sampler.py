@@ -806,7 +806,9 @@ class NeighborSampler(BaseSampler):
     sig = ('homo', batch_cap, tuple(fanouts), self.with_edge,
            self.with_weight, self.padded_window, self.strategy)
     if sig not in self._fns:
-      self._fns[sig] = self._build_homo_fn(batch_cap, tuple(fanouts))
+      from ..metrics import programs
+      self._fns[sig] = programs.instrument(
+          self._build_homo_fn(batch_cap, tuple(fanouts)), 'sample')
     return self._fns[sig]
 
   def _graph_arrays(self, etype=None):

@@ -251,8 +251,9 @@ class EmbeddingMaterializer:
     if key not in self._init_fns:
       import jax
       import jax.numpy as jnp
-      self._init_fns[key] = jax.jit(
-          lambda: jnp.zeros(shape, dtype))
+      from ..metrics import programs
+      self._init_fns[key] = programs.instrument(
+          jax.jit(lambda: jnp.zeros(shape, dtype)), 'embed_store_init')
     return self._init_fns[key]
 
   def _out_spec(self, slice_fn, in_specs):
@@ -291,7 +292,9 @@ class EmbeddingMaterializer:
       out, _ = lax.scan(body, out, start + lax.iota(jnp.int32, k))
       return out
 
-    fn = jax.jit(chunk, donate_argnums=(2,))
+    from ..metrics import programs
+    fn = programs.instrument(jax.jit(chunk, donate_argnums=(2,)),
+                             'embed_chunk')
     self._chunk_fns[key] = fn
     return fn
 
@@ -424,7 +427,9 @@ class EmbeddingMaterializer:
       out, _ = lax.scan(body, out, start + lax.iota(jnp.int32, k))
       return out
 
-    fn = jax.jit(chunk, donate_argnums=(2,))
+    from ..metrics import programs
+    fn = programs.instrument(jax.jit(chunk, donate_argnums=(2,)),
+                             'embed_chunk')
     self._chunk_fns[key] = fn
     return fn
 
@@ -453,7 +458,9 @@ class EmbeddingMaterializer:
       out, _ = lax.scan(body, out, start + lax.iota(jnp.int32, k))
       return out
 
-    fn = jax.jit(chunk, donate_argnums=(2,))
+    from ..metrics import programs
+    fn = programs.instrument(jax.jit(chunk, donate_argnums=(2,)),
+                             'embed_chunk')
     self._chunk_fns[key] = fn
     return fn
 
@@ -622,7 +629,8 @@ class EmbeddingMaterializer:
                    edge_index=ei, edge_mask=em)
       return slice_fn(params, batch)[:cap]
 
-    fn = jax.jit(refresh)
+    from ..metrics import programs
+    fn = programs.instrument(jax.jit(refresh), 'serve_refresh')
     self._refresh_fns[cap] = fn
     return fn
 

@@ -80,7 +80,9 @@ class EmbeddingStore:
         rows = emb[jnp.maximum(ids, 0)]
         return jnp.where(mask[:, None], rows, 0)
 
-      self._gather = jax.jit(gather)
+      from ..metrics import programs
+      self._gather = programs.instrument(jax.jit(gather),
+                                         'serve_lookup')
     return self._gather
 
   def lookup(self, ids, mask):
@@ -118,7 +120,9 @@ class EmbeddingStore:
       def scatter(emb, idx, vals):
         return emb.at[idx].set(vals.astype(emb.dtype), mode='drop')
 
-      self._scatter = jax.jit(scatter, donate_argnums=(0,))
+      from ..metrics import programs
+      self._scatter = programs.instrument(
+          jax.jit(scatter, donate_argnums=(0,)), 'serve_store_update')
     record_dispatch('serve_store_update')
     self._emb = self._scatter(self._emb, jnp.asarray(idx),
                               jnp.asarray(vals))

@@ -270,6 +270,15 @@ def reset_counters(prefix: str = ''):
 
 
 _active = False
+_active_dir: Optional[str] = None
+
+
+def active_profile_dir() -> Optional[str]:
+  """The live maybe_start_trace() session's log dir, or None. Spans
+  opened while a profiler session is live stamp this key
+  (metrics/spans.py ``profile_key``), so device traces and host span
+  trees correlate — previously the key only reached flight records."""
+  return _active_dir if _active else None
 
 
 def maybe_start_trace(env_var: str = 'GLT_PROFILE_DIR') -> Optional[str]:
@@ -280,7 +289,7 @@ def maybe_start_trace(env_var: str = 'GLT_PROFILE_DIR') -> Optional[str]:
   False AND best-effort-close any half-opened profiler session —
   otherwise the next maybe_start_trace either silently no-ops for the
   rest of the run or trips over the orphaned session."""
-  global _active
+  global _active, _active_dir
   logdir = os.environ.get(env_var)
   if logdir and not _active:
     import jax
@@ -288,12 +297,14 @@ def maybe_start_trace(env_var: str = 'GLT_PROFILE_DIR') -> Optional[str]:
       jax.profiler.start_trace(logdir)
     except BaseException:
       _active = False
+      _active_dir = None
       try:       # close a partially-started session so a later start
         jax.profiler.stop_trace()   # isn't wedged by the orphan
       except Exception:  # noqa: BLE001 - cleanup of a failed start
         pass
       raise
     _active = True
+    _active_dir = logdir
     return logdir
   return None
 
@@ -304,8 +315,9 @@ def stop_trace():
   must not leave the flag stuck True, where every later
   maybe_start_trace would silently no-op and the run would quietly
   produce no traces at all."""
-  global _active
+  global _active, _active_dir
   if _active:
     import jax
     _active = False
+    _active_dir = None
     jax.profiler.stop_trace()

@@ -31,6 +31,14 @@ fi
 echo "== bench schema =="
 python bench.py --validate || rc=1
 
+echo "== flight/span JSONL schema =="
+# with no args this SELF-CHECKS: one record through each real recorder
+# (flight + span), validated against metrics/logcheck.py — a
+# recorder/schema drift fails lint in the change that introduces it.
+# Pass file paths to validate captured GLT_RUN_LOG / GLT_SPAN_LOG
+# trails from a run.
+python -m graphlearn_tpu.metrics.logcheck || rc=1
+
 echo "== bench trajectory gate =="
 # >20% round-over-round regression on a declared lower-is-better key
 # (BENCH_LOWER_IS_BETTER) fails the gate; rounds without numbers are

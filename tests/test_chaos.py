@@ -105,6 +105,11 @@ def test_sigkill_server_mid_epoch_failover(monkeypatch, tmp_path):
     opts = glt.distributed.RemoteDistSamplingWorkerOptions(
         server_rank=[0, 1], num_workers=1, prefetch_size=2,
         heartbeat_interval=0.5, heartbeat_miss=3)
+    # scope the process-global span ring BEFORE the loader exists: the
+    # construction RPCs' client spans must stay in the ring, or the
+    # servers' handle spans (which parent under them) read as orphans
+    from graphlearn_tpu.metrics import spans as spans_mod
+    spans_mod.reset()
     loader = glt.distributed.RemoteDistNeighborLoader(
         [2, 2], np.arange(N), batch_size=4, collect_features=True,
         worker_options=opts, seed=0)
@@ -140,6 +145,36 @@ def test_sigkill_server_mid_epoch_failover(monkeypatch, tmp_path):
     assert rec['resilience'].get('resilience.failover_seeds', 0) == \
         trace.counter_get('resilience.failover_seeds')
     assert '1' in rec['dead_ranks']
+    # span acceptance for a REAL process death: the epoch yields one
+    # joinable tree — client ring + the SURVIVOR's scrape (its handle
+    # spans + its producers' worker spans; the victim's spans died with
+    # it and parent nothing local, so no orphans) — and the failover
+    # span carries the resilience annotations
+    from graphlearn_tpu import metrics as metrics_mod
+    from graphlearn_tpu.metrics import spans as sp
+    run = sp.run_id()
+    assert rec['run_id'] == run
+    remote_spans, deadline = [], time.monotonic() + 15
+    while time.monotonic() < deadline:
+      scrape = metrics_mod.scrape_all(timeout=5.0)
+      remote_spans = [r for r in sp.from_scrape(scrape)
+                      if r['trace'] == run]
+      if any(r['name'] == 'producer.epoch' for r in remote_spans):
+        break
+      time.sleep(0.2)
+    collected = sp.dedupe(sp.export(trace=run) + remote_spans)
+    tree = sp.build_tree(collected)
+    assert tree['orphans'] == []
+    by_name = {}
+    for r in collected:
+      by_name.setdefault(r['name'], []).append(r)
+    [epoch_root] = [r for r in by_name['epoch.run']
+                    if r['attrs'].get('completed')]
+    fo = by_name['loader.failover']
+    assert fo and all(f['parent'] == epoch_root['span'] for f in fo)
+    assert any(f['attrs'].get('seeds', 0) >= 0 and 'cause' in f['attrs']
+               for f in fo)
+    assert by_name.get('producer.epoch'), 'survivor worker spans missing'
 
     # epoch 2 on the degraded cluster: dead rank's full share fails
     # over at epoch start, batch count and coverage still exact
@@ -205,6 +240,10 @@ def test_injected_fetch_failure_triggers_failover(monkeypatch, tmp_path):
         [2, 2], np.arange(N), batch_size=4, collect_features=True,
         worker_options=opts, seed=0)
     expected = len(loader)
+    # scope the span ring to THIS epoch: the ring is process-global and
+    # every local span carries the same process run_id
+    from graphlearn_tpu.metrics import spans as spans_mod
+    spans_mod.reset()
     # fail the 5th fetch, once — mid-epoch, after some batches landed
     faults.arm('channel.remote.fetch', 'raise', exc=ConnectionError,
                after=4, times=1)
@@ -222,6 +261,37 @@ def test_injected_fetch_failure_triggers_failover(monkeypatch, tmp_path):
     assert rec['completed'] is True and rec['steps'] == expected
     assert rec['resilience']['resilience.failover'] == 1
     assert rec['fault']['fault.channel.remote.fetch'] == 1
+    # observability acceptance: the failover epoch yields ONE joinable
+    # span tree (client ring + producer worker rings, joined by the
+    # epoch's trace id = this process run_id, which the flight record
+    # also carries), the failover span carries the resilience
+    # annotations, and producer respawn/replacement leaves NO orphans
+    from graphlearn_tpu.metrics import spans
+    assert rec['run_id'] == spans.run_id()
+    collected = list(spans.export(trace=spans.run_id()))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+      worker_spans = [
+          r for s, _ in pairs
+          for snap in s.get_metrics()['producers'].values()
+          for r in snap.get('spans', ())
+          if r['trace'] == spans.run_id()]
+      if any(r['name'] == 'producer.epoch' for r in worker_spans):
+        break
+      time.sleep(0.05)
+    tree = spans.build_tree(collected + worker_spans)
+    assert tree['orphans'] == []
+    by_name = {}
+    for r in tree['spans'].values():
+      by_name.setdefault(r['name'], []).append(r)
+    [epoch_span] = [r for r in by_name['epoch.run']
+                    if r['attrs'].get('completed')]
+    [fo] = by_name['loader.failover']
+    assert fo['parent'] == epoch_span['span']     # annotation ON the tree
+    assert fo['attrs']['rank'] == 0 or fo['attrs']['rank'] == 1
+    assert 'seeds' in fo['attrs'] and 'cause' in fo['attrs']
+    # worker spans chain to the epoch root through the server handles
+    assert by_name.get('producer.epoch') and by_name.get('producer.batch')
     loader.shutdown()
   finally:
     faults.disarm()
@@ -392,6 +462,24 @@ def test_worker_restart_and_replay_completes_epoch(monkeypatch):
         break
     assert got == server.producer_num_expected(pid) == 4
     assert trace.counter_get('resilience.worker_restart') == 1
+    # span acceptance: the respawned incarnation replays under the SAME
+    # propagated context — its producer.epoch span records the replay
+    # start batch, and the collected tree has no orphans (the dead
+    # incarnation never published, so no half-trees either)
+    from graphlearn_tpu.metrics import spans
+    worker_spans, deadline = [], time.monotonic() + 10
+    while time.monotonic() < deadline:
+      worker_spans = [r for snap in
+                      server.get_metrics()['producers'].values()
+                      for r in snap.get('spans', ())]
+      if any(r['name'] == 'producer.epoch' for r in worker_spans):
+        break
+      time.sleep(0.05)
+    epochs = [r for r in worker_spans if r['name'] == 'producer.epoch']
+    assert epochs and any(r['attrs']['start_batch'] == 2 for r in epochs)
+    tree = spans.build_tree(worker_spans +
+                            spans.export(trace=spans.run_id()))
+    assert tree['orphans'] == []
   finally:
     server.exit()
 

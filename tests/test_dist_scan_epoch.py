@@ -119,6 +119,8 @@ def run_equivalence(make_loader, model, tx, steps, chunk,
   assert dc_step.counts['dist_collate'] == steps
 
   fresh_counters()
+  from graphlearn_tpu.metrics import programs
+  c0 = programs.compile_count('dist_scan_chunk')
   with glt.utils.count_dispatches() as dc_scan:
     state_scan, losses, accs = trainer.run_epoch(state_scan)
   losses = np.asarray(losses)
@@ -127,6 +129,11 @@ def run_equivalence(make_loader, model, tx, steps, chunk,
   # the scan's whole-epoch budget: ceil(steps/K) + 2
   assert dc_scan.total <= -(-steps // chunk) + 2, dc_scan
   assert dc_scan.counts['dist_scan_chunk'] == -(-steps // chunk)
+  # program observatory (GLT_STRICT): compile_count == the executable
+  # population — ONE per chunk LENGTH (full K + optional tail), zero
+  # extra dispatches (dc_scan above bit-matches with it armed)
+  n_lengths = 1 if (steps <= chunk or steps % chunk == 0) else 2
+  assert programs.compile_count('dist_scan_chunk') - c0 == n_lengths
   # bit-exact losses + params
   np.testing.assert_array_equal(losses, losses_ref)
   assert np.asarray(accs).shape == (steps,)
@@ -140,7 +147,9 @@ def run_equivalence(make_loader, model, tx, steps, chunk,
   # both runs still matches (stream continuation)
   assert scan_loader.sampler._call_count == ref_loader.sampler._call_count
   state_ref, losses_ref2 = ref.run_epoch_steps(state_ref)
-  state_scan, losses2, _ = trainer.run_epoch(state_scan)
+  with programs.retrace_budget('dist_scan_chunk', 0):   # steady state
+    state_scan, losses2, _ = trainer.run_epoch(state_scan)
+  assert programs.compile_count('dist_scan_chunk') - c0 == n_lengths
   np.testing.assert_array_equal(
       np.asarray(losses2),
       np.asarray([np.asarray(x) for x in losses_ref2]))

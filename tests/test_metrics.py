@@ -410,7 +410,10 @@ def test_flight_record_dist_loader_feature_bitmatch(monkeypatch,
   assert rec['dispatch'] is None               # no region was active
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 8): the LOCAL ScanTrainer
 def test_flight_record_dist_scan_trainer(monkeypatch, tmp_path):
+  # flight bit-match stays tier-1, and the dist feature-stats parity is
+  # carried by test_dist_scan_epoch's equivalence protocol
   """Acceptance on the SCANNED distributed epoch: the flight record's
   dispatch fields bit-match the live counter at the ceil(steps/K)+2
   budget (recording adds zero dispatches), its feature fields bit-match
@@ -649,6 +652,68 @@ def test_metric_rule_package_is_clean():
   pkg = os.path.join(REPO, 'graphlearn_tpu')
   findings, *_ = run_lint([pkg], Config())
   assert [f for f in findings if f.rule == 'metric-registry'] == []
+
+
+# ----------------------------------------------- graftlint span-registry
+
+
+def _run_span_rule(tmp_path, code, registry_src=None, doc=None):
+  from graphlearn_tpu.analysis.core import Config, run_lint
+  reg = tmp_path / 'regnames.py'
+  reg.write_text(registry_src or
+                 "REGISTERED_SPANS = frozenset({\n"
+                 "    'good.span', 'undoc.span',\n"
+                 "})\n")
+  (tmp_path / 'obs.md').write_text(doc if doc is not None else
+                                   'Spans: `good.span`.\n')
+  mod = tmp_path / 'code.py'
+  mod.write_text(code)
+  cfg = Config(metrics_registry_module='regnames.py',
+               observability_doc='obs.md',
+               metrics_exempt_modules=(),
+               repo_root=str(tmp_path))
+  findings, *_ = run_lint([str(mod), str(reg)], cfg)
+  return [f for f in findings if f.rule == 'span-registry']
+
+
+def test_span_rule_literal_registered_ok(tmp_path):
+  out = _run_span_rule(tmp_path, (
+      'from graphlearn_tpu.metrics import spans\n'
+      'def f():\n'
+      "  with spans.span('good.span'):\n"
+      "    spans.end(spans.begin('good.span'))\n"
+      "    spans.emit('good.span', dur_ms=1.0)\n"))
+  assert [f for f in out if f.relpath == 'code.py'] == []
+  # the registry itself is flagged for its undocumented entry
+  assert any('undoc.span' in f.message and f.relpath == 'regnames.py'
+             for f in out)
+
+
+def test_span_rule_flags_unregistered_computed_and_undocumented(tmp_path):
+  out = _run_span_rule(tmp_path, (
+      'from graphlearn_tpu.metrics import spans\n'
+      'def f(name):\n'
+      "  spans.begin('rogue.span')\n"       # unregistered literal
+      '  spans.span(name)\n'                # computed
+      "  spans.emit('undoc.span')\n"))      # registered, undocumented
+  msgs = [f.message for f in out if f.relpath == 'code.py']
+  assert len(msgs) == 3
+  assert sum('not in metrics/registry_names.py' in m for m in msgs) == 1
+  assert sum('not a string literal' in m for m in msgs) == 1
+  assert sum('missing from' in m for m in msgs) == 1
+
+
+def test_span_rule_pragma_and_package_clean(tmp_path):
+  out = _run_span_rule(tmp_path, (
+      'from graphlearn_tpu.metrics import spans\n'
+      'def f(kind):\n'
+      '  # graftlint: allow[span-registry] caller-chosen name\n'
+      '  spans.begin(kind)\n'))
+  assert [f for f in out if f.relpath == 'code.py'] == []
+  from graphlearn_tpu.analysis.core import Config, run_lint
+  pkg = os.path.join(REPO, 'graphlearn_tpu')
+  findings, *_ = run_lint([pkg], Config())
+  assert [f for f in findings if f.rule == 'span-registry'] == []
 
 
 # ------------------------------------------------- bench trajectory gate

@@ -159,8 +159,14 @@ def test_scan_trainer_overflow_guard():
 def test_scan_trainer_dispatch_count():
   """A scanned epoch issues <= ceil(steps/K) + 2 instrumented dispatches
   (chunks + seed-matrix prologue + metrics concat), where the per-step
-  loop issues ~3 per step."""
+  loop issues ~3 per step. The program observatory rides the same
+  epoch: compile_count == the executable population (one per chunk
+  LENGTH) under GLT_STRICT, and a steady-state epoch compiles nothing
+  — recorded with zero extra dispatches (dc bit-matches the budget
+  with the observatory armed)."""
   import jax
+
+  from graphlearn_tpu.metrics import programs
   ds = make_dataset()
   num_seeds = 44     # 6 steps at batch 8 (ragged tail)
   chunk = 4          # ceil(6/4) = 2 chunk dispatches
@@ -170,13 +176,18 @@ def test_scan_trainer_dispatch_count():
                                            first)
   trainer = glt.loader.ScanTrainer(_make_loader(ds, num_seeds), model, tx,
                                    3, chunk_size=chunk)
+  c0 = programs.compile_count('scan_chunk')   # observatory is global
   state, _, _ = trainer.run_epoch(state)   # compile outside the count
+  # ONE executable per chunk length: the full-K chunk + the tail chunk
+  assert programs.compile_count('scan_chunk') - c0 == 2
   steps = 6
-  with glt.utils.count_dispatches() as dc:
-    state, losses, _ = trainer.run_epoch(state)
+  with programs.retrace_budget('scan_chunk', 0):   # steady state
+    with glt.utils.count_dispatches() as dc:
+      state, losses, _ = trainer.run_epoch(state)
   assert len(losses) == steps
   assert dc.total <= -(-steps // chunk) + 2, dc
   assert dc.counts['scan_chunk'] == -(-steps // chunk)
+  assert programs.compile_count('scan_chunk') - c0 == 2   # no retrace
 
   # contrast: the plain per-step loop pays >= 2 dispatches per step
   # (sample + collate; its train step is the caller's own dispatch)
@@ -186,6 +197,44 @@ def test_scan_trainer_dispatch_count():
       pass
   assert dc_loop.total >= 2 * steps
   assert dc_loop.counts['sample'] == steps
+
+
+def test_retrace_budget_catches_chunk_length_perturbation():
+  """Acceptance (PR 8): deliberately perturbing the chunk length
+  retraces the chunk program, retrace_budget catches it under
+  GLT_STRICT (conftest arms it for this module), and the error names
+  the changed argument — the static chunk-length k — in a
+  human-readable signature diff."""
+  import jax
+
+  from graphlearn_tpu.metrics import programs
+  from graphlearn_tpu.metrics.programs import RetraceBudgetExceeded
+  ds = make_dataset()
+  num_seeds = 32     # 4 steps at batch 8, chunk 4: ONE chunk length
+  model = GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2)
+  first = train_lib.batch_to_dict(next(iter(_make_loader(ds, 32))))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  trainer = glt.loader.ScanTrainer(_make_loader(ds, num_seeds), model,
+                                   tx, 3, chunk_size=4)
+  c0 = programs.compile_count('scan_chunk')
+  state, _, _ = trainer.run_epoch(state)
+  assert programs.compile_count('scan_chunk') - c0 == 1
+  # perturb the chunk length: the next epoch needs a NEW executable —
+  # exactly the silent production retrace the budget exists to catch
+  # (K=2 divides the 4 steps, so the epoch adds exactly one length)
+  trainer.chunk_size = 2
+  with pytest.raises(RetraceBudgetExceeded) as ei:
+    with programs.retrace_budget('scan_chunk', 0):
+      state, _, _ = trainer.run_epoch(state)
+  msg = str(ei.value)
+  assert 'scan_chunk' in msg and 'last retrace' in msg
+  # the diff names the changed argument: the static k, 4 -> 2
+  assert 'static:4 -> static:2' in msg, msg
+  # the run itself completed — the budget is a guard rail, not a wedge
+  assert programs.compile_count('scan_chunk') - c0 >= 2
+  ev = programs.last_compile('scan_chunk')
+  assert ev.index >= 1 and 'arg ' in ev.diff
 
 
 def test_wrap_dispatch_counts_user_calls():

@@ -287,7 +287,14 @@ def test_bucket_admission_property():
   reqs = [rng.integers(0, n, rng.integers(1, 50)) for _ in range(60)]
   engine = ServingEngine(store, buckets=(16, 64), max_wait_ms=1.0)
   results = [None] * len(reqs)
+  from graphlearn_tpu.metrics import programs
+  c0 = programs.compile_count('serve_lookup')
   with engine:
+    # touch both capacities deterministically (thread interleave decides
+    # which caps the concurrent traffic lands on): the compile count
+    # must equal the BUCKET SET, never the request count
+    engine.lookup(np.arange(5))      # cap 16
+    engine.lookup(np.arange(40))     # cap 64
     def client(lo, hi):
       for i in range(lo, hi):
         results[i] = engine.submit(reqs[i]).result(30)
@@ -301,7 +308,11 @@ def test_bucket_admission_property():
     assert res.shape == (ids.size, 4)         # padding never leaks
     np.testing.assert_allclose(res, np.asarray(emb)[ids], rtol=1e-6)
   snap = metrics.snapshot()
-  assert snap['counters']['serving.requests'] - base_req == len(reqs)
+  assert snap['counters']['serving.requests'] - base_req == len(reqs) + 2
+  # program observatory (GLT_STRICT): the closed static-shape contract
+  # is compile_count == the BUCKET set — one persistent executable per
+  # padded capacity, however many requests flowed through
+  assert programs.compile_count('serve_lookup') - c0 == 2
   # padding is engine-internal: out-of-range ids are rejected at the API
   with ServingEngine(store, buckets=(16,)) as eng2:
     with pytest.raises(ValueError, match='padding'):
