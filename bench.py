@@ -412,6 +412,16 @@ BENCH_KEY_REGISTRY = {
     # RUN_MEAN_IMPL decision pair (VERDICT r5)
     'run_mean_impl_reshape_ms': 'e2e step ms with RUN_MEAN_IMPL=reshape',
     'run_mean_impl_window_ms': 'e2e step ms with RUN_MEAN_IMPL=window',
+    # out-of-core tiered storage (storage/, ROADMAP item 2): a scanned
+    # epoch whose feature table is >= 4x the HBM(hot)+RAM(warm) budget,
+    # vs the identical all-HBM epoch — the oversubscription gate
+    'oversub_epoch_wall_s': 'tiered (HBM+RAM+disk) scanned epoch wall s',
+    'oversub_hbm_epoch_wall_s': 'all-HBM reference epoch wall s',
+    'oversub_ratio': 'tiered / all-HBM epoch wall (gate: ~1.5x)',
+    'prefetch_hit_rate': 'cold rows staged ahead / all cold-row reads',
+    'staged_mb_per_chunk': 'MB staged host->ring per scanned chunk',
+    'oversub_bit_identical': 'tiered epoch losses == all-HBM losses',
+    'oversub_config': 'graph/tier/oversubscription shape of the figures',
     # serving tier (PR 7): offline materialization + online endpoint
     'embed_epoch_wall_s': 'full-graph layer-wise materialization wall s',
     'embed_epoch_dispatches': 'materialization dispatches, all layers',
@@ -441,6 +451,7 @@ BENCH_KEY_REGISTRY = {
 BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
     'hetero_step', 'hetero_ref', 'feature_exchange', 'serving',
+    'oversub',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -464,6 +475,7 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'feature_exchange_mb_per_batch',
     'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
     'embed_epoch_wall_s', 'embed_epoch_dispatches',
+    'oversub_epoch_wall_s', 'staged_mb_per_chunk',
     'serving_p50_ms', 'serving_p99_ms',
     'hetero_rgnn_step_ms_bf16', 'hetero_rgnn_train_program_ms',
     'hetero_rgat_step_ms_bf16', 'hetero_rgat_train_program_ms',
@@ -1138,6 +1150,96 @@ def main():
   except Exception as e:
     result['feature_exchange_mb_per_batch'] = None
     result['feature_exchange_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- out-of-core oversubscription (storage/, ROADMAP item 2) ----
+  # A scanned epoch over a TieredFeature whose table is >= 4x the
+  # HBM(hot)+RAM(warm) budget, A/B'd against the identical all-HBM
+  # ScanTrainer epoch. Fetch-bearing by design (the prologue plan fetch
+  # + per-chunk slab uploads ARE the mechanism), so it sits after every
+  # dispatch-sensitive section; epoch 1 compiles, epoch 2 measures.
+  try:
+    import tempfile
+    import time as _time
+
+    from graphlearn_tpu import metrics as glt_metrics
+    from graphlearn_tpu.models import GraphSAGE as _SAGE
+    from graphlearn_tpu.models import train as _train_lib
+    from graphlearn_tpu.storage import TieredFeature, TieredScanTrainer
+    ov_n, ov_deg, ov_f = 60_000, 4, 64
+    ov_hot, ov_warm = 4096, 4096
+    ov_batch, ov_seeds, ov_k = 256, 8192, 8
+    ov_rng = np.random.default_rng(17)
+    ov_rows = np.repeat(np.arange(ov_n), ov_deg)
+    ov_cols = (ov_rows + ov_rng.integers(1, ov_n, ov_rows.shape[0])) % ov_n
+    ov_feat = ov_rng.standard_normal((ov_n, ov_f)).astype(np.float32)
+    ov_labels = ov_rng.integers(0, E2E_CLASSES, ov_n)
+    ov_pool = ov_rng.permutation(ov_n)[:ov_seeds].astype(np.int64)
+    feat_mb = ov_feat.nbytes / 1e6
+    budget_mb = (ov_hot + ov_warm) * ov_f * 4 / 1e6
+    assert feat_mb >= 4 * budget_mb, (feat_mb, budget_mb)
+
+    def ov_build(store_fn):
+      ds = glt.data.Dataset()
+      ds.init_graph(np.stack([ov_rows, ov_cols]), graph_mode='CPU',
+                    num_nodes=ov_n)
+      ds.node_features = store_fn()
+      ds.init_node_labels(ov_labels)
+      return glt.loader.NeighborLoader(ds, [3, 2], ov_pool,
+                                       batch_size=ov_batch, shuffle=False,
+                                       drop_last=True, seed=5)
+
+    ov_model = _SAGE(hidden_dim=64, out_dim=E2E_CLASSES, num_layers=2)
+    ov_tmpl = _train_lib.batch_to_dict(next(iter(ov_build(
+        lambda: glt.data.Feature(ov_feat, split_ratio=1.0)))))
+
+    def ov_epoch(trainer_cls, store_fn, **kw):
+      import jax as _jax
+      loader = ov_build(store_fn)
+      state, tx = _train_lib.create_train_state(
+          ov_model, _jax.random.PRNGKey(0), ov_tmpl)
+      tr = trainer_cls(loader, ov_model, tx, E2E_CLASSES,
+                       chunk_size=ov_k, **kw)
+      state, _, _ = tr.run_epoch(state)          # compile epoch
+      t0 = _time.perf_counter()
+      state, losses, _ = tr.run_epoch(state)     # measured epoch
+      _jax.block_until_ready(losses)
+      wall = _time.perf_counter() - t0
+      return wall, np.asarray(losses), tr
+
+    hbm_wall, hbm_losses, _ = ov_epoch(
+        glt.loader.ScanTrainer,
+        lambda: glt.data.Feature(ov_feat, split_ratio=1.0))
+    ov_dir = tempfile.mkdtemp(prefix='glt_oversub_')
+    c0 = glt_metrics.default_registry().counters()
+    t_wall, t_losses, t_tr = ov_epoch(
+        TieredScanTrainer,
+        lambda: TieredFeature(ov_feat, hot_rows=ov_hot,
+                              warm_rows=ov_warm, spill_dir=ov_dir))
+    c1 = glt_metrics.default_registry().counters()
+    staged = c1.get('storage.staged_rows', 0) - c0.get(
+        'storage.staged_rows', 0)
+    missed = c1.get('storage.prefetch_miss', 0) - c0.get(
+        'storage.prefetch_miss', 0)
+    staged_mb = (c1.get('storage.staged_bytes', 0)
+                 - c0.get('storage.staged_bytes', 0)) / 1e6
+    chunks = 2 * max(1, -(-(ov_seeds // ov_batch) // ov_k))
+    t_tr.close()
+    result['oversub_epoch_wall_s'] = round(t_wall, 3)
+    result['oversub_hbm_epoch_wall_s'] = round(hbm_wall, 3)
+    result['oversub_ratio'] = round(t_wall / hbm_wall, 3)
+    result['prefetch_hit_rate'] = round(
+        staged / (staged + missed), 4) if staged + missed else None
+    result['staged_mb_per_chunk'] = round(staged_mb / chunks, 3)
+    result['oversub_bit_identical'] = bool(
+        np.array_equal(hbm_losses, t_losses))
+    result['oversub_config'] = (
+        f'N={ov_n}, deg={ov_deg}, F={ov_f}, feat {feat_mb:.1f} MB vs '
+        f'hot+warm {budget_mb:.1f} MB ({feat_mb / budget_mb:.1f}x '
+        f'oversub), batch {ov_batch} x {ov_seeds // ov_batch} steps, '
+        f'K={ov_k}')
+  except Exception as e:
+    result['oversub_epoch_wall_s'] = None
+    result['oversub_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- serving tier (PR 7): offline materialization + online QPS ----
   # LAST measured section by design: the serving path fetches rows per

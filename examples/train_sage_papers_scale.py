@@ -42,7 +42,19 @@ def main():
   ap.add_argument('--steps', type=int, default=8)
   ap.add_argument('--batch-size', type=int, default=256)
   ap.add_argument('--fanout', type=int, nargs='+', default=[5, 5])
+  ap.add_argument('--spill-dir', default=None,
+                  help='THREE-tier mode (docs/storage.md): spill the '
+                       'cold tail to memory-mapped chunk files here and '
+                       'run the scanned epoch over a TieredFeature with '
+                       'chunk-boundary prefetch (TieredScanTrainer)')
+  ap.add_argument('--warm-gb', type=float, default=1.0,
+                  help='host-RAM budget for the warm tier (three-tier '
+                       'mode only)')
+  ap.add_argument('--chunk-size', type=int, default=8,
+                  help='scan chunk K (three-tier mode only)')
   args = ap.parse_args()
+  if args.spill_dir is not None:
+    return main_tiered(args)
 
   import jax
   glt.utils.enable_compilation_cache()
@@ -131,6 +143,84 @@ def main():
       'secs_per_step_wall': round(dt / max(len(losses), 1), 3),
       'timing': 'wall (tunnel-bound on this rig; see PERF.md)',
   }), flush=True)
+
+
+def main_tiered(args):
+  """Three-tier mode: features span HBM -> host RAM -> disk, and the
+  epoch runs as a TieredScanTrainer scanned program — the prologue
+  plans the epoch's exact disk miss set and the staging worker feeds
+  each chunk ahead of the device (docs/storage.md)."""
+  import jax
+
+  from graphlearn_tpu.storage import TieredFeature, TieredScanTrainer
+  glt.utils.enable_compilation_cache()
+  rng = np.random.default_rng(0)
+  n, f = args.num_nodes, args.feat_dim
+  ncls = 16
+  t0 = time.time()
+  e = n * args.avg_deg
+  rows = rng.integers(0, n, e).astype(np.int32)
+  cols = (rng.zipf(1.3, e) % n).astype(np.int32)
+  feat = rng.standard_normal((n, f)).astype(np.float32)
+  feat_gb = feat.nbytes / (1 << 30)
+  row_gb = f * 4 / (1 << 30)
+  hot = min(n, int(args.hot_gb / row_gb))
+  warm = min(n - hot, int(args.warm_gb / row_gb))
+  assert hot + warm < n, ('pick --num-nodes/--hot-gb/--warm-gb so the '
+                          'disk tier is non-empty')
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), num_nodes=n, graph_mode='HBM')
+  order = np.argsort(rows, kind='stable')
+  uniq, first_pos = np.unique(rows[order], return_index=True)
+  first_nbr = np.arange(n)
+  first_nbr[uniq] = cols[order[first_pos]]
+  label = (first_nbr % ncls).astype(np.int64)
+  topo = glt.data.Topology(np.stack([rows, cols]), layout='CSR',
+                           num_nodes=n)
+  reordered, id2idx = glt.data.sort_by_in_degree(feat, hot / n, topo)
+  del feat
+  ds.node_features = TieredFeature(reordered, hot_rows=hot,
+                                   warm_rows=warm, id2index=id2idx,
+                                   spill_dir=args.spill_dir)
+  del reordered
+  ds.init_node_labels(label)
+  occ = ds.node_features.tier_occupancy()
+  print(f'# features {feat_gb:.1f} GB -> tiers hot={occ["hot"]} '
+        f'warm={occ["warm"]} disk={occ["disk"]} rows; built in '
+        f'{time.time()-t0:.1f}s', flush=True)
+
+  loader = glt.loader.NeighborLoader(
+      ds, args.fanout, rng.integers(0, n, n // 100),
+      batch_size=args.batch_size, shuffle=True, drop_last=True, seed=0,
+      dedup='tree')
+  model = GraphSAGE(hidden_dim=64, out_dim=ncls,
+                    num_layers=len(args.fanout))
+  # template batch for model init: one reactive tiered batch (a second
+  # all-RAM store just for shapes would defeat the point at this scale)
+  first = train_lib.batch_to_dict(next(iter(loader)))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  trainer = TieredScanTrainer(loader, model, tx, ncls,
+                              chunk_size=args.chunk_size)
+  t0 = time.perf_counter()
+  state, losses, _ = trainer.run_epoch(state, max_steps=args.steps)
+  jax.block_until_ready(losses)
+  dt = time.perf_counter() - t0
+  from graphlearn_tpu import metrics
+  c = metrics.default_registry().counters()
+  staged = c.get('storage.staged_rows', 0)
+  missed = c.get('storage.prefetch_miss', 0)
+  print(json.dumps({
+      'num_nodes': n, 'feat_gb': round(feat_gb, 2),
+      'tiers': occ, 'steps': int(np.asarray(losses).shape[0]),
+      'final_loss': round(float(np.asarray(losses)[-1]), 4),
+      'epoch_wall_s': round(dt, 3),
+      'staged_rows': int(staged), 'prefetch_miss': int(missed),
+      'prefetch_hit_rate': round(staged / max(staged + missed, 1), 4),
+      'plan': trainer.last_plan.stats(),
+      'timing': 'wall (tunnel-bound on this rig; see PERF.md)',
+  }), flush=True)
+  trainer.close()
 
 
 if __name__ == '__main__':

@@ -162,8 +162,7 @@ class Feature:
     goes through ``__getitem__``'s mixed path instead.
     """
     self.lazy_init()
-    if self._unified.host_part is not None or \
-        self._unified.device_part is None:
+    if self._unified.host_rows or self._unified.device_part is None:
       return None
     return self._unified.device_part, self._id2index_dev
 

@@ -52,6 +52,7 @@ class UnifiedTensor:
     self._device_part = None   # jax.Array [H, F] in HBM
     self._host_part = None     # np.ndarray [N-H, F] in host RAM
     self._device_rows = 0
+    self._host_rows_n = 0      # virtual host-row count (tiers may stack)
     self._pool = None          # lazy host-gather worker
     self._hot_fn = None        # jitted hot gather (dispatched pre-block)
     self._scatter_fn = None    # jitted cold-row scatter
@@ -77,6 +78,7 @@ class UnifiedTensor:
       if self.dtype is not None:
         arr = arr.astype(self.dtype)
       self._host_part = arr
+      self._host_rows_n = int(arr.shape[0])
     return self
 
   @property
@@ -88,9 +90,23 @@ class UnifiedTensor:
     return self._host_part
 
   @property
+  def host_rows(self) -> int:
+    """Rows resolved on the host side (everything past the device
+    prefix). Subclasses stacking deeper tiers (storage.TieredFeature's
+    warm-RAM + disk tensor) report their combined span here."""
+    return self._host_rows_n
+
+  def _host_resolve(self, rel_ids: np.ndarray) -> np.ndarray:
+    """Host rows for host-relative indices [0, host_rows) — THE staging
+    hook: the base class reads its resident host block; the tiered
+    tensor (storage/tiered.py) overrides this to resolve warm-RAM rows,
+    the staging ring, and memory-mapped disk chunks."""
+    return np.take(self._host_part, rel_ids, axis=0)
+
+  @property
   def shape(self):
     h = self._device_rows
-    n = h + (self._host_part.shape[0] if self._host_part is not None else 0)
+    n = h + self._host_rows_n
     f = (self._device_part.shape[1] if self._device_part is not None
          else self._host_part.shape[1])
     return (n, f)
@@ -124,14 +140,14 @@ class UnifiedTensor:
     """
     import jax
     import jax.numpy as jnp
-    if self._host_part is None:
+    if self._host_rows_n == 0:
       if self._pallas_ok():
         from ..ops import gather_rows_hbm
         return gather_rows_hbm(self._device_part, jnp.asarray(ids))
       return jnp.take(self._device_part, jnp.asarray(ids), axis=0)
     ids_np = np.asarray(ids)
     if self._device_part is None:
-      host = np.take(self._host_part, ids_np - self._device_rows, axis=0)
+      host = self._host_resolve(ids_np - self._device_rows)
       return jax.device_put(host, self._small_block_target())
     # Mixed: ship only the cold rows.
     b = ids_np.shape[0]
@@ -144,8 +160,7 @@ class UnifiedTensor:
       self._pool = ThreadPoolExecutor(max_workers=1)
 
     def host_gather():
-      rows = np.take(self._host_part,
-                     ids_np[cold_pos] - self._device_rows, axis=0)
+      rows = self._host_resolve(ids_np[cold_pos] - self._device_rows)
       if n_cold < cold_cap:
         pad = np.zeros((cold_cap - n_cold,) + rows.shape[1:], rows.dtype)
         rows = np.concatenate([rows, pad]) if n_cold else pad

@@ -39,7 +39,7 @@ class DistDataset:
            edge_dir: str = 'out', feature_dtype=None,
            feature_with_cache: bool = True, split_ratio: float = 0.0,
            cache_rows=None, hotness='in_degree', wire_dtype=None,
-           bucket_frac=2.0):
+           bucket_frac=2.0, feature_spill_dir=None):
     """Load all partitions of `root_dir` and shard them over `mesh`
     (reference: DistDataset.load, dist_dataset.py:78-167). Handles both
     the homogeneous and the heterogeneous (per-type) partition layouts of
@@ -53,7 +53,11 @@ class DistDataset:
     partitions; pass explicit [N] scores (per type for hetero) for
     presampling-frequency hotness, or None to cache the lowest ids.
     ``wire_dtype``/``bucket_frac`` tune the miss exchange (see
-    DistFeature)."""
+    DistFeature). ``feature_spill_dir`` builds the NODE feature stores
+    as ``storage.TieredDistFeature`` instead: partition row payloads
+    spill to memory-mapped disk tiers under that directory and host
+    RAM keeps only the routing structures + hot cache — the
+    out-of-core shard layout (docs/storage.md)."""
     num_parts, g0, nf0, ef0, node_pb, edge_pb = load_partition(root_dir, 0)
     if mesh is None:
       from .dist_context import get_context
@@ -97,6 +101,18 @@ class DistDataset:
     feat_kw = dict(mesh=mesh, dtype=feature_dtype, wire_dtype=wire_dtype,
                    bucket_frac=bucket_frac)
     cache_kw = dict(split_ratio=split_ratio, cache_rows=cache_rows)
+
+    def _node_store_cls(subdir):
+      """(class, extra kwargs) for a node feature store: RAM-resident
+      DistFeature, or the disk-backed tiered variant when a spill dir
+      is configured."""
+      if feature_spill_dir is None:
+        return DistFeature, {}
+      import os
+
+      from ..storage.dist import TieredDistFeature
+      return TieredDistFeature, {
+          'spill_dir': os.path.join(feature_spill_dir, subdir)}
     if isinstance(g0, dict):
       from .dist_graph import DistHeteroGraph
       self.graph = DistHeteroGraph(num_parts, 0, parts, node_pb,
@@ -115,10 +131,11 @@ class DistDataset:
               feats, ids = nft.feats, nft.ids
             blocks.append((ids, feats))
           self.node_feat_pb[nt] = feat_pb
-          self.node_features[nt] = DistFeature(
+          cls, extra = _node_store_cls(f'node_{nt}')
+          self.node_features[nt] = cls(
               num_parts, blocks, node_pb[nt],
               hotness=_hotness(node_pb[nt].shape[0], nt), **cache_kw,
-              **feat_kw)
+              **feat_kw, **extra)
       if ef0:
         self.edge_features = {}
         for et in ef0:
@@ -139,9 +156,11 @@ class DistDataset:
             feats, ids = nf.feats, nf.ids
           blocks.append((ids, feats))
         self.node_feat_pb = feat_pb
-        self.node_features = DistFeature(
+        cls, extra = _node_store_cls('node')
+        self.node_features = cls(
             num_parts, blocks, node_pb,
-            hotness=_hotness(node_pb.shape[0]), **cache_kw, **feat_kw)
+            hotness=_hotness(node_pb.shape[0]), **cache_kw, **feat_kw,
+            **extra)
         # note: lookups route by the *graph* node_pb (each id's canonical
         # owner); the cache raises the chance the row is also local, but
         # canonical routing keeps responses unique. The feature pb with

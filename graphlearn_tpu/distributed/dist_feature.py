@@ -109,16 +109,7 @@ class DistFeature:
     self.num_partitions = num_partitions
     self.feature_pb = np.asarray(feature_pb)
     self.mesh = mesh
-    n_max = max(ids.shape[0] for ids, _ in feat_parts)
-    f = feat_parts[0][1].shape[1]
-    p = len(feat_parts)
-    dt = dtype or feat_parts[0][1].dtype
-    self.feat_ids = np.full((p, n_max), INT32_MAX, np.int32)
-    self.feats = np.zeros((p, n_max, f), dt)
-    for i, (ids, fe) in enumerate(feat_parts):
-      order = np.argsort(ids)
-      self.feat_ids[i, :ids.shape[0]] = ids[order]
-      self.feats[i, :ids.shape[0]] = fe[order]
+    self._init_storage(feat_parts, dtype)
     self.split_ratio = float(split_ratio)
     self.wire_dtype = wire_dtype
     self.bucket_frac = bucket_frac
@@ -150,9 +141,30 @@ class DistFeature:
     self._stats = None
     self._fns = {}
 
+  def _init_storage(self, feat_parts, dtype):
+    """Pack the per-partition (ids, rows) blocks into the sorted
+    [P, n_max] id table + the [P, n_max, F] row store. The row store
+    is HOST-RAM-resident here; storage.TieredDistFeature overrides
+    this to keep rows in memory-mapped disk tiers (the out-of-core
+    shard layout, docs/storage.md) while the id table — the small
+    routing structure — stays resident."""
+    n_max = max(ids.shape[0] for ids, _ in feat_parts)
+    f = feat_parts[0][1].shape[1]
+    p = len(feat_parts)
+    dt = np.dtype(dtype or feat_parts[0][1].dtype)
+    self.n_max = n_max
+    self._fdim = int(f)
+    self.storage_dtype = dt
+    self.feat_ids = np.full((p, n_max), INT32_MAX, np.int32)
+    self.feats = np.zeros((p, n_max, f), dt)
+    for i, (ids, fe) in enumerate(feat_parts):
+      order = np.argsort(ids)
+      self.feat_ids[i, :ids.shape[0]] = ids[order]
+      self.feats[i, :ids.shape[0]] = fe[order]
+
   @property
   def feature_dim(self) -> int:
-    return self.feats.shape[-1]
+    return self._fdim
 
   def device_arrays(self):
     if self._dev is None:
@@ -164,7 +176,7 @@ class DistFeature:
       cache_ids = (self.cache_ids if h else
                    np.full((1,), INT32_MAX, np.int32))
       cache_feats = (self.cache_feats if h else
-                     np.zeros((1, self.feature_dim), self.feats.dtype))
+                     np.zeros((1, self.feature_dim), self.storage_dtype))
       self._dev = dict(
           feat_ids=global_device_put(self.feat_ids, shard),
           feats=global_device_put(self.feats, shard),
@@ -240,7 +252,7 @@ class DistFeature:
 
     nparts = self.num_partitions
     fdim = self.feature_dim
-    fdtype = self.feats.dtype
+    fdtype = self.storage_dtype
     wdtype = self.wire_dtype or fdtype
     h = self.cache_rows
     dedup = self.dedup
@@ -431,7 +443,7 @@ class DistFeature:
   def cpu_get(self, ids) -> np.ndarray:
     """Host-side exact gather (server-side remote serving path)."""
     ids = np.asarray(ids)
-    out = np.zeros((ids.shape[0], self.feature_dim), self.feats.dtype)
+    out = np.zeros((ids.shape[0], self.feature_dim), self.storage_dtype)
     for p in range(self.num_partitions):
       m = self.feature_pb[np.clip(ids, 0, None)] == p
       if not m.any():

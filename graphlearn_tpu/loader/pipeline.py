@@ -85,19 +85,33 @@ class FusedEpochTrainer:
       seed_labels_only = loader.seed_labels_only
     self._label_cap = self._batch_size if seed_labels_only else None
 
-    dt = loader.data.node_features.device_table() \
-        if loader.data.node_features is not None else None
-    if dt is None:
-      raise ValueError(f'{self._NAME} needs a device-resident '
-                       'feature table (Feature on HBM)')
-    self._feats, self._id2i = dt
+    self._feats, self._id2i = self._resolve_feature_tables(loader)
     self._labels = loader._label_table()
     if self._labels is None:
       raise ValueError(f'{self._NAME} needs node labels')
 
     from ..models import train as train_lib
     self._train_step, _ = train_lib.make_train_step(model, tx, num_classes)
+    self._sample_collate = self._make_sample_collate_body()
 
+  def _resolve_feature_tables(self, loader):
+    """(feats, id2index) device tables the traced programs gather from.
+    The base contract is an ALL-HBM table; the out-of-core trainer
+    (storage/scan.py TieredScanTrainer) overrides this to accept a
+    TieredFeature's hot prefix + per-chunk staged slabs."""
+    dt = loader.data.node_features.device_table() \
+        if loader.data.node_features is not None else None
+    if dt is None:
+      raise ValueError(f'{self._NAME} needs a device-resident '
+                       'feature table (Feature on HBM), or the tiered '
+                       'trainer (storage.TieredScanTrainer) for an '
+                       'out-of-core TieredFeature')
+    return dt
+
+  def _make_sample_collate_body(self):
+    """The pure traced sample+collate body. ``feats`` is whatever
+    pytree :meth:`_resolve_feature_tables` produced — here a plain
+    [N, F] table fed straight to the fused collate gather."""
     sample_fn, label_cap = self._sample_fn, self._label_cap
 
     def _sample_collate(fargs, feats, id2i, labels, seeds, smask, key):
@@ -112,7 +126,7 @@ class FusedEpochTrainer:
       # (train_step must not see it; the batch buffers are donated)
       return batch, res['overflow']
 
-    self._sample_collate = _sample_collate
+    return _sample_collate
 
 
 class OverlappedTrainer(FusedEpochTrainer):

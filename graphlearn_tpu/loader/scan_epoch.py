@@ -86,6 +86,16 @@ class ScanTrainer(FusedEpochTrainer):
 
   _NAME = 'ScanTrainer'
 
+  # chunk-boundary staging hooks (storage/ subsystem, docs/storage.md):
+  # ``stage_hook(chunk_index, start, k)`` runs on the dispatch thread
+  # BEFORE each chunk dispatch, ``ack_hook(chunk_index, start, k)``
+  # right after it — the seam the out-of-core pipeline (and tests)
+  # attach to without subclassing the epoch loop. Host-side only; a
+  # hook must not fetch device arrays (the loop runs under
+  # strict_guards).
+  stage_hook = None
+  ack_hook = None
+
   def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
                chunk_size: int = 32,
                seed_labels_only: Optional[bool] = None,
@@ -310,6 +320,8 @@ class ScanTrainer(FusedEpochTrainer):
                                          full_steps)
       while start < steps:
         k = min(self.chunk_size, steps - start)
+        if self.stage_hook is not None:
+          self.stage_hook(start // self.chunk_size, start, k)
         record_dispatch('scan_chunk')
         # chunk-level span: host clocks only (the dispatch is async, so
         # dur is dispatch wall, not device compute — PERF.md's point)
@@ -318,6 +330,8 @@ class ScanTrainer(FusedEpochTrainer):
               state, ovf, fargs, self._feats, self._id2i, self._labels,
               seed_mat, mask_mat, base_key, count0,
               jax.device_put(np.int32(start)), k)
+        if self.ack_hook is not None:
+          self.ack_hook(start // self.chunk_size, start, k)
         losses.append(loss_k)
         accs.append(acc_k)
         start += k
@@ -389,6 +403,13 @@ class DistScanTrainer(DistFusedEpochTrainer):
   """
 
   _NAME = 'DistScanTrainer'
+
+  # chunk-boundary staging hooks — same contract as ScanTrainer's:
+  # host-side callables around each chunk dispatch, the attachment
+  # point for per-shard staging pipelines (docs/storage.md documents
+  # the distributed tier model and its current scope)
+  stage_hook = None
+  ack_hook = None
 
   def __init__(self, loader, model, tx, num_classes: int,
                chunk_size: int = 32,
@@ -702,6 +723,8 @@ class DistScanTrainer(DistFusedEpochTrainer):
                                            full_steps)
         while start < steps:
           k = min(self.chunk_size, steps - start)
+          if self.stage_hook is not None:
+            self.stage_hook(start // self.chunk_size, start, k)
           record_dispatch('dist_scan_chunk')
           with spans.span('epoch.chunk', start=start, k=k):
             params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
@@ -709,6 +732,8 @@ class DistScanTrainer(DistFusedEpochTrainer):
                     self._shard_tree, self._repl_tree, stats, params,
                     opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
                     count0, jax.device_put(np.int32(start), repl))
+          if self.ack_hook is not None:
+            self.ack_hook(start // self.chunk_size, start, k)
           stats_back(stats)
           losses.append(loss_k)
           accs.append(acc_k)

@@ -210,7 +210,12 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
   feature = split('dist_feature', 'dist_label')
   resilience = split('resilience')
   fault = split('fault')
-  known = set(feature) | set(resilience) | set(fault)
+  # per-epoch staging deltas (the out-of-core tiers, storage/): rows
+  # and bytes the chunk-boundary pipeline staged this epoch, plus the
+  # synchronous fallback reads (prefetch_miss) — a degrading prefetch
+  # hit rate is visible epoch by epoch
+  storage = split('storage')
+  known = set(feature) | set(resilience) | set(fault) | set(storage)
   record = {
       'schema': SCHEMA,
       'kind': 'epoch',
@@ -228,6 +233,7 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
       'feature': feature,
       'resilience': resilience,
       'fault': fault,
+      'storage': storage,
       'programs': prog,
       'counters': {k: v for k, v in cdelta.items() if k not in known},
       'config': _jsonable(config or {}),

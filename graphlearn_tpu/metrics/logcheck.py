@@ -48,6 +48,12 @@ _FLIGHT_NULLABLE = {
     'dispatch': (dict,),
     'dispatch_total': (int,),
 }
+# fields later schema-1 writers added without a version bump: current
+# records always carry them, but logs captured by earlier builds must
+# still validate (present -> type-checked, absent -> fine)
+_FLIGHT_OPTIONAL = {
+    'storage': (dict,),
+}
 
 _SPAN_REQUIRED = {
     'schema': (int,),
@@ -98,8 +104,8 @@ def validate_flight_record(rec: dict, label: str = 'flight') -> List[str]:
   """Problems with one epoch flight record (empty list = valid)."""
   if rec.get('kind') != 'epoch':
     return [f'{label}: kind {rec.get("kind")!r} != "epoch"']
-  return _check_fields(rec, _FLIGHT_REQUIRED, _FLIGHT_NULLABLE, {},
-                       label)
+  return _check_fields(rec, _FLIGHT_REQUIRED, _FLIGHT_NULLABLE,
+                       _FLIGHT_OPTIONAL, label)
 
 
 def validate_span(rec: dict, label: str = 'span') -> List[str]:

@@ -56,6 +56,18 @@ REGISTERED_METRICS = frozenset({
     'program.retraces',
     'program.compile_ms',
     'program.retrace_budget_exceeded',
+    # out-of-core tiered feature storage (graphlearn_tpu/storage/):
+    # the chunk-boundary staging pipeline's counters/latencies plus
+    # tier-occupancy gauges (docs/storage.md)
+    'storage.staged_rows',
+    'storage.staged_bytes',
+    'storage.prefetch_miss',
+    'storage.stage_ms',
+    'storage.promote_ms',
+    'storage.ring_rows',
+    'storage.hot_rows',
+    'storage.warm_rows',
+    'storage.disk_rows',
 })
 
 # The closed inventory of SPAN names (metrics/spans.py) — the same
@@ -84,4 +96,7 @@ REGISTERED_SPANS = frozenset({
     'serving.batch',
     'serving.compute',
     'serving.respond',
+    # out-of-core staging pipeline (storage/staging.py): one span per
+    # staged chunk on the worker thread
+    'storage.stage',
 })

@@ -113,7 +113,8 @@ class EmbeddingMaterializer:
   _NAME = 'EmbeddingMaterializer'
 
   def __init__(self, dataset, model, params, *, block_size: int = 128,
-               chunk_size: int = 8, neighbor_cap: Optional[int] = None):
+               chunk_size: int = 8, neighbor_cap: Optional[int] = None,
+               spill_dir: Optional[str] = None):
     if block_size < 1 or chunk_size < 1:
       raise ValueError('block_size and chunk_size must be >= 1')
     self.model = model
@@ -121,6 +122,13 @@ class EmbeddingMaterializer:
     self.block_size = int(block_size)
     self.chunk_size = int(chunk_size)
     self.neighbor_cap = neighbor_cap
+    # spill-to-tier (storage/, docs/storage.md): every completed layer
+    # pass also lands on disk as a memory-mapped tier, so O(N·F)
+    # stores beyond HBM still materialize (the superseded device store
+    # is donated away as before; the disk copy is the durable one) and
+    # the finished table can serve through a TieredEmbeddingStore
+    self.spill_dir = spill_dir
+    self.spilled = {}     # pass label -> storage.DiskTier
     self.is_hetero = bool(dataset.is_hetero)
     self.num_layers = int(model.num_layers)
     self._chunk_fns: Dict[Any, Any] = {}
@@ -328,7 +336,18 @@ class EmbeddingMaterializer:
           self, tok, emitter=self._NAME, steps=nblocks,
           completed=completed, config=self._flight_config(),
           extra={'pass': str(layer_label), 'chunks': chunks})
+    if self.spill_dir is not None:
+      self._spill_pass(str(layer_label), out)
     return out
+
+  def _spill_pass(self, label: str, out):
+    """Write a completed pass's output store to its disk tier (outside
+    the strict region — the fetch is the spill's whole point)."""
+    import os
+    from ..storage.disk import spill_array
+    safe = label.replace('/', '_').replace(' ', '_')
+    self.spilled[label] = spill_array(
+        os.path.join(self.spill_dir, f'pass_{safe}'), np.asarray(out))
 
   def _flight_config(self) -> dict:
     return dict(emitter=self._NAME, block_size=self.block_size,
@@ -585,6 +604,29 @@ class EmbeddingMaterializer:
     if self._embeddings is None:
       raise RuntimeError('call materialize() first')
     return EmbeddingStore(self._embeddings, num_nodes=self.num_nodes)
+
+  def tiered_embedding_store(self, hot_rows: int = 0, warm_rows: int = 0,
+                             **kwargs):
+    """The spilled final-layer table as a beyond-HBM
+    ``TieredEmbeddingStore``: hot_rows stay in HBM, warm_rows in host
+    RAM, the rest serves from the memory-mapped spill (homo only;
+    requires ``spill_dir``). The real node count rides along so block
+    padding stays behind the engine's id validation."""
+    from ..storage.tiered import TieredFeature
+    from .store import TieredEmbeddingStore
+    if self.is_hetero:
+      raise ValueError('hetero materialization produces per-type '
+                       'stores — build TieredEmbeddingStore over the '
+                       'spilled pass tier you serve explicitly')
+    if self.spill_dir is None:
+      raise ValueError('tiered_embedding_store needs '
+                       'EmbeddingMaterializer(..., spill_dir=...)')
+    if self._embeddings is None:
+      raise RuntimeError('call materialize() first')
+    tier = self.spilled[str(self.num_layers - 1)]
+    tf = TieredFeature(tier, hot_rows=hot_rows, warm_rows=warm_rows,
+                       **kwargs)
+    return TieredEmbeddingStore(tf, num_nodes=self.num_nodes)
 
   def dist_embedding_store(self, mesh, **kwargs):
     """The materialized table as a sharded ``DistEmbeddingStore`` over

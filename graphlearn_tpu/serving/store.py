@@ -128,6 +128,60 @@ class EmbeddingStore:
                               jnp.asarray(vals))
 
 
+class TieredEmbeddingStore:
+  """Beyond-HBM embedding store: the materialized table lives in a
+  ``storage.TieredFeature`` (HBM hot prefix -> host RAM -> disk), so an
+  O(N·F) embedding table larger than device memory still serves — hot
+  rows at HBM gather speed, cold rows through the tiered mixed gather
+  (pow2 cold blocks, promoted-row warming). The natural pairing is
+  ``EmbeddingMaterializer(..., spill_dir=...)`` +
+  ``materializer.tiered_embedding_store(...)``.
+
+  Immutable, like DistEmbeddingStore: stale rows are refreshed by
+  rematerializing and rotating the spill (docs/serving.md), not by
+  in-place scatter — the hot tier is device-resident while warm/disk
+  rows are host-resident, and a write-through across tiers would race
+  the staging pipeline.
+  """
+
+  granularity = 1
+
+  def __init__(self, tiered_feature, num_nodes: Optional[int] = None):
+    self.tf = tiered_feature
+    self.num_nodes = int(num_nodes if num_nodes is not None
+                         else tiered_feature.size)
+    self._mask_fn = None
+
+  @property
+  def feature_dim(self) -> int:
+    return int(self.tf.shape[1])
+
+  def lookup(self, ids, mask):
+    """[cap] padded host ids (-1 pads) -> [cap, F] device rows. The
+    tiered gather ships only the non-hot rows (UnifiedTensor mixed
+    path); one extra jitted where() zeroes the pad slots like
+    EmbeddingStore.lookup."""
+    import jax
+    import jax.numpy as jnp
+    rows = self.tf[np.asarray(ids)]
+    if self._mask_fn is None:
+      from ..metrics import programs
+      self._mask_fn = programs.instrument(
+          jax.jit(lambda r, m: jnp.where(m[:, None], r, 0)),
+          'serve_lookup')
+    record_dispatch('serve_lookup')
+    return self._mask_fn(rows, jnp.asarray(mask))
+
+  def fetch(self, rows) -> np.ndarray:
+    return np.asarray(rows)
+
+  def update_rows(self, ids, rows):
+    raise NotImplementedError(
+        'TieredEmbeddingStore rows are immutable — rematerialize with '
+        'EmbeddingMaterializer(..., spill_dir=...) and rotate the '
+        'spill (docs/storage.md, docs/serving.md)')
+
+
 class DistEmbeddingStore:
   """Sharded embedding store over a mesh: a ``DistFeature`` whose rows
   are the materialized embeddings — the hot-embedding cache IS the

@@ -1,0 +1,32 @@
+"""graphlearn_tpu.storage: out-of-core tiered feature storage.
+
+The subsystem that turns "fits in HBM" into "fits on disk" (ROADMAP
+item 2; docs/storage.md): a three-tier feature store — HBM hot prefix,
+host-RAM warm tier, memory-mapped disk cold tier — plus the epoch
+prefetch planner and the chunk-boundary staging pipeline that fuse
+disk reads to the scanned epoch's dispatch cadence.
+
+* ``DiskTier`` / ``spill_array`` — the chunked mmap bottom tier.
+* ``TieredFeature`` — drop-in for ``data.Feature`` across all three
+  tiers (reactive per-batch path + ``cpu_get`` serving).
+* ``ChunkStager`` — the bounded staging worker (double-buffered disk ->
+  host ring, degrade-to-sync failure semantics).
+* ``planner`` — exact per-chunk / per-tier miss sets, computable at the
+  epoch prologue from the replayable seed + fold_in PRNG streams.
+* ``TieredScanTrainer`` — the scanned epoch over a TieredFeature at the
+  unchanged ceil(steps/K)+2 dispatch budget.
+* ``TieredDistFeature`` — per-shard disk-backed rows behind the PR 3
+  hot-cache / miss-exchange machinery.
+"""
+from . import planner
+from .disk import DiskTier, spill_array
+from .dist import TieredDistFeature, spill_partitions
+from .scan import TieredScanTrainer, tiered_gather
+from .staging import ChunkStager, pad_slab, pow2_slab_cap
+from .tiered import TieredFeature
+
+__all__ = [
+    'DiskTier', 'spill_array', 'TieredDistFeature', 'spill_partitions',
+    'TieredScanTrainer', 'tiered_gather', 'ChunkStager', 'pad_slab',
+    'pow2_slab_cap', 'TieredFeature', 'planner',
+]

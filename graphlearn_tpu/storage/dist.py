@@ -1,0 +1,192 @@
+"""TieredDistFeature: per-shard out-of-core storage behind the PR 3
+hot-cache / miss-exchange machinery.
+
+``DistFeature`` keeps every partition's rows resident in host RAM (the
+[P, n_max, F] block it uploads and serves ``cpu_get`` from) — at
+products scale that is already ~GBs per host, and at the 100M–1B-node
+production scale ROADMAP item 2 names it is impossible. This subclass
+keeps only the ROUTING structures resident (the sorted [P, n_max] id
+table, the partition book, the replicated hot cache) and moves the row
+payload to per-partition memory-mapped disk tiers (storage/disk.py):
+
+* ``cpu_get`` (the server-side remote serving path, cache
+  construction) gathers through the mmaps — the OS page cache is the
+  warm tier;
+* ``device_arrays`` uploads the HBM shard table straight from disk via
+  ``jax.make_array_from_callback``: each addressable shard's block is
+  read transiently, so peak host RAM during upload is ONE shard's
+  block, never the whole table;
+* everything else — the one-dispatch cached miss-only exchange, the
+  [P, 4] on-device stats, ``publish_stats`` — is inherited unchanged:
+  the device-side lookup semantics are bit-identical to DistFeature
+  built from the same rows (tests/test_storage.py pins it).
+
+Scope note (docs/storage.md): the HBM tier still holds each shard's
+full partition — the exchange program must answer arbitrary remote
+requests in-program. The three-tier *device* oversubscription (hot
+prefix + staged slabs) is the local scanned path
+(storage.TieredScanTrainer); extending it through the shard exchange
+rides the DistScanTrainer chunk hooks and is tracked in ROADMAP.
+"""
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..distributed.dist_feature import INT32_MAX, DistFeature
+from .disk import DiskTier, spill_array
+
+
+class TieredDistFeature(DistFeature):
+  """DistFeature whose per-partition row payloads live on disk.
+
+  Args (beyond DistFeature's): ``spill_dir`` — where the per-partition
+  tiers are written when ``feat_parts`` carries in-RAM arrays. A part's
+  rows may also be given as a ``DiskTier`` directly (ids then must
+  already be in sorted-id order, the layout :func:`spill_partitions`
+  writes).
+  """
+
+  def __init__(self, num_partitions: int, feat_parts, feature_pb,
+               mesh=None, dtype=None, spill_dir: Optional[str] = None,
+               rows_per_chunk: int = 65536, fmt: str = 'npy', **kwargs):
+    self._spill_dir = spill_dir
+    self._rows_per_chunk = int(rows_per_chunk)
+    self._fmt = fmt
+    super().__init__(num_partitions, feat_parts, feature_pb, mesh=mesh,
+                     dtype=dtype, **kwargs)
+
+  # ------------------------------------------------------------- storage
+
+  def _init_storage(self, feat_parts, dtype):
+    first_rows = feat_parts[0][1]
+    f = (first_rows.dim if isinstance(first_rows, DiskTier)
+         else np.asarray(first_rows).shape[1])
+    dt = np.dtype(dtype) if dtype is not None else (
+        first_rows.dtype if isinstance(first_rows, DiskTier)
+        else np.asarray(first_rows).dtype)
+    p = len(feat_parts)
+    n_max = max((ids.shape[0] for ids, _ in feat_parts), default=1)
+    self.n_max = int(n_max)
+    self._fdim = int(f)
+    self.storage_dtype = dt
+    self.feat_ids = np.full((p, n_max), INT32_MAX, np.int32)
+    self._tiers = []
+    for i, (ids, rows) in enumerate(feat_parts):
+      ids = np.asarray(ids)
+      if isinstance(rows, DiskTier):
+        if rows.rows != ids.shape[0]:
+          raise ValueError(f'partition {i}: tier holds {rows.rows} '
+                           f'rows for {ids.shape[0]} ids')
+        if ids.size > 1 and np.any(np.diff(ids) < 0):
+          raise ValueError(f'partition {i}: a DiskTier part must carry '
+                           'rows in sorted-id order (spill_partitions '
+                           'writes that layout)')
+        self.feat_ids[i, :ids.shape[0]] = ids
+        self._tiers.append(rows)
+      else:
+        if self._spill_dir is None:
+          raise ValueError('TieredDistFeature needs spill_dir=... when '
+                           'feat_parts carry in-RAM arrays (rows are '
+                           'written as memory-mapped chunk files)')
+        order = np.argsort(ids)
+        rows = np.asarray(rows)
+        if dtype is not None:
+          rows = rows.astype(dt)
+        self.feat_ids[i, :ids.shape[0]] = ids[order]
+        self._tiers.append(spill_array(
+            os.path.join(self._spill_dir, f'part_{i:03d}'), rows[order],
+            rows_per_chunk=self._rows_per_chunk, fmt=self._fmt))
+
+  def _part_rows(self, p: int) -> int:
+    return self._tiers[p].rows
+
+  # -------------------------------------------------------------- access
+
+  def cpu_get(self, ids) -> np.ndarray:
+    """Host-side exact gather via the per-partition mmaps — semantics
+    identical to DistFeature.cpu_get over the same rows."""
+    ids = np.asarray(ids)
+    out = np.zeros((ids.shape[0], self.feature_dim), self.storage_dtype)
+    for p in range(self.num_partitions):
+      m = self.feature_pb[np.clip(ids, 0, None)] == p
+      if not m.any():
+        continue
+      pos = np.searchsorted(self.feat_ids[p], ids[m])
+      pos = np.clip(pos, 0, self.feat_ids.shape[1] - 1)
+      n_p = self._part_rows(p)
+      real = pos < n_p        # pad slots read as zero rows, like the
+      vals = np.zeros((int(m.sum()), self.feature_dim),  # base's zero
+                      self.storage_dtype)                # padding
+      if real.any():
+        vals[real] = self._tiers[p].gather(pos[real])
+      out[m] = vals
+    return out
+
+  def device_arrays(self):
+    """Upload the shard table straight from the disk tiers: each
+    addressable shard's [n_max, F] block is assembled transiently in
+    the make_array_from_callback callback — whole-table host RAM is
+    never allocated."""
+    if self._dev is None:
+      import jax
+      from jax.sharding import NamedSharding, PartitionSpec as P
+
+      from ..utils import global_device_put
+      shard = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
+      repl = NamedSharding(self.mesh, P())
+      h = self.cache_rows
+      cache_ids = (self.cache_ids if h else
+                   np.full((1,), INT32_MAX, np.int32))
+      cache_feats = (self.cache_feats if h else
+                     np.zeros((1, self.feature_dim), self.storage_dtype))
+      shape = (self.num_partitions, self.n_max, self.feature_dim)
+
+      def part_block(p: int) -> np.ndarray:
+        block = np.zeros((self.n_max, self.feature_dim),
+                         self.storage_dtype)
+        n_p = self._part_rows(p)
+        if n_p:
+          block[:n_p] = self._tiers[p].gather(np.arange(n_p))
+        return block
+
+      def cb(index):
+        ps = range(*index[0].indices(self.num_partitions))
+        block = np.stack([part_block(p) for p in ps]) if ps else \
+            np.zeros((0,) + shape[1:], self.storage_dtype)
+        return block[(slice(None),) + tuple(index[1:])]
+
+      self._dev = dict(
+          feat_ids=global_device_put(self.feat_ids, shard),
+          feats=jax.make_array_from_callback(shape, shard, cb),
+          feature_pb=global_device_put(self.feature_pb.astype(np.int32),
+                                       repl),
+          cache_ids=global_device_put(cache_ids, repl),
+          cache_feats=global_device_put(cache_feats, repl))
+    return self._dev
+
+  def tier_bytes(self) -> dict:
+    """Resident vs on-disk byte accounting (sizing guidance,
+    docs/storage.md)."""
+    disk = sum(t.nbytes for t in self._tiers)
+    resident = self.feat_ids.nbytes + self.feature_pb.nbytes
+    if self.cache_feats is not None:
+      resident += self.cache_feats.nbytes + self.cache_ids.nbytes
+    return dict(disk_bytes=int(disk), resident_bytes=int(resident))
+
+
+def spill_partitions(spill_dir: str, feat_parts, rows_per_chunk: int =
+                     65536, fmt: str = 'npy'):
+  """Write per-partition (ids, rows) blocks as sorted-id disk tiers and
+  return ``[(sorted_ids, DiskTier), ...]`` — the layout
+  TieredDistFeature consumes directly (and the offline step a
+  partitioner can run once so later runs never touch the raw arrays)."""
+  out = []
+  for i, (ids, rows) in enumerate(feat_parts):
+    ids = np.asarray(ids)
+    order = np.argsort(ids)
+    tier = spill_array(os.path.join(spill_dir, f'part_{i:03d}'),
+                       np.asarray(rows)[order],
+                       rows_per_chunk=rows_per_chunk, fmt=fmt)
+    out.append((ids[order], tier))
+  return out

@@ -1,0 +1,243 @@
+"""TieredScanTrainer: the scanned epoch over an out-of-core feature store.
+
+``loader.ScanTrainer`` requires the whole [N, F] feature table in HBM;
+this trainer runs the SAME epoch-as-a-program over a
+``storage.TieredFeature`` whose table spans HBM -> host RAM -> disk:
+
+* **Prologue plan, one dispatch.** The epoch-seeds program is extended
+  with an id-only replay of the sampler over every step (same
+  ``fold_in(base_key, count)`` keys the chunk programs will derive, so
+  the draws are bit-identical by the PR 1/4 replay contracts) and emits
+  the [steps, node_cap] STORAGE-ROW matrix alongside the seed matrix —
+  still ONE ``epoch_seeds`` dispatch, so the epoch budget stays
+  ``ceil(steps/K) + 2``. The row matrix is fetched once (the prologue's
+  one explicit ``jax.device_get``) and ``planner.plan_from_rows`` turns
+  it into per-chunk sorted miss sets.
+* **Chunk-boundary staging.** While chunk ``c`` trains on device, the
+  bounded staging worker (storage/staging.py) gathers chunk ``c+1``'s
+  warm/disk rows into a pow2-padded host slab; at the boundary the
+  dispatch thread device_puts the slab (explicit — the strict_guards
+  region stays transfer-clean) and dispatches the chunk. Slabs are
+  acked (freed) as soon as their chunk is dispatched.
+* **In-program tiered gather.** The chunk program's feature gather is
+  hot-prefix ``take`` + slab ``searchsorted`` — every non-hot row a
+  chunk touches is in its slab by construction (the plan is exact), so
+  losses are BIT-IDENTICAL to the all-HBM ScanTrainer. Staging shapes
+  are pow2-capped: one executable per (chunk length, slab cap) pair.
+* **Degradation, never corruption.** A failed/slow staging worker
+  degrades to a synchronous gather of the same planned rows
+  (``storage.prefetch_miss``); the chaos suite completes the epoch
+  bit-identically with a ``storage.stage`` fault armed.
+
+Sampling runs twice per epoch (once id-only in the plan, once in the
+chunks) — the price of an exact plan with zero extra dispatches; the
+oversubscription gate (bench.py 'oversub' section, ROADMAP item 2)
+bounds the total at ~1.5x the all-HBM epoch wall.
+"""
+from typing import Optional
+
+import numpy as np
+
+from ..loader.node_loader import NodeLoader
+from ..loader.scan_epoch import ScanTrainer
+from ..metrics import spans
+from ..utils.strict import strict_guards
+from ..utils.trace import record_dispatch
+from . import planner
+from .staging import INT32_MAX, ChunkStager
+from .tiered import TieredFeature
+
+
+def tiered_gather(hot, slab_ids, slab, id2i, node):
+  """Traced three-way feature gather: node-id buffer -> rows from the
+  HBM hot prefix or the chunk's staged slab. Mirrors
+  ``ops.collate_batch``'s clamp exactly (pad slots -> node id 0), so a
+  tiered batch is byte-identical to the all-HBM gather. Rows in neither
+  (an impossible case under an exact plan) read as zeros rather than
+  garbage."""
+  import jax.numpy as jnp
+  safe = jnp.maximum(node, 0)
+  ridx = id2i[safe] if id2i is not None else safe
+  h = hot.shape[0]
+  hot_rows = hot[jnp.clip(ridx, 0, h - 1)]
+  pos = jnp.clip(jnp.searchsorted(slab_ids, ridx.astype(jnp.int32)), 0,
+                 slab_ids.shape[0] - 1)
+  in_slab = slab_ids[pos] == ridx.astype(jnp.int32)
+  return jnp.where((ridx < h)[:, None], hot_rows,
+                   jnp.where(in_slab[:, None], slab[pos], 0))
+
+
+class TieredScanTrainer(ScanTrainer):
+  """ScanTrainer over a TieredFeature (HBM hot prefix + host warm tier
+  + disk cold tier), with the epoch prefetch plan fused into the
+  prologue and chunk-boundary staging (module docstring).
+
+  Args (beyond ScanTrainer's):
+    max_ahead: staged chunks in flight (2 = double buffer).
+    stage_timeout_s: how long a chunk boundary waits for its slab
+      before degrading to a synchronous read.
+  """
+
+  _NAME = 'TieredScanTrainer'
+
+  def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
+               chunk_size: int = 32,
+               seed_labels_only: Optional[bool] = None,
+               perm_seed: Optional[int] = None, max_ahead: int = 2,
+               stage_timeout_s: float = 30.0):
+    store = loader.data.node_features
+    if not isinstance(store, TieredFeature):
+      raise ValueError(
+          f'{self._NAME} drives a storage.TieredFeature store, got '
+          f'{type(store).__name__}; use loader.ScanTrainer for all-HBM '
+          'Feature tables')
+    self._store = store
+    super().__init__(loader, model, tx, num_classes, chunk_size,
+                     seed_labels_only, perm_seed)
+    self._stager = ChunkStager(store, max_ahead=max_ahead,
+                               timeout_s=stage_timeout_s)
+    self.last_plan = None   # EpochPlan of the most recent epoch
+
+  # ------------------------------------------------------ trainer hooks
+
+  def _resolve_feature_tables(self, loader):
+    # the device table is the HOT PREFIX only; the id2index remap is
+    # shared with the all-HBM path (scan_tables validates hot_rows >= 1
+    # so the collate clamp lands on resident rows)
+    return self._store.scan_tables()
+
+  def _make_sample_collate_body(self):
+    from .. import ops
+    sample_fn, label_cap = self._sample_fn, self._label_cap
+
+    def _sample_collate(fargs, feats, id2i, labels, seeds, smask, key):
+      hot, slab_ids, slab = feats
+      res = sample_fn(*fargs, seeds, smask, key)
+      col = ops.collate_batch(res['node'], res['num_nodes'], res['row'],
+                              res['col'], None, None, labels, None,
+                              None, label_cap=label_cap)
+      x = tiered_gather(hot, slab_ids, slab, id2i, res['node'])
+      batch = dict(x=x, edge_index=col['edge_index'],
+                   edge_mask=res['edge_mask'], y=col['y'],
+                   num_seed_nodes=res['num_sampled_nodes'][0])
+      return batch, res['overflow']
+
+    return _sample_collate
+
+  def _build_seed_fn(self):
+    """The prologue PLAN program: the base seed/permutation math plus
+    an id-only sampler replay over every step, emitting the epoch's
+    [steps, node_cap] storage-row matrix — one dispatch, fetched once.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    batch = self._batch_size
+    shuffle = self._shuffle
+    sample_fn = self._sample_fn
+    has_id2i = self._id2i is not None
+
+    def epoch_seeds(fargs, id2i, seeds, key, base_key, count0, steps):
+      n = seeds.shape[0]
+      order = (jax.random.permutation(key, n) if shuffle
+               else jnp.arange(n, dtype=jnp.int32))
+      total = steps * batch
+      if total <= n:
+        order = order[:total]
+        mask = jnp.ones((total,), bool)
+      else:
+        order = jnp.concatenate(
+            [order, jnp.zeros((total - n,), order.dtype)])
+        mask = jnp.arange(total) < n
+      seed_mat = jnp.where(mask, seeds[order], 0).reshape(steps, batch)
+      mask_mat = mask.reshape(steps, batch)
+      counts = count0 + lax.iota(jnp.int32, steps)
+
+      def step_rows(carry, xs):
+        seeds_s, mask_s, count = xs
+        k = jax.random.fold_in(base_key, count)
+        res = sample_fn(*fargs, seeds_s, mask_s, k)
+        safe = jnp.maximum(res['node'], 0)
+        ridx = id2i[safe] if has_id2i else safe
+        return carry, ridx.astype(jnp.int32)
+
+      _, rows_mat = lax.scan(step_rows, 0, (seed_mat, mask_mat, counts))
+      return seed_mat, mask_mat, rows_mat
+
+    return jax.jit(epoch_seeds, static_argnums=(6,))
+
+  # ------------------------------------------------------------- epoch
+
+  def _run_epoch_body(self, state, steps, full_steps):
+    """The tiered epoch program: fused plan prologue (one dispatch, one
+    explicit fetch) + staged chunk loop. Budget: 1 epoch_seeds +
+    ceil(steps/K) scan_chunk + 1 metrics_concat = ceil(steps/K) + 2 —
+    unchanged from the all-HBM trainer."""
+    import jax
+    if self._seeds_dev is None:
+      self._seeds_dev = jax.device_put(
+          np.asarray(self.loader.input_seeds, dtype=np.int32))
+    perm_key = jax.random.fold_in(self._perm_key, self._epochs)
+    fargs = self._sampler._fused_args()
+    base_key = self._sampler._key
+    count0 = jax.device_put(np.int32(self._sampler._call_count + 1))
+    ovf = jax.device_put(np.zeros((), bool))
+    losses, accs = [], []
+    start = 0
+    hot = self._feats
+    with strict_guards():
+      record_dispatch('epoch_seeds')
+      seed_mat, mask_mat, rows_mat = self._seed_fn(
+          fargs, self._id2i, self._seeds_dev, perm_key, base_key,
+          count0, full_steps)
+      # the prologue's ONE fetch: the planned storage rows (explicit
+      # device_get — strict_guards rejects implicit transfers only)
+      rows_host = jax.device_get(rows_mat)[:steps]
+      plan = planner.plan_from_rows(rows_host, self.chunk_size,
+                                    self._store.hot_rows,
+                                    self._store.warm_rows)
+      self.last_plan = plan
+      self._stager.begin_epoch(plan.chunk_rows)
+      while start < steps:
+        k = min(self.chunk_size, steps - start)
+        c = start // self.chunk_size
+        slab_ids_np, slab_np = self._stager.take(c)
+        slab_ids = jax.device_put(slab_ids_np)
+        slab = jax.device_put(slab_np)
+        record_dispatch('scan_chunk')
+        with spans.span('epoch.chunk', start=start, k=k):
+          state, ovf, loss_k, acc_k = self._chunk_fn(
+              state, ovf, fargs, (hot, slab_ids, slab), self._id2i,
+              self._labels, seed_mat, mask_mat, base_key, count0,
+              jax.device_put(np.int32(start)), k)
+        # the device_put above copied the slab: free its ring slot and
+        # let the worker pull the next chunk forward
+        self._stager.ack(c)
+        losses.append(loss_k)
+        accs.append(acc_k)
+        start += k
+        self._steps_dispatched = start
+      if len(losses) > 1:
+        record_dispatch('metrics_concat')
+        losses, accs = self._concat_fn(losses, accs)
+      else:
+        losses, accs = losses[0], accs[0]
+    self._sampler._call_count += steps
+    self._epochs += 1
+    return state, losses, accs, ovf
+
+  def _flight_config(self) -> dict:
+    cfg = super()._flight_config()
+    cfg.update(hot_rows=self._store.hot_rows,
+               warm_rows=self._store.warm_rows,
+               disk_rows=self._store.disk_rows)
+    return cfg
+
+  def close(self):
+    """Stop the staging worker thread."""
+    self._stager.close()
+
+
+# keep the module's int sentinel importable next to the trainer (the
+# slab pad id tests assert against)
+__all__ = ['TieredScanTrainer', 'tiered_gather', 'INT32_MAX']

@@ -1,0 +1,232 @@
+"""Chunk-boundary staging pipeline: disk -> pinned host ring -> warm tier.
+
+The reference hides feature-fetch latency behind CUDA streams and UVA
+zero-copy (PAPER.md, unified_tensor.cu); PyTorch-Direct (arxiv
+2101.07956) and GPU-initiated storage access (arxiv 2306.16384) are the
+GPU-world exemplars. The TPU analog is *double-buffered host staging
+fused to the scanned epoch's chunk cadence*: the whole epoch's miss set
+is computable at the prologue (storage/planner.py), so while chunk ``c``
+trains on device, a single bounded worker thread gathers chunk
+``c+1``'s warm/disk rows into a host ring slab (pow2-padded — the
+chunk program's staging shapes form a closed set) and hands it to the
+dispatch thread at the chunk boundary.
+
+Failure semantics (docs/failure_model.md): a failed or slow staging
+worker NEVER yields a wrong batch — :meth:`ChunkStager.take` falls back
+to a synchronous on-demand gather of the SAME planned row set (counted
+by ``storage.prefetch_miss``), so the degraded epoch is bit-identical
+to the healthy one, just slower. Fault sites ``storage.stage`` (the
+worker's gather) and ``storage.promote`` (handing the slab to the
+ring) are registered in utils/faults.py for the chaos suite.
+
+Observability: ``storage.staged_rows`` / ``storage.staged_bytes``
+counters, ``storage.stage_ms`` / ``storage.promote_ms`` histograms, a
+``storage.ring_rows`` gauge, and one ``storage.stage`` span per staged
+chunk (docs/observability.md).
+"""
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import metrics
+from ..metrics import spans
+from ..utils.faults import fault_point
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def pow2_slab_cap(n: int) -> int:
+  """Padded slab capacity: next power of two, floor 1 — the staging
+  analog of UnifiedTensor's pow2 cold caps (one executable per shape)."""
+  if n <= 1:
+    return 1
+  return 1 << int(n - 1).bit_length()
+
+
+def pad_slab(row_ids: np.ndarray, rows: np.ndarray):
+  """(ids [cap], rows [cap, F]) pow2-padded; pad id slots carry
+  INT32_MAX so an in-program searchsorted can never match them."""
+  n = int(row_ids.shape[0])
+  cap = pow2_slab_cap(n)
+  ids = np.full((cap,), INT32_MAX, np.int32)
+  ids[:n] = row_ids
+  out = np.zeros((cap,) + rows.shape[1:], rows.dtype)
+  out[:n] = rows
+  return ids, out
+
+
+class _Slab:
+  __slots__ = ('ids', 'rows', 'ready', 'error', 'staged_async', 't_done')
+
+  def __init__(self):
+    self.ids = None
+    self.rows = None
+    self.ready = threading.Event()
+    self.error: Optional[BaseException] = None
+    self.staged_async = False
+    self.t_done: Optional[float] = None
+
+
+class ChunkStager:
+  """One background worker staging planned chunk slabs ahead of the
+  dispatch loop.
+
+  Args:
+    store: the TieredFeature whose warm/disk tiers to read
+      (``store.stage_gather(abs_rows)``).
+    max_ahead: outstanding staged chunks (2 = classic double buffer:
+      slab c+1 fills while chunk c trains).
+    timeout_s: how long :meth:`take` waits for the worker before
+      degrading to a synchronous gather.
+  """
+
+  def __init__(self, store, max_ahead: int = 2, timeout_s: float = 30.0):
+    if max_ahead < 1:
+      raise ValueError('max_ahead must be >= 1')
+    self.store = store
+    self.max_ahead = int(max_ahead)
+    self.timeout_s = float(timeout_s)
+    self._plan: List[np.ndarray] = []
+    self._slabs: Dict[int, _Slab] = {}
+    self._lock = threading.Lock()
+    self._q: 'queue.Queue' = queue.Queue()
+    self._worker: Optional[threading.Thread] = None
+    self._stop = False
+    self._next_submit = 0
+    self.degraded = False   # a worker gather failed this epoch
+    # perf_counter marks per chunk, kept for the whole epoch — the
+    # chunk-boundary-overlap contract ("stage of c+1 completes before
+    # chunk c's ack") is asserted from these
+    self.stage_done_t: Dict[int, float] = {}
+    self.ack_t: Dict[int, float] = {}
+
+  # ------------------------------------------------------------ lifecycle
+
+  def begin_epoch(self, chunk_rows: List[np.ndarray]):
+    """Install this epoch's plan (per-chunk sorted absolute storage
+    rows beyond the hot tier) and prime the first ``max_ahead`` slabs.
+    Any previous epoch's outstanding slabs are dropped."""
+    with self._lock:
+      self._plan = list(chunk_rows)
+      self._slabs = {}
+      self._next_submit = 0
+      self.degraded = False
+      self.stage_done_t = {}
+      self.ack_t = {}
+    self._ensure_worker()
+    for _ in range(min(self.max_ahead, len(self._plan))):
+      self._submit_next()
+
+  def close(self):
+    self._stop = True
+    self._q.put(None)
+    w = self._worker
+    if w is not None:
+      w.join(timeout=5.0)
+    self._worker = None
+    self._stop = False
+    # drain whatever the dead worker left behind (queued chunk ids, the
+    # None sentinel itself when the worker exited on a chunk id + _stop
+    # instead): a stale None would kill the NEXT epoch's fresh worker on
+    # its first pop, silently degrading every take() to the timeout path
+    try:
+      while True:
+        self._q.get_nowait()
+    except queue.Empty:
+      pass
+
+  def _ensure_worker(self):
+    if self._worker is not None and self._worker.is_alive():
+      return
+    self._worker = threading.Thread(target=self._loop, daemon=True,
+                                    name='glt-storage-stager')
+    self._worker.start()
+
+  def _submit_next(self):
+    with self._lock:
+      c = self._next_submit
+      if c >= len(self._plan):
+        return
+      self._next_submit = c + 1
+      self._slabs[c] = _Slab()
+    self._q.put(c)
+
+  # --------------------------------------------------------------- worker
+
+  def _loop(self):
+    while True:
+      c = self._q.get()
+      if c is None or self._stop:
+        return
+      with self._lock:
+        slab = self._slabs.get(c)
+        rows_abs = self._plan[c] if c < len(self._plan) else None
+      if slab is None or rows_abs is None:
+        continue   # epoch moved on under us
+      try:
+        with spans.span('storage.stage', chunk=int(c),
+                        rows=int(rows_abs.shape[0])):
+          t0 = time.perf_counter()
+          fault_point('storage.stage')
+          ids, rows = self._gather(rows_abs)
+          metrics.observe('storage.stage_ms',
+                          (time.perf_counter() - t0) * 1e3)
+          t1 = time.perf_counter()
+          fault_point('storage.promote')
+          slab.ids, slab.rows = ids, rows
+          slab.staged_async = True
+          metrics.inc('storage.staged_rows', int(rows_abs.shape[0]))
+          metrics.inc('storage.staged_bytes', int(rows.nbytes))
+          metrics.observe('storage.promote_ms',
+                          (time.perf_counter() - t1) * 1e3)
+          metrics.set_gauge('storage.ring_rows', self._ring_rows())
+      except BaseException as e:   # a chaos 'raise' must not kill later chunks
+        slab.error = e
+        self.degraded = True
+      finally:
+        slab.t_done = time.perf_counter()
+        with self._lock:
+          self.stage_done_t[c] = slab.t_done
+        slab.ready.set()
+
+  def _gather(self, rows_abs: np.ndarray):
+    rows = self.store.stage_gather(rows_abs)
+    return pad_slab(rows_abs.astype(np.int32), rows)
+
+  def _ring_rows(self) -> int:
+    with self._lock:
+      return sum(s.rows.shape[0] for s in self._slabs.values()
+                 if s.rows is not None)
+
+  # ------------------------------------------------------------- consumer
+
+  def take(self, c: int):
+    """Slab for chunk ``c``: ``(ids [cap] int32 sorted+INT32_MAX pads,
+    rows [cap, F])``. Blocks up to ``timeout_s`` for the worker, then
+    degrades to a synchronous gather of the same planned rows (counted
+    in ``storage.prefetch_miss``) — identical bytes either way. Also
+    submits the next chunk so the pipeline stays ``max_ahead`` deep."""
+    with self._lock:
+      slab = self._slabs.get(c)
+      rows_abs = self._plan[c]
+    ok = slab is not None and slab.ready.wait(self.timeout_s)
+    self._submit_next()
+    if ok and slab.error is None and slab.ids is not None:
+      return slab.ids, slab.rows
+    # degraded path: the worker died, faulted, or is too slow — gather
+    # the SAME planned rows on the dispatch thread. Never a wrong
+    # batch, only a slower one.
+    self.degraded = True
+    metrics.inc('storage.prefetch_miss', int(rows_abs.shape[0]))
+    return self._gather(rows_abs)
+
+  def ack(self, c: int):
+    """Chunk ``c``'s program has consumed its slab (the device_put
+    copied it): free the ring slot."""
+    with self._lock:
+      self._slabs.pop(c, None)
+      self.ack_t[c] = time.perf_counter()
+    metrics.set_gauge('storage.ring_rows', self._ring_rows())

@@ -57,6 +57,8 @@ REGISTERED_SITES = frozenset({
     'server.fetch',
     'producer.worker.batch',
     'heartbeat.probe',
+    'storage.stage',
+    'storage.promote',
 })
 
 

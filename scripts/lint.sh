@@ -13,10 +13,12 @@ rc=0
 
 echo "== graftlint =="
 # the package walk includes every subpackage — the serving tier
-# (graphlearn_tpu/serving/) is additionally scoped into the host-sync
-# and dispatch-instrumentation rules via analysis/core.py Config, so
-# its traced block programs carry the same hot-path contracts as the
-# scanned trainers
+# (graphlearn_tpu/serving/) and the out-of-core storage tier
+# (graphlearn_tpu/storage/: tiered scan-chunk + plan programs, staging
+# pipeline) are additionally scoped into the host-sync and
+# dispatch-instrumentation rules via analysis/core.py Config, so their
+# traced programs carry the same hot-path contracts as the scanned
+# trainers
 python -m graphlearn_tpu.analysis.lint graphlearn_tpu/ || rc=1
 
 echo "== ruff =="

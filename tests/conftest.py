@@ -112,7 +112,7 @@ def rng():
 # to debug a failure with the guards off.
 
 _STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
-                   'test_serving')
+                   'test_serving', 'test_storage')
 
 
 @pytest.fixture(autouse=True)
