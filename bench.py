@@ -422,6 +422,17 @@ BENCH_KEY_REGISTRY = {
     'staged_mb_per_chunk': 'MB staged host->ring per scanned chunk',
     'oversub_bit_identical': 'tiered epoch losses == all-HBM losses',
     'oversub_config': 'graph/tier/oversubscription shape of the figures',
+    # chunk-granular recovery (recovery/, docs/recovery.md): a scanned
+    # epoch checkpointed at the default cadence vs the plain epoch,
+    # plus a kill-at-chunk-N + resume measuring the lost-work bound
+    'checkpoint_save_ms_p99': 'checkpoint.save_ms p99 over the '
+                              'checkpointed epochs (ms)',
+    'checkpoint_bytes': 'avg bytes per chunk-boundary snapshot',
+    'resume_replay_chunks': 'chunks of lost work replayed after the '
+                            'kill (kill boundary - checkpoint boundary)',
+    'recovery_overhead_pct': 'checkpointed vs plain scanned epoch wall '
+                             'overhead, % (default cadence; gate <5%)',
+    'recovery_config': 'graph/cadence/kill shape of the recovery figures',
     # serving tier (PR 7): offline materialization + online endpoint
     'embed_epoch_wall_s': 'full-graph layer-wise materialization wall s',
     'embed_epoch_dispatches': 'materialization dispatches, all layers',
@@ -451,7 +462,7 @@ BENCH_KEY_REGISTRY = {
 BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
     'hetero_step', 'hetero_ref', 'feature_exchange', 'serving',
-    'oversub',
+    'oversub', 'recovery',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -476,6 +487,9 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
     'embed_epoch_wall_s', 'embed_epoch_dispatches',
     'oversub_epoch_wall_s', 'staged_mb_per_chunk',
+    # a checkpoint that gets expensive (bytes) or taxing (overhead)
+    # regresses silently otherwise — the issue's gate pair
+    'checkpoint_bytes', 'recovery_overhead_pct',
     'serving_p50_ms', 'serving_p99_ms',
     'hetero_rgnn_step_ms_bf16', 'hetero_rgnn_train_program_ms',
     'hetero_rgat_step_ms_bf16', 'hetero_rgat_train_program_ms',
@@ -1240,6 +1254,119 @@ def main():
   except Exception as e:
     result['oversub_epoch_wall_s'] = None
     result['oversub_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- chunk-granular recovery (recovery/, docs/recovery.md) ----
+  # Three measurements on one scanned fixture: (1) plain epoch wall,
+  # (2) the SAME epoch with a ChunkCheckpointer at the default cadence
+  # (overhead gate: <5%), (3) a kill at chunk N + resume, reporting
+  # the lost-work bound (replayed chunks) and asserting the resumed
+  # epoch's losses bit-match the uninterrupted stream. Fetch-bearing
+  # by design (boundary device_gets ARE the mechanism), so it sits
+  # with the other fetch-bearing sections, after everything
+  # dispatch-sensitive.
+  try:
+    import tempfile
+    import time as _time
+
+    from graphlearn_tpu import metrics as glt_metrics
+    from graphlearn_tpu.models import GraphSAGE as _SAGE
+    from graphlearn_tpu.models import train as _train_lib
+    from graphlearn_tpu.recovery import ChunkCheckpointer
+    rc_n, rc_deg, rc_f = 20_000, 4, 32
+    rc_batch, rc_seeds, rc_k, rc_every = 128, 4096, 4, 4
+    rc_rng = np.random.default_rng(23)
+    rc_rows = np.repeat(np.arange(rc_n), rc_deg)
+    rc_cols = (rc_rows + rc_rng.integers(1, rc_n, rc_rows.shape[0])) % rc_n
+    rc_feat = rc_rng.standard_normal((rc_n, rc_f)).astype(np.float32)
+    rc_labels = rc_rng.integers(0, E2E_CLASSES, rc_n)
+    rc_pool = rc_rng.permutation(rc_n)[:rc_seeds].astype(np.int64)
+    rc_steps = rc_seeds // rc_batch          # 32 steps, 8 chunks of K=4
+
+    def rc_build():
+      ds = glt.data.Dataset()
+      ds.init_graph(np.stack([rc_rows, rc_cols]), graph_mode='CPU',
+                    num_nodes=rc_n)
+      ds.init_node_features(rc_feat)
+      ds.init_node_labels(rc_labels)
+      return glt.loader.NeighborLoader(ds, [3, 2], rc_pool,
+                                       batch_size=rc_batch,
+                                       shuffle=False, drop_last=True,
+                                       seed=7)
+
+    rc_model = _SAGE(hidden_dim=64, out_dim=E2E_CLASSES, num_layers=2)
+    rc_tmpl = _train_lib.batch_to_dict(next(iter(rc_build())))
+
+    def rc_epoch(ckpt_dir=None, kill_chunk=None):
+      """(wall of the 2nd epoch or None, losses of the 1st epoch,
+      trainer, checkpointer) — epoch 1 compiles, epoch 2 measures;
+      kill_chunk raises out of epoch 1 at that chunk's boundary."""
+      import jax as _jax
+      state, tx = _train_lib.create_train_state(
+          rc_model, _jax.random.PRNGKey(0), rc_tmpl)
+      tr = glt.loader.ScanTrainer(rc_build(), rc_model, tx,
+                                  E2E_CLASSES, chunk_size=rc_k)
+      ck = None
+      if ckpt_dir is not None:
+        ck = ChunkCheckpointer(ckpt_dir, every=rc_every).attach(tr)
+      if kill_chunk is not None:
+        def rc_killer(c, start, k):
+          if c == kill_chunk:
+            raise RuntimeError('bench kill')
+        tr.stage_hook = rc_killer
+        try:
+          tr.run_epoch(state)
+          raise AssertionError('bench kill did not fire')
+        except RuntimeError:
+          pass
+        ck.close()
+        return None, None, tr, ck
+      state, losses1, _ = tr.run_epoch(state)     # compile epoch
+      t0 = _time.perf_counter()
+      state, losses2, _ = tr.run_epoch(state)     # measured epoch
+      _jax.block_until_ready(losses2)
+      wall = _time.perf_counter() - t0
+      if ck is not None:
+        ck.flush()
+      return wall, np.asarray(losses1), tr, ck
+
+    base_wall, rc_losses1, _, _ = rc_epoch()
+    c0 = glt_metrics.default_registry().counters()
+    rc_dir = tempfile.mkdtemp(prefix='glt_ckpt_')
+    ck_wall, _, _, rc_ck = rc_epoch(ckpt_dir=rc_dir)
+    rc_ck.close()
+    c1 = glt_metrics.default_registry().counters()
+    saves = c1.get('checkpoint.saves', 0) - c0.get('checkpoint.saves', 0)
+    sbytes = c1.get('checkpoint.bytes', 0) - c0.get(
+        'checkpoint.bytes', 0)
+    # kill at the chunk after the first cadence write, then resume in
+    # a FRESH trainer: bit-identity vs the uninterrupted first epoch
+    rc_kill = rc_every + 1
+    rc_dir2 = tempfile.mkdtemp(prefix='glt_ckpt_kill_')
+    _, _, _, _ = rc_epoch(ckpt_dir=rc_dir2, kill_chunk=rc_kill)
+    import jax as _jax
+    tmpl_state, rc_tx = _train_lib.create_train_state(
+        rc_model, _jax.random.PRNGKey(1), rc_tmpl)
+    rc_fresh = glt.loader.ScanTrainer(rc_build(), rc_model, rc_tx,
+                                      E2E_CLASSES, chunk_size=rc_k)
+    rc_resumer = ChunkCheckpointer(rc_dir2)
+    snap = rc_resumer.latest()
+    _, rl, _ = rc_resumer.resume_epoch(rc_fresh, tmpl_state,
+                                       snapshot=snap)
+    assert np.array_equal(rl, rc_losses1), 'resume diverged'
+    result['checkpoint_save_ms_p99'] = round(
+        glt_metrics.histogram('checkpoint.save_ms')
+        .percentiles()['p99'], 3)
+    result['checkpoint_bytes'] = int(sbytes / max(1, saves))
+    result['resume_replay_chunks'] = rc_kill - (snap.next_start // rc_k)
+    result['recovery_overhead_pct'] = round(
+        100.0 * (ck_wall - base_wall) / base_wall, 2)
+    result['recovery_config'] = (
+        f'N={rc_n}, deg={rc_deg}, F={rc_f}, batch {rc_batch} x '
+        f'{rc_steps} steps, K={rc_k}, cadence {rc_every} chunks, '
+        f'kill at chunk {rc_kill}, resume bit-identical')
+  except Exception as e:
+    result['recovery_overhead_pct'] = None
+    result['recovery_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- serving tier (PR 7): offline materialization + online QPS ----
   # LAST measured section by design: the serving path fetches rows per

@@ -38,7 +38,8 @@ if _os.environ.get('JAX_PLATFORMS'):
     pass   # backend already initialized (config then already applied)
 
 from . import (channel, data, distributed, loader, metrics, models, ops,
-               partition, sampler, serving, storage, typing, utils)
+               partition, recovery, sampler, serving, storage, typing,
+               utils)
 # the epoch executors are the package's training entry points — exported
 # at the root alongside their loader-submodule homes
 from .loader import OverlappedTrainer, ScanTrainer

@@ -55,18 +55,19 @@ class Config:
   an exact file match.
   """
   # rule host-sync: modules whose traced code must be sync-free
-  # (storage/ carries the tiered scanned-chunk + plan programs)
+  # (storage/ carries the tiered scanned-chunk + plan programs;
+  # recovery/ rides the chunk-boundary hooks inside the guarded epoch)
   hot_sync_modules: Tuple[str, ...] = (
       'loader/scan_epoch.py', 'loader/pipeline.py',
       'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
-      'ops/', 'serving/', 'storage/')
+      'ops/', 'serving/', 'storage/', 'recovery/')
   # rule dispatch-instrumentation: modules whose jit entrypoints must
   # record dispatches (the dispatch-budget tests' instrumented surface)
   dispatch_modules: Tuple[str, ...] = (
       'loader/scan_epoch.py', 'loader/pipeline.py', 'loader/node_loader.py',
       'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
       'distributed/dist_loader.py', 'sampler/neighbor_sampler.py',
-      'data/unified_tensor.py', 'serving/', 'storage/')
+      'data/unified_tensor.py', 'serving/', 'storage/', 'recovery/')
   # cross-module jit factories the per-module dataflow can't see: calls
   # to these names yield jitted callables (models/train.py builders)
   known_jit_factories: Tuple[str, ...] = ('make_train_step',)

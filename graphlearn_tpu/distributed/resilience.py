@@ -21,6 +21,7 @@ Degradation events are reported through utils/trace.py counters
 epoch is observable without log scraping.
 """
 import logging
+import os
 import random
 import threading
 import time
@@ -35,6 +36,49 @@ logger = logging.getLogger('graphlearn_tpu.resilience')
 # shared jitter source for policies without an explicit seed (process-
 # seeded, so independent clients spread their retries apart)
 _jitter = random.Random()
+
+
+def env_float(name: str, default: float,
+              minimum: Optional[float] = None) -> float:
+  """A float tuning knob from the environment, HARDENED: a malformed
+  or out-of-range value warns and falls back to the default — a typo'd
+  production override must never crash a worker's import or wedge its
+  liveness loop (the GLT_SPAN_BUFFER discipline, metrics/spans.py)."""
+  raw = os.environ.get(name)
+  if raw in (None, ''):
+    return default
+  try:
+    val = float(raw)
+    if val != val or (minimum is not None and val < minimum):
+      raise ValueError('out of range')
+  except (TypeError, ValueError):
+    logger.warning('%s=%r is not a usable number — using the default '
+                   '%s', name, raw, default)
+    return default
+  return val
+
+
+def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
+  """Integer counterpart of :func:`env_float` (same fallback rules)."""
+  raw = os.environ.get(name)
+  if raw in (None, ''):
+    return default
+  try:
+    val = int(raw)
+    if minimum is not None and val < minimum:
+      raise ValueError('out of range')
+  except (TypeError, ValueError):
+    logger.warning('%s=%r is not a usable integer — using the default '
+                   '%s', name, raw, default)
+    return default
+  return val
+
+
+#: Launch-wide heartbeat tuning (docs/failure_model.md): probe period
+#: and miss threshold for Heartbeat instances constructed without
+#: explicit values. Malformed values fall back (env_float/env_int).
+ENV_HEARTBEAT_INTERVAL = 'GLT_HEARTBEAT_INTERVAL'
+ENV_HEARTBEAT_MISS = 'GLT_HEARTBEAT_MISS'
 
 
 class DeadlineExceeded(TimeoutError):
@@ -156,10 +200,17 @@ class Heartbeat:
   """
 
   def __init__(self, ranks: Iterable[int], probe_fn: Callable[[int], None],
-               interval: float = 1.0, miss_threshold: int = 3,
+               interval: Optional[float] = None,
+               miss_threshold: Optional[int] = None,
                on_dead: Optional[Callable[[int, str], None]] = None):
     self._ranks: List[int] = list(ranks)
     self._probe = probe_fn
+    # None = the launch-wide env defaults (hardened parse: a malformed
+    # GLT_HEARTBEAT_* value warns and uses the built-in default)
+    if interval is None:
+      interval = env_float(ENV_HEARTBEAT_INTERVAL, 1.0, minimum=1e-3)
+    if miss_threshold is None:
+      miss_threshold = env_int(ENV_HEARTBEAT_MISS, 3, minimum=1)
     self.interval = interval
     self.miss_threshold = max(1, miss_threshold)
     self._on_dead = on_dead

@@ -69,6 +69,30 @@ from .pipeline import (_RECOMPUTE_MSG, DistFusedEpochTrainer,
                        FusedEpochTrainer)
 
 
+def _recovery_config_for(trainer) -> dict:
+  """The snapshot-fingerprint config (recovery/checkpoint.py): the
+  flight grouping config PLUS every stream-determining knob it omits —
+  sampler strategy/dedup/padded-window/weighting and a digest of the
+  seed pool itself. The resume refusal must catch any drift that would
+  change the replayed draws, not just the coarse shape (a
+  padded_window added to an 'identical' loader samples a different
+  stream at the same fanouts/batch/seed)."""
+  import hashlib
+  s = trainer._sampler
+  cfg = trainer._flight_config()
+  cfg.update(
+      strategy=getattr(s, 'strategy', None),
+      dedup=getattr(s, 'dedup', None),
+      padded_window=getattr(s, 'padded_window', None),
+      weighted=str(getattr(s, 'with_weight', None)),
+      frontier_caps=str(getattr(s, 'frontier_caps', None)),
+      seeds_sha=hashlib.sha1(
+          np.ascontiguousarray(
+              np.asarray(trainer.loader.input_seeds,
+                         np.int64)).tobytes()).hexdigest()[:16])
+  return cfg
+
+
 class ScanTrainer(FusedEpochTrainer):
   """Executes an epoch as ~ceil(steps/K) scanned-chunk dispatches.
 
@@ -86,13 +110,16 @@ class ScanTrainer(FusedEpochTrainer):
 
   _NAME = 'ScanTrainer'
 
-  # chunk-boundary staging hooks (storage/ subsystem, docs/storage.md):
-  # ``stage_hook(chunk_index, start, k)`` runs on the dispatch thread
-  # BEFORE each chunk dispatch, ``ack_hook(chunk_index, start, k)``
-  # right after it — the seam the out-of-core pipeline (and tests)
-  # attach to without subclassing the epoch loop. Host-side only; a
-  # hook must not fetch device arrays (the loop runs under
-  # strict_guards).
+  # chunk-boundary staging hooks (storage/ subsystem, docs/storage.md;
+  # recovery/ checkpointing, docs/recovery.md): ``stage_hook(
+  # chunk_index, start, k)`` runs on the dispatch thread BEFORE each
+  # chunk dispatch, ``ack_hook(chunk_index, start, k)`` right after it
+  # — the seam the out-of-core pipeline and the ChunkCheckpointer
+  # attach to without subclassing the epoch loop. Host-side only; the
+  # loop runs under strict_guards, so a hook may fetch device arrays
+  # EXPLICITLY (jax.device_get — the checkpointer's boundary capture)
+  # but must never transfer implicitly or dispatch programs. Inside
+  # ack_hook, ``self._chunk_carry`` exposes the boundary state.
   stage_hook = None
   ack_hook = None
 
@@ -209,7 +236,8 @@ class ScanTrainer(FusedEpochTrainer):
     # per-step loop's by construction
     return len(self.loader._batcher)
 
-  def run_epoch(self, state, max_steps: Optional[int] = None):
+  def run_epoch(self, state, max_steps: Optional[int] = None,
+                start_step: int = 0, resume_overflow: bool = False):
     """One scanned epoch. Returns ``(state, losses, accs)`` with losses
     and accs [steps]-shaped device arrays — fetch once, after the epoch.
 
@@ -217,7 +245,17 @@ class ScanTrainer(FusedEpochTrainer):
     not be reused; train on the returned state. ``max_steps`` truncates
     the epoch to exactly that many optimizer updates (the permutation is
     still drawn for the full epoch, so truncation never changes which
-    seeds later steps would have seen)."""
+    seeds later steps would have seen).
+
+    ``start_step`` (a chunk boundary — a multiple of ``chunk_size``)
+    resumes THIS epoch mid-flight: the seed matrix is drawn for the
+    full epoch as usual and the scan starts at that boundary, so with
+    the sampler counter and epoch index restored the remaining chunks
+    replay BIT-IDENTICALLY (the recovery/ resume path — callers should
+    go through ``recovery.ChunkCheckpointer.resume_epoch``, which also
+    restores the counters). ``resume_overflow`` seeds the overflow
+    carry with the flag the interrupted prefix had accumulated.
+    Returned losses/accs then cover only ``[start_step, steps)``."""
     import jax
     import jax.numpy as jnp
 
@@ -233,6 +271,15 @@ class ScanTrainer(FusedEpochTrainer):
     truncated = False
     if max_steps is not None and max_steps < steps:
       steps, truncated = max_steps, True
+    if start_step:
+      if start_step % self.chunk_size != 0:
+        raise ValueError(f'start_step={start_step} is not a chunk '
+                         f'boundary (chunk_size={self.chunk_size}) — '
+                         'resume only at the boundaries checkpoints '
+                         'are taken at')
+      if not 0 <= start_step < steps:
+        raise ValueError(f'start_step={start_step} outside this '
+                         f"epoch's {steps} steps")
     # the epoch span is current for the whole program region: chunk
     # spans (and any spans the model hooks open) parent under it.
     # Begun AFTER the step arithmetic so every path below (zero-step
@@ -254,12 +301,14 @@ class ScanTrainer(FusedEpochTrainer):
 
     completed = False
     # reset BEFORE the body: a failure in its staging prologue (fused
-    # args rebuild, carry device_puts) must read as 0 steps dispatched,
-    # not the previous epoch's stale count
-    self._steps_dispatched = 0
+    # args rebuild, carry device_puts) must read as the resume point,
+    # not the previous epoch's stale count — a resume that fails still
+    # records the chunk boundary it reached
+    self._steps_dispatched = start_step
     try:
       state, losses, accs, ovf = self._run_epoch_body(
-          state, steps, full_steps)
+          state, steps, full_steps, start_step=start_step,
+          resume_overflow=resume_overflow)
       completed = True
       if guarded:
         # same contract as OverlappedTrainer: natural epoch end applies
@@ -286,10 +335,12 @@ class ScanTrainer(FusedEpochTrainer):
                        completed=completed,
                        config=self._flight_config(),
                        extra={'chunk_size': self.chunk_size,
-                              'truncated': truncated})
+                              'truncated': truncated,
+                              'start_step': start_step})
     return state, losses, accs
 
-  def _run_epoch_body(self, state, steps, full_steps):
+  def _run_epoch_body(self, state, steps, full_steps, start_step=0,
+                      resume_overflow=False):
     """The epoch program proper: seed draw + scanned chunks. Split out
     so run_epoch owns only the guard/flight bracketing."""
     import jax
@@ -311,9 +362,11 @@ class ScanTrainer(FusedEpochTrainer):
     # numpy arg, an eager op minting a constant — raises, so the epoch
     # region provably contains nothing but all-device program dispatches
     count0 = jax.device_put(np.int32(self._sampler._call_count + 1))
-    ovf = jax.device_put(np.zeros((), bool))
+    # a resume seeds the carry with the interrupted prefix's flag — a
+    # pre-crash overflow must still fire the epoch-end policy
+    ovf = jax.device_put(np.asarray(bool(resume_overflow)))
     losses, accs = [], []
-    start = 0
+    start = start_step
     with strict_guards():
       record_dispatch('epoch_seeds')
       seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
@@ -330,12 +383,21 @@ class ScanTrainer(FusedEpochTrainer):
               state, ovf, fargs, self._feats, self._id2i, self._labels,
               seed_mat, mask_mat, base_key, count0,
               jax.device_put(np.int32(start)), k)
-        if self.ack_hook is not None:
-          self.ack_hook(start // self.chunk_size, start, k)
         losses.append(loss_k)
         accs.append(acc_k)
+        self._steps_dispatched = start + k
+        if self.ack_hook is not None:
+          # boundary carry for the recovery seam (recovery/checkpoint):
+          # valid ONLY inside the hook call — the next chunk dispatch
+          # donates state/ovf. Hooks may device_get it (explicit
+          # fetches pass the strict transfer guard); they must never
+          # fetch implicitly or dispatch programs.
+          self._chunk_carry = dict(state=state, ovf=ovf, losses=losses,
+                                   accs=accs, steps=steps,
+                                   full_steps=full_steps,
+                                   start_step=start_step)
+          self.ack_hook(start // self.chunk_size, start, k)
         start += k
-        self._steps_dispatched = start
       if len(losses) > 1:
         record_dispatch('metrics_concat')
         losses, accs = self._concat_fn(losses, accs)
@@ -356,6 +418,68 @@ class ScanTrainer(FusedEpochTrainer):
                 shuffle=self._shuffle, drop_last=self._drop_last,
                 num_classes=self.num_classes,
                 seed=self.loader._batcher.seed)
+
+  # -------------------------------------------------- recovery protocol
+  # (recovery/checkpoint.py ChunkCheckpointer — docs/recovery.md)
+
+  def _recovery_config(self) -> dict:
+    return _recovery_config_for(self)
+
+  def _recovery_capture(self, carry):
+    """(meta_extra, device_arrays_extra) a boundary snapshot must
+    carry beyond the train state: the sampler stream position (base
+    key + counter — it still holds the EPOCH-START value while the
+    epoch is in flight) and, for padded-window sampling, the
+    padded-table reseed counters."""
+    meta = {'sampler': self._sampler.state_dict()}
+    s = self._sampler
+    if getattr(s, 'padded_window', None) is not None:
+      meta['padded'] = {
+          'seed': int(s._padded_seed),
+          'epochs_started': int(getattr(self.loader, '_epochs_started',
+                                        0))}
+    return meta, {}
+
+  def _recovery_load(self, meta, arrays):
+    """Rewind this (typically fresh) trainer to the snapshot's epoch:
+    sampler stream, epoch index, and — for padded-window sampling —
+    the padded-table reseed counters, positioned so run_epoch's own
+    ``_begin_epoch`` lands the table on exactly the crashed epoch's
+    seed (no refresh for a first epoch, one refresh otherwise)."""
+    del arrays   # the local trainer carries no extra device state
+    self._sampler.load_state_dict(meta['sampler'])
+    self._epochs = int(meta['epoch'])
+    pad = meta.get('padded')
+    if pad:
+      s = self._sampler
+      es = int(pad['epochs_started'])
+      if es <= 1:
+        self.loader._epochs_started = 0
+        s._padded_seed = int(pad['seed'])
+      else:
+        self.loader._epochs_started = es - 1
+        s._padded_seed = int(pad['seed']) - 1
+      # drop any cached padded table so the resumed epoch rebuilds it
+      # from the restored seed
+      s._garrs.pop(('padded', id(s._get_graph())), None)
+
+  def _recovery_advance(self, meta):
+    """A COMPLETED-epoch snapshot resumes as 'advance past it': the
+    stream/epoch counters land where a normal epoch end would leave
+    them, and the padded-table counters keep the values captured
+    DURING that epoch (the next run_epoch's ``_begin_epoch`` then
+    refreshes onto the FOLLOWING epoch's seed, matching the
+    uninterrupted multi-epoch stream). No stats restore: a finished
+    epoch already published its accumulators before the crash."""
+    self._sampler.load_state_dict(meta['sampler'])
+    self._sampler._call_count += int(meta['steps'])
+    self._epochs = int(meta['epoch']) + 1
+    pad = meta.get('padded')
+    if pad:
+      s = self._sampler
+      self.loader._epochs_started = int(pad['epochs_started'])
+      s._padded_seed = int(pad['seed'])
+      s._garrs.pop(('padded', id(s._get_graph())), None)
 
 
 class DistScanTrainer(DistFusedEpochTrainer):
@@ -562,7 +686,8 @@ class DistScanTrainer(DistFusedEpochTrainer):
 
   # ----------------------------------------------------------------- epoch
 
-  def run_epoch(self, state, max_steps: Optional[int] = None):
+  def run_epoch(self, state, max_steps: Optional[int] = None,
+                start_step: int = 0, resume_overflow: bool = False):
     """One scanned distributed epoch. Returns ``(state, losses, accs)``
     with losses/accs [steps]-shaped replicated device arrays — fetch
     once, after the epoch.
@@ -571,7 +696,11 @@ class DistScanTrainer(DistFusedEpochTrainer):
     on the returned state. ``max_steps`` truncates the epoch to exactly
     that many optimizer updates (the permutation is still drawn for the
     full epoch, so truncation never changes which seeds later steps
-    would have seen)."""
+    would have seen). ``start_step``/``resume_overflow`` resume THIS
+    epoch at a chunk boundary — the recovery seam (see
+    ``ScanTrainer.run_epoch``; go through ``recovery.
+    ChunkCheckpointer.resume_epoch``, which also restores the sampler
+    counter, epoch index and feature-cache stats rows)."""
     import jax
     import jax.numpy as jnp
 
@@ -586,6 +715,13 @@ class DistScanTrainer(DistFusedEpochTrainer):
     truncated = False
     if max_steps is not None and max_steps < steps:
       steps, truncated = max_steps, True
+    if start_step:
+      if start_step % self.chunk_size != 0:
+        raise ValueError(f'start_step={start_step} is not a chunk '
+                         f'boundary (chunk_size={self.chunk_size})')
+      if not 0 <= start_step < steps:
+        raise ValueError(f'start_step={start_step} outside this '
+                         f"epoch's {steps} steps")
     # begun after the step arithmetic: every path below ends the span
     # (zero-step finally, main finally) — see ScanTrainer.run_epoch
     epoch_span = spans.begin('epoch.run', emitter=self._NAME,
@@ -620,12 +756,14 @@ class DistScanTrainer(DistFusedEpochTrainer):
 
     completed = False
     # reset BEFORE the body: a failure in its staging prologue (the
-    # replicated-carry device_puts, program retraces) must read as 0
-    # steps dispatched, not the previous epoch's stale count
-    self._steps_dispatched = 0
+    # replicated-carry device_puts, program retraces) must read as the
+    # resume point, not the previous epoch's stale count — a resume
+    # that fails still records the chunk boundary it reached
+    self._steps_dispatched = start_step
     try:
       state, losses, accs, ovf = self._run_epoch_body(
-          state, steps, full_steps)
+          state, steps, full_steps, start_step=start_step,
+          resume_overflow=resume_overflow)
       completed = True
       if guarded:
         # same contract as the local trainers: natural epoch end
@@ -660,10 +798,12 @@ class DistScanTrainer(DistFusedEpochTrainer):
                          completed=completed,
                          config=self._flight_config(),
                          extra={'chunk_size': self.chunk_size,
-                                'truncated': truncated})
+                                'truncated': truncated,
+                                'start_step': start_step})
     return state, losses, accs
 
-  def _run_epoch_body(self, state, steps, full_steps):
+  def _run_epoch_body(self, state, steps, full_steps, start_step=0,
+                      resume_overflow=False):
     """The mesh epoch program proper: replicated carry staging + seed
     draw + scanned chunks. Split out so run_epoch owns only the
     guard/publish/flight bracketing."""
@@ -700,7 +840,7 @@ class DistScanTrainer(DistFusedEpochTrainer):
                             repl)
     params, opt_state, stepc, ovf = jax.device_put(
         (state.params, state.opt_state, state.step,
-         np.zeros((), bool)), repl)
+         np.asarray(bool(resume_overflow))), repl)
 
     def stats_back(tree):
       # hand the carried accumulators back to the stores AFTER EVERY
@@ -715,7 +855,7 @@ class DistScanTrainer(DistFusedEpochTrainer):
         self._feat._stats = tree
 
     losses, accs = [], []
-    start = 0
+    start = start_step
     try:
       with strict_guards():
         record_dispatch('dist_epoch_seeds')
@@ -732,13 +872,21 @@ class DistScanTrainer(DistFusedEpochTrainer):
                     self._shard_tree, self._repl_tree, stats, params,
                     opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
                     count0, jax.device_put(np.int32(start), repl))
-          if self.ack_hook is not None:
-            self.ack_hook(start // self.chunk_size, start, k)
           stats_back(stats)
           losses.append(loss_k)
           accs.append(acc_k)
+          self._steps_dispatched = start + k
+          if self.ack_hook is not None:
+            # boundary carry for the recovery seam — valid only inside
+            # the hook call (the next chunk dispatch donates the state
+            # and stats buffers); see ScanTrainer
+            self._chunk_carry = dict(
+                state=self._train_state_cls(params, opt_state, stepc),
+                ovf=ovf, stats=stats, losses=losses, accs=accs,
+                steps=steps, full_steps=full_steps,
+                start_step=start_step)
+            self.ack_hook(start // self.chunk_size, start, k)
           start += k
-          self._steps_dispatched = start
         if len(losses) > 1:
           record_dispatch('dist_metrics_concat')
           losses, accs = self._concat_fn(losses, accs)
@@ -768,3 +916,50 @@ class DistScanTrainer(DistFusedEpochTrainer):
                 mesh={a: self.mesh.shape[a] for a in self._axes},
                 hetero=self.is_hetero, num_classes=self.num_classes,
                 seed=self.loader.seed)
+
+  # -------------------------------------------------- recovery protocol
+  # (recovery/checkpoint.py ChunkCheckpointer — docs/recovery.md)
+
+  def _recovery_config(self) -> dict:
+    return _recovery_config_for(self)
+
+  def _recovery_capture(self, carry):
+    """Beyond the train state: the sampler stream position and the
+    feature-cache [P, 4] stats accumulators riding the scan carry —
+    restoring them keeps the resumed epoch's ``publish_stats`` EXACT,
+    not just its losses."""
+    meta = {'sampler': self._sampler.state_dict()}
+    stats = carry.get('stats')
+    if self.is_hetero:
+      dev = {f'stats:{t}': stats[t] for t in self._feat_types}
+    else:
+      dev = {'stats:': stats}
+    return meta, dev
+
+  def _recovery_load(self, meta, arrays):
+    """Rewind a (typically fresh) trainer to the snapshot's epoch:
+    sampler stream, epoch index, and the stores' stats accumulators
+    (committed back to the mesh sharding ``_stats_dev`` uses)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils import global_device_put
+    self._sampler.load_state_dict(meta['sampler'])
+    self._epochs = int(meta['epoch'])
+    if arrays:
+      shard = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
+      if self.is_hetero:
+        for t in self._feat_types:
+          self._feat[t]._stats = global_device_put(
+              np.asarray(arrays[f'stats:{t}'], np.int32), shard)
+      else:
+        self._feat._stats = global_device_put(
+            np.asarray(arrays['stats:'], np.int32), shard)
+
+  def _recovery_advance(self, meta):
+    """Completed-epoch snapshot: advance the stream past the epoch.
+    The stats accumulators are NOT restored — the finished epoch's
+    publish already drained them pre-crash, and restoring would
+    double-count them into the next epoch's publish."""
+    self._sampler.load_state_dict(meta['sampler'])
+    self._sampler._call_count += int(meta['steps'])
+    self._epochs = int(meta['epoch']) + 1

@@ -68,6 +68,20 @@ REGISTERED_METRICS = frozenset({
     'storage.hot_rows',
     'storage.warm_rows',
     'storage.disk_rows',
+    # chunk-granular recovery (graphlearn_tpu/recovery/): async exact
+    # checkpointing at chunk boundaries + mid-epoch resume + scanned
+    # failover rollback (docs/recovery.md)
+    'checkpoint.saves',
+    'checkpoint.bytes',
+    'checkpoint.save_ms',
+    'checkpoint.capture_ms',
+    'checkpoint.sync_fallback',
+    'checkpoint.save_errors',
+    'checkpoint.torn_skipped',
+    'checkpoint.restore_ms',
+    'recovery.resumes',
+    'recovery.resume_chunks',
+    'recovery.rollbacks',
 })
 
 # The closed inventory of SPAN names (metrics/spans.py) — the same
@@ -99,4 +113,10 @@ REGISTERED_SPANS = frozenset({
     # out-of-core staging pipeline (storage/staging.py): one span per
     # staged chunk on the worker thread
     'storage.stage',
+    # chunk-granular recovery (recovery/): one span per snapshot write
+    # (worker thread or sync fallback) and one wrapping each mid-epoch
+    # resume; the failover rollback reuses `loader.failover` with the
+    # rolled-back chunk index in its attrs (docs/recovery.md)
+    'checkpoint.save',
+    'recovery.resume',
 })

@@ -1,0 +1,27 @@
+"""Chunk-granular recovery for the scanned epoch programs.
+
+Three pieces (docs/recovery.md):
+
+* :mod:`snapshot` — the atomic, torn-proof snapshot file format
+  (tmp + fsync + rename; header-checksummed payload).
+* :class:`ChunkCheckpointer` — async exact checkpointing riding the
+  trainers' ``stage_hook``/``ack_hook`` chunk-boundary seams, plus
+  :meth:`~ChunkCheckpointer.resume_epoch`, which restarts a SIGKILLed
+  epoch mid-flight with the remaining chunks bit-identical to the
+  uninterrupted run.
+* :class:`FailoverRunner` — chunk-granular failover for
+  ``DistScanTrainer``: a dead mesh shard (detected via the PR 2
+  Heartbeat) rolls the epoch back at most one chunk, the data
+  re-slices over the survivors, and the epoch completes with exact
+  seed coverage.
+"""
+from .checkpoint import ChunkCheckpointer
+from .failover import FailoverRunner, ShardDeadError, remaining_seeds
+from .snapshot import (Snapshot, TornSnapshotError, list_snapshots,
+                       load_snapshot, write_snapshot)
+
+__all__ = [
+    'ChunkCheckpointer', 'FailoverRunner', 'ShardDeadError',
+    'remaining_seeds', 'Snapshot', 'TornSnapshotError', 'list_snapshots',
+    'load_snapshot', 'write_snapshot',
+]

@@ -1,0 +1,459 @@
+"""ChunkCheckpointer: async exact checkpointing at scanned-chunk
+boundaries, and the mid-epoch resume that replays bit-identically.
+
+The scanned trainers (ScanTrainer / DistScanTrainer /
+TieredScanTrainer) run an epoch as ``ceil(steps/K) + 2`` dispatches
+with every random draw addressed by a host counter (the PR 1/4 replay
+contracts). That contract makes recovery CHEAP: a checkpoint at a
+chunk boundary needs only the train-state leaves, the losses already
+produced, and a handful of counters — the seed permutation and every
+remaining per-step draw replay from them exactly, so a
+:meth:`resume_epoch` after a crash produces the remaining chunks'
+losses and the final params BIT-IDENTICAL to the uninterrupted run
+(tests/test_recovery.py pins this for all three trainers).
+
+Mechanics (the ChunkStager pattern, storage/staging.py):
+
+* :meth:`attach` rides the trainers' existing ``ack_hook`` seam. At
+  every K-chunk cadence hit the dispatch thread materializes a HOST
+  copy of the boundary state (one explicit ``jax.device_get`` — the
+  strict_guards region only rejects implicit transfers, and the copy
+  must happen before the next chunk dispatch donates the buffers) and
+  hands it to a bounded writer thread. Zero extra program dispatches:
+  the GLT_STRICT dispatch-budget tests bit-match ``ceil(steps/K)+2``
+  with a checkpointer attached.
+* The writer serializes + atomically writes the snapshot
+  (recovery/snapshot.py) off the critical path. A slow or failed
+  writer DEGRADES TO SYNC — the boundary writes inline
+  (``checkpoint.sync_fallback``) — and a failing save never kills the
+  epoch (``checkpoint.save_errors``): checkpointing is insurance, not
+  a new failure mode. Torn files are impossible by construction
+  (tmp + fsync + rename) and DETECTED if produced by outside forces
+  (``checkpoint.torn_skipped`` — restore falls back to the previous
+  snapshot).
+* :meth:`resume_epoch` restores the newest valid snapshot into a
+  FRESH trainer (config-fingerprint-checked), rewinds the sampler /
+  epoch counters, and re-runs ``run_epoch(start_step=...)`` over the
+  remaining chunks. A resume that fails mid-replay still writes its
+  ``completed=False`` flight record with the chunk it reached — that
+  bracket lives in the trainers themselves.
+
+Observability: ``checkpoint.*`` metrics + the ``checkpoint.save`` /
+``recovery.resume`` spans (docs/observability.md); fault sites
+``recovery.save`` / ``recovery.restore`` (docs/failure_model.md).
+"""
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import metrics
+from ..metrics import flight, spans
+from . import snapshot as snapshot_lib
+from .snapshot import Snapshot, TornSnapshotError
+
+logger = logging.getLogger('graphlearn_tpu.recovery')
+
+
+class _AckChain:
+  """The installed ack_hook: run any previously-installed hook, then
+  the checkpointer's boundary capture. A module-level callable (not a
+  closure) on purpose — hooks are HOST-side objects, and graftlint's
+  nested-def-in-builder convention would otherwise read a closure here
+  as a traced program body."""
+
+  __slots__ = ('ckpt', 'prev')
+
+  def __init__(self, ckpt, prev):
+    self.ckpt = ckpt
+    self.prev = prev
+
+  def __call__(self, c, start, k):
+    if self.prev is not None:
+      self.prev(c, start, k)
+    self.ckpt._on_ack(c, start, k)
+
+
+class ChunkCheckpointer:
+  """Chunk-cadence exact checkpointing for the scanned trainers.
+
+  Args:
+    directory: snapshot directory, or None for MEMORY-ONLY snapshots
+      (the failover runner's rollback buffer — nothing touches disk).
+    every: disk-write cadence in chunks (a snapshot lands after chunks
+      ``every-1``, ``2*every-1``, ... and always after the final
+      chunk). The resume replays at most ``every`` chunks of lost
+      work.
+    keep: newest snapshots retained on disk (older ones pruned after
+      each successful write; >= 2 keeps a fallback for torn files).
+    mem_every: in-memory snapshot cadence (None = same boundaries as
+      ``every``). The failover runner sets 1: rollback then loses at
+      most the in-flight chunk.
+    max_pending: bounded writer queue depth; a boundary that finds it
+      full writes synchronously instead of stalling the ring.
+
+  Usage::
+
+      ckpt = ChunkCheckpointer('/ckpts/run1', every=4).attach(trainer)
+      state, losses, accs = trainer.run_epoch(state)   # checkpointed
+      ...
+      # after a crash, in a fresh process:
+      ckpt = ChunkCheckpointer('/ckpts/run1').attach(fresh_trainer)
+      state, losses, accs = ckpt.resume_epoch(fresh_trainer, template)
+  """
+
+  def __init__(self, directory: Optional[str] = None, every: int = 4,
+               keep: int = 2, mem_every: Optional[int] = None,
+               max_pending: int = 2):
+    if every < 1:
+      raise ValueError(f'every must be >= 1, got {every}')
+    if keep < 1:
+      raise ValueError(f'keep must be >= 1, got {keep}')
+    self.directory = directory
+    self.every = int(every)
+    self.keep = int(keep)
+    self.mem_every = int(mem_every) if mem_every is not None else None
+    self.max_pending = int(max_pending)
+    self.latest_mem: Optional[dict] = None   # structured host snapshot
+    self.degraded = False    # a writer-thread save failed this run
+    self._trainer = None
+    self._prev_ack = None
+    self._q: 'queue.Queue' = queue.Queue(maxsize=max(1, max_pending))
+    self._worker: Optional[threading.Thread] = None
+    self._wlock = threading.Lock()   # serializes file writes + prunes
+    self._stop = False
+
+  # ------------------------------------------------------------- lifecycle
+
+  def attach(self, trainer) -> 'ChunkCheckpointer':
+    """Hook this checkpointer onto ``trainer``'s ``ack_hook`` seam
+    (chaining any hook already installed). Returns self."""
+    if self._trainer is not None:
+      raise RuntimeError('already attached; detach() first')
+    self._trainer = trainer
+    self._prev_ack = trainer.ack_hook
+    trainer.ack_hook = _AckChain(self, self._prev_ack)
+    return self
+
+  def detach(self):
+    """Restore the trainer's previous ack_hook."""
+    if self._trainer is not None:
+      self._trainer.ack_hook = self._prev_ack
+      self._trainer = None
+      self._prev_ack = None
+
+  def flush(self):
+    """Block until every queued async write has hit disk."""
+    self._q.join()
+
+  def close(self):
+    """Drain pending writes and stop the writer thread."""
+    self.flush()
+    self._stop = True
+    self._q.put(None)
+    w = self._worker
+    if w is not None:
+      w.join(timeout=10.0)
+    self._worker = None
+    self._stop = False
+    try:     # drain a leftover sentinel (the ChunkStager close contract)
+      while True:
+        self._q.get_nowait()
+        self._q.task_done()
+    except queue.Empty:
+      pass
+
+  def _ensure_worker(self):
+    if self._worker is not None and self._worker.is_alive():
+      return
+    self._worker = threading.Thread(target=self._loop, daemon=True,
+                                    name='glt-chunk-checkpointer')
+    self._worker.start()
+
+  def _loop(self):
+    while True:
+      item = self._q.get()
+      try:
+        if item is None or self._stop:
+          return
+        self._write_item(item, sync=False)
+      finally:
+        self._q.task_done()
+
+  # --------------------------------------------------------------- capture
+
+  def _on_ack(self, c: int, start: int, k: int):
+    """Chunk boundary: decide cadence, materialize the host snapshot,
+    route it to memory and/or the writer. Never raises — a checkpoint
+    failure must not kill the epoch it exists to protect."""
+    try:
+      trainer = self._trainer
+      carry = getattr(trainer, '_chunk_carry', None)
+      if carry is None:
+        return
+      next_start = start + k
+      steps = int(carry['steps'])
+      final = next_start >= steps
+      disk_hit = self.directory is not None and (
+          (c + 1) % self.every == 0 or final)
+      mem_hit = (c + 1) % (self.mem_every or self.every) == 0 or final
+      if not (disk_hit or mem_hit):
+        return
+      t0 = time.perf_counter()
+      host = self._capture(trainer, carry, c, next_start)
+      if host is None:
+        return
+      metrics.observe('checkpoint.capture_ms',
+                      (time.perf_counter() - t0) * 1e3)
+      if mem_hit:
+        self.latest_mem = host
+      if disk_hit:
+        self._submit(host)
+    except Exception:
+      metrics.inc('checkpoint.save_errors')
+      logger.exception('chunk checkpoint capture failed — epoch '
+                       'continues unprotected past this boundary')
+
+  def _capture(self, trainer, carry: dict, c: int,
+               next_start: int) -> Optional[dict]:
+    """One explicit device->host fetch of the boundary state. Runs on
+    the dispatch thread BEFORE the next chunk dispatch donates the
+    carry buffers (the strict_guards region allows explicit
+    transfers). Returns None when the boundary cannot yield a
+    WHOLE-epoch snapshot (a resumed epoch whose pre-crash loss prefix
+    is unknown) — a partial-loss snapshot would silently break the
+    bit-identity contract at the next resume."""
+    import jax
+    start_step = int(carry.get('start_step', 0))
+    prefix = None
+    if start_step:
+      # a resumed epoch produces losses only for [start_step, now);
+      # resume_epoch stashes the checkpointed prefix so snapshots
+      # taken DURING the replay still cover the whole epoch (a second
+      # crash resumes exactly like the first)
+      prefix = getattr(trainer, '_recovery_prefix', None)
+      if (prefix is None or prefix['epoch'] != int(trainer._epochs)
+          or prefix['start_step'] != start_step):
+        logger.warning(
+            'chunk %d boundary of a start_step=%d epoch has no loss '
+            'prefix (run_epoch(start_step=...) called outside '
+            'resume_epoch?) — skipping this snapshot rather than '
+            'writing a partial-loss one', c, start_step)
+        return None
+    meta_extra, dev_extra = trainer._recovery_capture(carry)
+    bundle = dict(state=carry['state'], ovf=carry['ovf'],
+                  losses=list(carry['losses']), accs=list(carry['accs']),
+                  extra=dev_extra)
+    host = jax.device_get(bundle)
+    losses = (np.concatenate([np.atleast_1d(a) for a in host['losses']])
+              if host['losses'] else np.zeros((0,), np.float32))
+    accs = (np.concatenate([np.atleast_1d(a) for a in host['accs']])
+            if host['accs'] else np.zeros((0,), np.float32))
+    if prefix is not None:
+      losses = np.concatenate([prefix['losses'], losses])
+      accs = np.concatenate([prefix['accs'], accs])
+    meta = dict(format=1, trainer=trainer._NAME,
+                epoch=int(trainer._epochs), chunk=int(c),
+                next_start=int(next_start), steps=int(carry['steps']),
+                full_steps=int(carry['full_steps']),
+                chunk_size=int(trainer.chunk_size),
+                overflow=bool(host['ovf']),
+                # the STREAM-tight config (flight config + sampler
+                # strategy/window/dedup + seed-pool digest): resume
+                # refuses any drift that would replay different draws
+                config_fingerprint=flight.config_fingerprint(
+                    trainer._recovery_config()))
+    meta.update(meta_extra)
+    return dict(meta=meta, state=host['state'], losses=losses,
+                accs=accs, extra=host['extra'])
+
+  # ----------------------------------------------------------------- write
+
+  def _submit(self, host: dict):
+    item = self._flatten(host)
+    self._ensure_worker()
+    if self.degraded or self._worker is None or \
+        not self._worker.is_alive():
+      metrics.inc('checkpoint.sync_fallback')
+      self._write_item(item, sync=True)
+      return
+    try:
+      self._q.put_nowait(item)
+    except queue.Full:
+      # slow writer: never stall the ring unbounded — write inline
+      metrics.inc('checkpoint.sync_fallback')
+      self._write_item(item, sync=True)
+
+  @staticmethod
+  def _flatten(host: dict) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Structured host snapshot -> (meta, named arrays) for the file
+    format. Leaf order is the pytree flatten order; the resume
+    template re-supplies the structure."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(host['state'])
+    meta = dict(host['meta'], n_leaves=len(leaves))
+    arrays = {f'leaf_{i:05d}': np.asarray(a)
+              for i, a in enumerate(leaves)}
+    arrays['losses'] = host['losses']
+    arrays['accs'] = host['accs']
+    for key, arr in (host['extra'] or {}).items():
+      arrays[f'extra:{key}'] = np.asarray(arr)
+    return meta, arrays
+
+  def _write_item(self, item: Tuple[dict, Dict[str, np.ndarray]],
+                  sync: bool):
+    meta, arrays = item
+    try:
+      with self._wlock:
+        with spans.span('checkpoint.save', epoch=meta['epoch'],
+                        next_start=meta['next_start'], sync=sync):
+          t0 = time.perf_counter()
+          _, nbytes = snapshot_lib.write_snapshot(self.directory, meta,
+                                                  arrays)
+          metrics.observe('checkpoint.save_ms',
+                          (time.perf_counter() - t0) * 1e3)
+          metrics.inc('checkpoint.saves')
+          metrics.inc('checkpoint.bytes', nbytes)
+        self._prune()
+    except Exception as e:
+      # a failed save degrades (later boundaries write sync) but NEVER
+      # propagates — the epoch it protects must finish
+      self.degraded = True
+      metrics.inc('checkpoint.save_errors')
+      logger.warning('checkpoint save at epoch %s step %s failed (%s) '
+                     '— degrading to synchronous writes',
+                     meta.get('epoch'), meta.get('next_start'), e)
+
+  def _prune(self):
+    snaps = snapshot_lib.list_snapshots(self.directory)
+    for _, _, path in snaps[:-self.keep]:
+      try:
+        import os
+        os.unlink(path)
+      except OSError:
+        pass
+
+  # ---------------------------------------------------------------- resume
+
+  def latest(self) -> Optional[Snapshot]:
+    """Newest VALID on-disk snapshot (torn/corrupt files are skipped
+    with ``checkpoint.torn_skipped``), or None."""
+    if self.directory is None:
+      return None
+    t0 = time.perf_counter()
+    for _, _, path in reversed(snapshot_lib.list_snapshots(
+        self.directory)):
+      try:
+        snap = snapshot_lib.load_snapshot(path)
+        metrics.observe('checkpoint.restore_ms',
+                        (time.perf_counter() - t0) * 1e3)
+        return snap
+      except (TornSnapshotError, OSError, ValueError) as e:
+        metrics.inc('checkpoint.torn_skipped')
+        logger.warning('skipping unrestorable snapshot %s: %s', path, e)
+      except Exception as e:  # noqa: BLE001 - injected restore faults land here
+        metrics.inc('checkpoint.torn_skipped')
+        logger.warning('snapshot %s failed to restore (%s) — falling '
+                       'back to the previous one', path, e)
+    return None
+
+  def resume_epoch(self, trainer, state_template: Any,
+                   snapshot: Optional[Snapshot] = None):
+    """Restore the newest snapshot into ``trainer`` and finish its
+    epoch. Returns ``(state, losses, accs)`` with losses/accs HOST
+    float arrays covering the WHOLE epoch (checkpointed prefix +
+    replayed remainder) — bit-identical to the uninterrupted run.
+
+    ``trainer`` is typically a FRESH instance over an identically
+    configured loader (same seeds, batch size, shuffle, chunk_size) —
+    the snapshot's config fingerprint is checked against it, so a
+    drifted configuration fails loudly instead of resuming a
+    different stream. ``state_template`` supplies the train-state
+    pytree STRUCTURE (e.g. a fresh ``create_train_state`` result);
+    its leaf values are discarded.
+    """
+    import jax
+    if self._trainer is not None and self._trainer is not trainer:
+      raise RuntimeError('attached to a different trainer; detach() '
+                         'or attach to the one being resumed')
+    if self._worker is not None:
+      self.flush()
+    snap = snapshot or self.latest()
+    if snap is None:
+      raise FileNotFoundError(
+          f'no restorable snapshot in {self.directory!r}')
+    meta = snap.meta
+    if meta.get('trainer') != trainer._NAME:
+      raise ValueError(
+          f"snapshot was written by {meta.get('trainer')!r}, resuming "
+          f'into {trainer._NAME!r} would diverge')
+    fp = flight.config_fingerprint(trainer._recovery_config())
+    if meta.get('config_fingerprint') != fp:
+      raise ValueError(
+          'snapshot config fingerprint '
+          f"{meta.get('config_fingerprint')} != this trainer's {fp} — "
+          'loader/trainer/sampler configuration drifted (batch, chunk '
+          'size, fanouts, shuffle, sampling strategy/window, or the '
+          'seed pool itself); resuming would not replay the same '
+          'stream (docs/recovery.md)')
+    leaves_t, treedef = jax.tree_util.tree_flatten(state_template)
+    n = int(meta['n_leaves'])
+    if len(leaves_t) != n:
+      raise ValueError(f'state template has {len(leaves_t)} leaves, '
+                       f'snapshot has {n}')
+    host_leaves = []
+    for i, tmpl in enumerate(leaves_t):
+      leaf = snap.arrays[f'leaf_{i:05d}']
+      t_shape = tuple(np.shape(tmpl))
+      if tuple(leaf.shape) != t_shape:
+        raise ValueError(f'leaf {i}: snapshot shape {leaf.shape} != '
+                         f'template shape {t_shape}')
+      host_leaves.append(leaf)
+    # EXPLICIT upload of the restored leaves: the chunk programs run
+    # under strict_guards (transfer_guard('disallow')), which would
+    # reject a host numpy state arriving implicitly at dispatch. The
+    # dist trainer re-commits to its replicated mesh sharding itself.
+    state = jax.device_put(
+        jax.tree_util.tree_unflatten(treedef, host_leaves))
+    extras = {k[len('extra:'):]: v for k, v in snap.arrays.items()
+              if k.startswith('extra:')}
+    steps, next_start = int(meta['steps']), int(meta['next_start'])
+    saved_losses = np.asarray(snap.arrays['losses'])
+    saved_accs = np.asarray(snap.arrays['accs'])
+    metrics.inc('recovery.resumes')
+    if next_start >= steps:
+      # the epoch completed before the crash: position the counters
+      # AFTER it (not at its start — _recovery_load is the replay
+      # path's rewind, and re-restoring already-published stats or
+      # rewinding the padded-table seed here would double-count the
+      # finished epoch) and hand back its final state — the caller
+      # starts the next epoch
+      trainer._recovery_advance(meta)
+      return state, saved_losses, saved_accs
+    trainer._recovery_load(meta, extras)
+    k = int(meta['chunk_size'])
+    replay_chunks = -(-(steps - next_start) // k)
+    metrics.inc('recovery.resume_chunks', replay_chunks)
+    max_steps = steps if steps < int(meta['full_steps']) else None
+    # snapshots taken DURING the replay must still cover the whole
+    # epoch: hand the checkpointed loss prefix to _capture (cleared
+    # afterwards — it is only meaningful for this epoch's replay)
+    trainer._recovery_prefix = dict(epoch=int(meta['epoch']),
+                                    start_step=next_start,
+                                    losses=saved_losses,
+                                    accs=saved_accs)
+    try:
+      with spans.span('recovery.resume', epoch=meta['epoch'],
+                      start_step=next_start,
+                      replay_chunks=replay_chunks):
+        state, losses, accs = trainer.run_epoch(
+            state, max_steps=max_steps, start_step=next_start,
+            resume_overflow=bool(meta.get('overflow', False)))
+    finally:
+      trainer._recovery_prefix = None
+    return (state,
+            np.concatenate([saved_losses, np.asarray(losses)]),
+            np.concatenate([saved_accs, np.asarray(accs)]))

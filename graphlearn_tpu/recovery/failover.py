@@ -1,0 +1,230 @@
+"""Chunk-granular failover for the scanned distributed epoch.
+
+The per-step remote loaders fail over at BATCH granularity (PR 2: a
+dead server's unacked seeds redistribute to survivors) — but
+``DistScanTrainer`` dispatches a K-step chunk as ONE program, so there
+is no per-batch host point to ack from. This module lifts the ack
+protocol to the chunk: the unit of loss on a shard death is AT MOST
+ONE CHUNK.
+
+:class:`FailoverRunner` drives one scanned distributed epoch with:
+
+* **liveness** — any object with ``dead_ranks() -> {rank: cause}``
+  (``distributed.resilience.Heartbeat`` is the production
+  implementation: survivors learn of a dead shard in
+  ``interval x miss`` seconds). The runner polls it at every chunk
+  boundary (the ``stage_hook`` seam) and raises
+  :class:`ShardDeadError` BEFORE dispatching into a broken mesh.
+* **per-chunk rollback buffer** — a memory-only
+  :class:`~..recovery.checkpoint.ChunkCheckpointer` (``mem_every=1``)
+  snapshots the boundary state after every chunk, so the roll-back
+  target is always the LAST ACKED chunk boundary.
+* **rebuild + deterministic replay** — on a death the runner computes
+  the epoch's REMAINING seeds by replaying the seed-matrix math on the
+  host (``storage.planner.replay_seed_matrix`` — threefry is
+  bit-identical across backends, the same property the prefetch
+  planner trusts), calls the caller's ``rebuild(remaining_seeds,
+  num_survivors)`` factory — which re-partitions the data, rebuilds
+  the mesh and the cached feature stores (the rebuild-on-failover
+  contract, docs/feature_cache.md) — and replays forward from the
+  rollback state. Every seed of the original epoch is trained EXACTLY
+  ONCE across the segments (chaos-tested).
+
+The ``loader.failover`` span carries the ROLLED-BACK CHUNK INDEX,
+the dead rank and the survivor count, and parents the replacement
+epoch's ``epoch.run`` span — one joinable tree for the degraded epoch,
+orphan-free (docs/observability.md). The aborted attempt's own flight
+record lands ``completed=False`` with the step it reached (the
+trainers' bracket), and ``recovery.roll_back`` is the fault site the
+chaos suite arms against the rollback path itself.
+"""
+import logging
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .. import metrics
+from ..metrics import spans
+from ..utils.faults import fault_point
+from .checkpoint import ChunkCheckpointer
+
+logger = logging.getLogger('graphlearn_tpu.recovery')
+
+
+class ShardDeadError(RuntimeError):
+  """A mesh shard was declared dead at a chunk boundary.
+
+  Carries the rank, the liveness cause, and the index of the next
+  chunk that was ABOUT to dispatch (everything before it is acked)."""
+
+  def __init__(self, rank: int, cause: str = '', chunk: int = 0):
+    super().__init__(f'mesh shard rank {rank} declared dead at chunk '
+                     f'{chunk}' + (f': {cause}' if cause else ''))
+    self.rank = rank
+    self.cause = cause
+    self.chunk = chunk
+
+
+def remaining_seeds(trainer, boundary_step: int) -> np.ndarray:
+  """The epoch-ordered seeds NOT yet consumed at ``boundary_step``
+  (a chunk boundary) of ``trainer``'s CURRENT epoch — replayed on the
+  host from the same permutation stream the device seed program draws
+  (``trainer._epochs`` is un-advanced while the epoch is in flight,
+  so the fold_in index is the aborted epoch's)."""
+  import jax
+
+  from ..storage import planner
+  loader = trainer.loader
+  full_steps = len(loader)
+  perm_key = jax.random.fold_in(trainer._perm_key, trainer._epochs)
+  seed_mat, mask_mat = planner.replay_seed_matrix(
+      np.asarray(loader.input_seeds), perm_key, full_steps,
+      trainer._batch_size, loader.shuffle, nparts=trainer._nparts)
+  # [P, steps, B] -> epoch order [steps, P, B]; pad slots (cyclic tail)
+  # are masked invalid and drop out, so every seed appears exactly once
+  sm = seed_mat.transpose(1, 0, 2)[boundary_step:]
+  mm = mask_mat.transpose(1, 0, 2)[boundary_step:]
+  return np.asarray(sm[mm], dtype=np.int64)
+
+
+class FailoverRunner:
+  """Run one DistScanTrainer epoch with chunk-granular failover.
+
+  Args:
+    trainer: the initial ``loader.DistScanTrainer`` over the full mesh.
+    rebuild: ``rebuild(remaining_seeds, num_survivors) -> trainer`` —
+      builds a replacement DistScanTrainer over the surviving shard
+      count whose loader iterates EXACTLY ``remaining_seeds`` with
+      ``shuffle=False`` (the runner hands seeds already in epoch
+      order; a reshuffle would double/drop seeds). The factory owns
+      re-partitioning and store rebuilds.
+    liveness: object with ``dead_ranks() -> {rank: cause}`` (e.g. a
+      started ``resilience.Heartbeat``); polled at every chunk
+      boundary. None disables detection (the runner then only reacts
+      to a ShardDeadError raised by a hook).
+    max_failovers: deaths tolerated in one epoch before giving up
+      (the original error re-raises).
+
+  Usage::
+
+      hb = Heartbeat(range(P), probe_fn, interval=1.0); hb.start()
+      runner = FailoverRunner(trainer, rebuild, liveness=hb)
+      state, losses, accs, report = runner.run_epoch(state)
+  """
+
+  def __init__(self, trainer, rebuild: Callable[[np.ndarray, int], Any],
+               liveness=None, max_failovers: int = 1):
+    self.trainer = trainer
+    self.rebuild = rebuild
+    self.liveness = liveness
+    self.max_failovers = int(max_failovers)
+
+  def _install_liveness_hook(self, trainer):
+    prev = trainer.stage_hook
+    liveness = self.liveness
+    handled = self._handled
+
+    def hook(c, start, k):
+      if prev is not None:
+        prev(c, start, k)
+      if liveness is not None:
+        for rank, cause in liveness.dead_ranks().items():
+          if rank not in handled:
+            raise ShardDeadError(rank, cause, chunk=c)
+
+    trainer.stage_hook = hook
+    return prev
+
+  def run_epoch(self, state, max_steps: Optional[int] = None):
+    """One failure-tolerant epoch. Returns ``(state, losses, accs,
+    report)``: losses/accs are HOST float arrays over every optimizer
+    step actually taken (completed-chunk prefix + replayed remainder —
+    step COUNT can differ from the undisturbed epoch when the batch
+    grid re-slices over fewer shards, seed coverage cannot), and
+    ``report`` records the failovers (rank, cause, rolled_back_chunk,
+    survivors) plus per-segment step counts."""
+    if max_steps is not None:
+      raise ValueError('FailoverRunner covers full epochs: max_steps '
+                       'would make "remaining seeds" ambiguous across '
+                       'failover segments')
+    trainer = self.trainer
+    self._handled: set = set()
+    survivors = trainer._nparts
+    losses_parts: List[np.ndarray] = []
+    accs_parts: List[np.ndarray] = []
+    report = dict(failovers=[], segments=[])
+    open_spans = []
+    failures = 0
+    state_in = state
+    ovf0 = False
+    try:
+      while True:
+        ckpt = ChunkCheckpointer(None, every=1, mem_every=1)
+        prev_stage = self._install_liveness_hook(trainer)
+        ckpt.attach(trainer)
+        try:
+          # a shard already dead at epoch start: its whole share fails
+          # over before anything dispatches (PR 2's epoch-start path)
+          if self.liveness is not None:
+            for rank, cause in self.liveness.dead_ranks().items():
+              if rank not in self._handled:
+                raise ShardDeadError(rank, cause, chunk=0)
+          state_out, losses, accs = trainer.run_epoch(
+              state_in, resume_overflow=ovf0)
+          losses_parts.append(np.asarray(losses))
+          accs_parts.append(np.asarray(accs))
+          report['segments'].append(
+              dict(num_parts=trainer._nparts,
+                   steps=int(np.asarray(losses).shape[0])))
+          return (state_out, np.concatenate(losses_parts),
+                  np.concatenate(accs_parts), report)
+        except ShardDeadError as e:
+          failures += 1
+          self._handled.add(e.rank)
+          if failures > self.max_failovers:
+            raise
+          fault_point('recovery.roll_back')
+          metrics.inc('recovery.rollbacks')
+          rolled = ckpt.latest_mem
+          boundary = (int(rolled['meta']['next_start'])
+                      if rolled is not None else 0)
+          k = trainer.chunk_size
+          fo_span = spans.begin('loader.failover', rank=e.rank,
+                                cause=str(e.cause)[:200],
+                                rolled_back_chunk=boundary // k,
+                                detected_chunk=e.chunk,
+                                survivors=survivors - 1)
+          open_spans.append(fo_span)
+          logger.warning(
+              'shard rank %d died (%s): rolling back to chunk '
+              'boundary %d (step %d) and re-slicing over %d survivors',
+              e.rank, e.cause, boundary // k, boundary, survivors - 1)
+          rem = remaining_seeds(trainer, boundary)
+          if rolled is not None:
+            losses_parts.append(np.asarray(rolled['losses']))
+            accs_parts.append(np.asarray(rolled['accs']))
+            state_in = rolled['state']
+            ovf0 = bool(rolled['meta']['overflow'])
+          report['segments'].append(
+              dict(num_parts=trainer._nparts, steps=boundary))
+          report['failovers'].append(
+              dict(rank=e.rank, cause=str(e.cause)[:200],
+                   rolled_back_chunk=boundary // k,
+                   detected_chunk=e.chunk, remaining_seeds=len(rem),
+                   survivors=survivors - 1))
+          survivors -= 1
+          if survivors < 1:
+            raise
+        finally:
+          ckpt.detach()
+          trainer.stage_hook = prev_stage
+        # rebuild OUTSIDE the hook bracket: the replacement trainer's
+        # epoch.run span parents under the open loader.failover span
+        trainer = self.rebuild(rem, survivors)
+        if trainer.loader.shuffle:
+          raise ValueError('rebuild() must return a shuffle=False '
+                           'loader over the seeds it was handed — a '
+                           'reshuffle would break exact-once coverage')
+    finally:
+      for sp in reversed(open_spans):
+        spans.end(sp)

@@ -1,0 +1,177 @@
+"""Atomic, torn-proof chunk-boundary snapshots (the recovery substrate).
+
+One snapshot file captures everything the counter-addressed PRNG
+contract does NOT replay for free: the train-state pytree leaves, the
+per-step losses/accs already produced, the epoch/chunk position, the
+sampler's ``state_dict`` (base key + ``call_count``), the overflow
+flag, and per-trainer extras (DistScanTrainer feature-cache stats rows,
+TieredScanTrainer staging watermarks). Everything else — the seed
+permutation, every per-step sampling draw, the exact chunk boundaries —
+is a pure function of that state (the PR 1/4 replay contracts), which
+is what keeps the snapshot TINY and the resume EXACT
+(docs/recovery.md).
+
+File format (single self-validating file)::
+
+    MAGIC 'GLTCKPT1' | u32be header_len | header JSON | npz payload
+
+The header carries the payload's byte length and sha256, so a torn
+write — a crash mid-``write()``, a truncated copy, a partial disk —
+is always DETECTED (:class:`TornSnapshotError`), never silently
+restored. Writes are atomic by construction: the bytes are assembled
+in memory, written to a same-directory temp file, fsync'd, and
+``os.replace``'d onto the final name (then the directory entry is
+fsync'd), so a crash at ANY point leaves either the previous snapshot
+or the new one — never a half file under the final name. The
+``recovery.save`` / ``recovery.restore`` fault sites
+(docs/failure_model.md) arm the chaos suite's writer-death and
+restore-under-fault scenarios.
+"""
+import hashlib
+import io
+import json
+import os
+import re
+import struct
+import tempfile
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.checkpoint import _dejsonify, _jsonify
+from ..utils.faults import fault_point
+
+MAGIC = b'GLTCKPT1'
+_NAME_RE = re.compile(r'^ckpt-(\d+)-(\d+)\.glt$')
+
+
+class TornSnapshotError(RuntimeError):
+  """A snapshot file failed its integrity check (truncated header,
+  payload length or sha256 mismatch) — the restore path skips it and
+  falls back to the previous snapshot."""
+
+
+@dataclass
+class Snapshot:
+  """A loaded (validated) snapshot: JSON meta + named numpy arrays."""
+  meta: dict
+  arrays: Dict[str, np.ndarray]
+  path: Optional[str] = None
+
+  @property
+  def epoch(self) -> int:
+    return int(self.meta['epoch'])
+
+  @property
+  def next_start(self) -> int:
+    """First step NOT yet covered by this snapshot (the resume point,
+    a chunk boundary by construction)."""
+    return int(self.meta['next_start'])
+
+
+def snapshot_path(directory: str, epoch: int, next_start: int) -> str:
+  return os.path.join(directory, f'ckpt-{epoch:06d}-{next_start:06d}.glt')
+
+
+def encode(meta: dict, arrays: Dict[str, np.ndarray]) -> bytes:
+  """Serialize to the self-validating byte layout (pure, for tests)."""
+  buf = io.BytesIO()
+  np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+  payload = buf.getvalue()
+  header = json.dumps({
+      'meta': _jsonify(meta),
+      'payload_bytes': len(payload),
+      'payload_sha256': hashlib.sha256(payload).hexdigest(),
+  }, sort_keys=True).encode()
+  return MAGIC + struct.pack('>I', len(header)) + header + payload
+
+
+def decode(blob: bytes, label: str = 'snapshot') -> Snapshot:
+  """Parse + integrity-check one encoded snapshot. Raises
+  :class:`TornSnapshotError` on ANY mismatch — a torn file must never
+  restore as a shorter-but-plausible state."""
+  if len(blob) < len(MAGIC) + 4 or blob[:len(MAGIC)] != MAGIC:
+    raise TornSnapshotError(f'{label}: bad magic or truncated prologue')
+  (hlen,) = struct.unpack('>I', blob[len(MAGIC):len(MAGIC) + 4])
+  hstart = len(MAGIC) + 4
+  if len(blob) < hstart + hlen:
+    raise TornSnapshotError(f'{label}: truncated header '
+                            f'({len(blob) - hstart} of {hlen} bytes)')
+  try:
+    header = json.loads(blob[hstart:hstart + hlen])
+  except ValueError as e:
+    raise TornSnapshotError(f'{label}: unparseable header: {e}') from e
+  payload = blob[hstart + hlen:]
+  want = int(header.get('payload_bytes', -1))
+  if len(payload) != want:
+    raise TornSnapshotError(
+        f'{label}: payload is {len(payload)} bytes, header says {want}')
+  sha = hashlib.sha256(payload).hexdigest()
+  if sha != header.get('payload_sha256'):
+    raise TornSnapshotError(f'{label}: payload sha256 mismatch')
+  with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+    arrays = {k: z[k] for k in z.files}
+  return Snapshot(meta=_dejsonify(header['meta']), arrays=arrays)
+
+
+def write_snapshot(directory: str, meta: dict,
+                   arrays: Dict[str, np.ndarray]) -> Tuple[str, int]:
+  """Atomically write one snapshot; returns ``(path, bytes)``. The
+  ``recovery.save`` fault site sits here — BOTH the async writer thread
+  and the degraded synchronous path funnel through this one function."""
+  fault_point('recovery.save')
+  os.makedirs(directory, exist_ok=True)
+  blob = encode(meta, arrays)
+  path = snapshot_path(directory, int(meta['epoch']),
+                       int(meta['next_start']))
+  fd, tmp = tempfile.mkstemp(prefix='.ckpt-', suffix='.tmp',
+                             dir=directory)
+  try:
+    with os.fdopen(fd, 'wb') as fh:
+      fh.write(blob)
+      fh.flush()
+      os.fsync(fh.fileno())
+    os.replace(tmp, path)
+  except BaseException:
+    try:
+      os.unlink(tmp)
+    except OSError:
+      pass
+    raise
+  # fsync the directory entry so the rename itself is durable
+  try:
+    dfd = os.open(directory, os.O_RDONLY)
+    try:
+      os.fsync(dfd)
+    finally:
+      os.close(dfd)
+  except OSError:
+    pass   # platform without directory fsync: the rename is still atomic
+  return path, len(blob)
+
+
+def load_snapshot(path: str) -> Snapshot:
+  """Read + validate one snapshot file. The ``recovery.restore`` fault
+  site arms the restore-under-fault chaos scenario."""
+  fault_point('recovery.restore')
+  with open(path, 'rb') as fh:
+    blob = fh.read()
+  snap = decode(blob, label=os.path.basename(path))
+  snap.path = path
+  return snap
+
+
+def list_snapshots(directory: str) -> List[Tuple[int, int, str]]:
+  """``(epoch, next_start, path)`` for every snapshot file, sorted
+  oldest -> newest by (epoch, next_start)."""
+  if not os.path.isdir(directory):
+    return []
+  out = []
+  for name in os.listdir(directory):
+    m = _NAME_RE.match(name)
+    if m:
+      out.append((int(m.group(1)), int(m.group(2)),
+                  os.path.join(directory, name)))
+  out.sort()
+  return out

@@ -18,10 +18,12 @@ definition training optimizes, so trained and served models cannot
 drift.
 """
 from .engine import DEFAULT_BUCKETS, ServingEngine
-from .materialize import EmbeddingMaterializer, padded_neighbors
+from .materialize import (EmbeddingMaterializer, padded_neighbors,
+                          warm_embedding_store)
 from .store import DistEmbeddingStore, EmbeddingStore
 
 __all__ = [
     'DEFAULT_BUCKETS', 'DistEmbeddingStore', 'EmbeddingMaterializer',
     'EmbeddingStore', 'ServingEngine', 'padded_neighbors',
+    'warm_embedding_store',
 ]

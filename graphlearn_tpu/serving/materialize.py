@@ -703,3 +703,66 @@ class EmbeddingMaterializer:
         self.params, self._penultimate, self._upload()['nbr'],
         jnp.asarray(padded), jnp.asarray(mask))
     return np.asarray(rows)[:ids.size]
+
+
+def warm_embedding_store(spill_dir: str, num_nodes: int, *,
+                         hot_rows: Optional[int] = None,
+                         warm_rows: int = 0,
+                         pass_label: Optional[str] = None):
+  """Engine RESTART path: rebuild a serving store from the spilled
+  (checkpointed) final-layer tier on disk, WITHOUT rematerializing.
+
+  ``EmbeddingMaterializer(..., spill_dir=...)`` writes every completed
+  layer pass as an immutable memory-mapped disk tier — a durable
+  checkpoint of the store version that was serving. After an engine
+  crash or rolling restart, this reopens that version and serves it
+  immediately (seconds, not a full O(L) rematerialization); the next
+  scheduled rematerialize-and-rotate then replaces it as usual
+  (docs/recovery.md, docs/serving.md).
+
+  Args:
+    spill_dir: the materializer's spill directory.
+    num_nodes: REAL node count (the spilled table carries block-pad
+      rows; they must stay behind the engine's id validation — the
+      same footgun :meth:`EmbeddingMaterializer.embedding_store`
+      guards).
+    hot_rows: None -> load the whole table to HBM (a plain
+      ``EmbeddingStore``); otherwise serve beyond-HBM through a
+      ``TieredEmbeddingStore`` with this hot prefix (+ ``warm_rows``
+      in host RAM).
+    pass_label: which spilled pass to serve (default: the
+      highest-numbered ``pass_<n>`` — the final layer).
+  """
+  import os
+  import re
+
+  from ..storage.disk import DiskTier
+  from ..storage.tiered import TieredFeature
+  from .store import EmbeddingStore, TieredEmbeddingStore
+  if pass_label is None:
+    passes = sorted(
+        (int(m.group(1)) for m in
+         (re.match(r'^pass_(\d+)$', d) for d in os.listdir(spill_dir))
+         if m))
+    if not passes:
+      spilled = sorted(d for d in os.listdir(spill_dir)
+                       if d.startswith('pass_'))
+      raise FileNotFoundError(
+          f'no numeric pass_<n> tier under {spill_dir!r} '
+          f'(found: {spilled or "nothing"}) — either the materializer '
+          'ran without spill_dir, or this is a HETERO spill (per-type '
+          "labels like 'head/paper'): pass the pass_label of the store "
+          'you serve explicitly')
+    pass_label = str(passes[-1])
+  # the materializer sanitizes labels on spill ('/'/' ' -> '_',
+  # _spill_pass) — apply the same mapping so hetero labels round-trip
+  safe = str(pass_label).replace('/', '_').replace(' ', '_')
+  tier = DiskTier(os.path.join(spill_dir, f'pass_{safe}'))
+  if num_nodes > tier.shape[0]:
+    raise ValueError(f'num_nodes={num_nodes} exceeds the spilled '
+                     f'table height {tier.shape[0]}')
+  if hot_rows is None:
+    table = tier.gather(np.arange(tier.shape[0], dtype=np.int64))
+    return EmbeddingStore(table, num_nodes=num_nodes)
+  tf = TieredFeature(tier, hot_rows=hot_rows, warm_rows=warm_rows)
+  return TieredEmbeddingStore(tf, num_nodes=num_nodes)

@@ -168,11 +168,16 @@ class TieredScanTrainer(ScanTrainer):
 
   # ------------------------------------------------------------- epoch
 
-  def _run_epoch_body(self, state, steps, full_steps):
+  def _run_epoch_body(self, state, steps, full_steps, start_step=0,
+                      resume_overflow=False):
     """The tiered epoch program: fused plan prologue (one dispatch, one
     explicit fetch) + staged chunk loop. Budget: 1 epoch_seeds +
     ceil(steps/K) scan_chunk + 1 metrics_concat = ceil(steps/K) + 2 —
-    unchanged from the all-HBM trainer."""
+    unchanged from the all-HBM trainer. A mid-epoch resume
+    (``start_step`` — recovery/checkpoint.py) re-runs the SAME plan
+    prologue (the permutation and sampler streams replay exactly) and
+    begins staging at the resume chunk; consumed chunks never stage
+    again."""
     import jax
     if self._seeds_dev is None:
       self._seeds_dev = jax.device_put(
@@ -181,9 +186,9 @@ class TieredScanTrainer(ScanTrainer):
     fargs = self._sampler._fused_args()
     base_key = self._sampler._key
     count0 = jax.device_put(np.int32(self._sampler._call_count + 1))
-    ovf = jax.device_put(np.zeros((), bool))
+    ovf = jax.device_put(np.asarray(bool(resume_overflow)))
     losses, accs = [], []
-    start = 0
+    start = start_step
     hot = self._feats
     with strict_guards():
       record_dispatch('epoch_seeds')
@@ -197,10 +202,13 @@ class TieredScanTrainer(ScanTrainer):
                                     self._store.hot_rows,
                                     self._store.warm_rows)
       self.last_plan = plan
-      self._stager.begin_epoch(plan.chunk_rows)
+      self._stager.begin_epoch(plan.chunk_rows,
+                               start_chunk=start // self.chunk_size)
       while start < steps:
         k = min(self.chunk_size, steps - start)
         c = start // self.chunk_size
+        if self.stage_hook is not None:
+          self.stage_hook(c, start, k)
         slab_ids_np, slab_np = self._stager.take(c)
         slab_ids = jax.device_put(slab_ids_np)
         slab = jax.device_put(slab_np)
@@ -215,8 +223,16 @@ class TieredScanTrainer(ScanTrainer):
         self._stager.ack(c)
         losses.append(loss_k)
         accs.append(acc_k)
+        self._steps_dispatched = start + k
+        if self.ack_hook is not None:
+          # the generic chunk-boundary seam (recovery/checkpoint.py
+          # rides it) — same carry contract as ScanTrainer
+          self._chunk_carry = dict(state=state, ovf=ovf, losses=losses,
+                                   accs=accs, steps=steps,
+                                   full_steps=full_steps,
+                                   start_step=start_step)
+          self.ack_hook(c, start, k)
         start += k
-        self._steps_dispatched = start
       if len(losses) > 1:
         record_dispatch('metrics_concat')
         losses, accs = self._concat_fn(losses, accs)
@@ -232,6 +248,15 @@ class TieredScanTrainer(ScanTrainer):
                warm_rows=self._store.warm_rows,
                disk_rows=self._store.disk_rows)
     return cfg
+
+  def _recovery_capture(self, carry):
+    """ScanTrainer's capture plus the staging-ring watermarks — a
+    postmortem can see how deep the prefetch pipeline was at the
+    boundary (resume re-plans and re-stages; the watermarks are
+    diagnostic, not replayed state)."""
+    meta, dev = super()._recovery_capture(carry)
+    meta['staging'] = self._stager.watermarks()
+    return meta, dev
 
   def close(self):
     """Stop the staging worker thread."""

@@ -105,20 +105,36 @@ class ChunkStager:
 
   # ------------------------------------------------------------ lifecycle
 
-  def begin_epoch(self, chunk_rows: List[np.ndarray]):
+  def begin_epoch(self, chunk_rows: List[np.ndarray],
+                  start_chunk: int = 0):
     """Install this epoch's plan (per-chunk sorted absolute storage
     rows beyond the hot tier) and prime the first ``max_ahead`` slabs.
-    Any previous epoch's outstanding slabs are dropped."""
+    Any previous epoch's outstanding slabs are dropped. A mid-epoch
+    RESUME (recovery/checkpoint.py) passes ``start_chunk``: the plan
+    keeps its absolute chunk indexing and staging starts at that
+    chunk — earlier chunks were consumed before the crash and are
+    never staged again."""
+    if not 0 <= start_chunk <= len(chunk_rows):
+      raise ValueError(f'start_chunk={start_chunk} outside the '
+                       f'{len(chunk_rows)}-chunk plan')
     with self._lock:
       self._plan = list(chunk_rows)
       self._slabs = {}
-      self._next_submit = 0
+      self._next_submit = int(start_chunk)
       self.degraded = False
       self.stage_done_t = {}
       self.ack_t = {}
     self._ensure_worker()
-    for _ in range(min(self.max_ahead, len(self._plan))):
+    for _ in range(min(self.max_ahead,
+                       len(self._plan) - int(start_chunk))):
       self._submit_next()
+
+  def watermarks(self) -> Dict[str, int]:
+    """Ring position snapshot for checkpoint metadata: the next chunk
+    the worker will be asked to stage and the slabs currently held."""
+    with self._lock:
+      return dict(next_submit=int(self._next_submit),
+                  held=len(self._slabs), planned=len(self._plan))
 
   def close(self):
     self._stop = True

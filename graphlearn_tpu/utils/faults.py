@@ -35,10 +35,13 @@ Every firing increments the ``fault.<name>`` trace counter
 (utils/trace.py), so chaos tests can assert a fault actually fired.
 """
 import builtins
+import logging
 import os
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
+
+logger = logging.getLogger('graphlearn_tpu.faults')
 
 _ENV_VAR = 'GLT_FAULTS'
 
@@ -59,6 +62,9 @@ REGISTERED_SITES = frozenset({
     'heartbeat.probe',
     'storage.stage',
     'storage.promote',
+    'recovery.save',
+    'recovery.restore',
+    'recovery.roll_back',
 })
 
 
@@ -160,6 +166,10 @@ def env_spec(*specs: str) -> Dict[str, str]:
 
 
 def _parse_env(spec: str):
+  """Parse a GLT_FAULTS spec into faults, then arm them all. The parse
+  happens FIRST: a malformed later item must not leave a partial
+  arming behind (raises before any arm)."""
+  parsed = []
   for item in spec.split(';'):
     item = item.strip()
     if not item:
@@ -169,7 +179,9 @@ def _parse_env(spec: str):
     kwargs = {}
     if len(parts) > 2 and parts[2]:
       for kv in parts[2].split(','):
-        k, v = kv.split('=', 1)
+        k, sep, v = kv.partition('=')
+        if not sep:
+          raise ValueError(f'GLT_FAULTS: malformed key=val {kv!r}')
         if k in ('times', 'after', 'code'):
           kwargs[k] = int(v)
         elif k == 'delay':
@@ -182,9 +194,25 @@ def _parse_env(spec: str):
           kwargs['exc'] = exc
         else:
           raise ValueError(f'GLT_FAULTS: unknown key {k!r}')
-    arm(name, kind, **kwargs)
+    parsed.append(_Fault(name, kind, **kwargs))   # validates kind
+  for f in parsed:
+    _active[f.name] = f
 
 
-_env = os.environ.get(_ENV_VAR)
-if _env:
-  _parse_env(_env)
+def load_env(spec: Optional[str]) -> bool:
+  """Arm faults from a GLT_FAULTS-grammar spec, tolerating garbage: a
+  malformed value WARNS and arms nothing (observability/chaos tooling
+  must never crash the worker import it rides in on — the PR 8
+  GLT_SPAN_BUFFER discipline). Returns True when the spec armed."""
+  if not spec:
+    return False
+  try:
+    _parse_env(spec)
+    return True
+  except (ValueError, TypeError) as e:
+    logger.warning('%s=%r is malformed (%s) — no faults armed; see the '
+                   'grammar in utils/faults.py', _ENV_VAR, spec, e)
+    return False
+
+
+load_env(os.environ.get(_ENV_VAR))

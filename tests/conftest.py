@@ -44,7 +44,23 @@ import pytest
 # thread. Override per test with @pytest.mark.timeout(seconds).
 # Posix-only and main-thread-only — exactly where pytest runs test code.
 
-_DEFAULT_TIMEOUT = int(os.environ.get('GLT_TEST_TIMEOUT', '300'))
+def _parse_timeout(raw, default=300):
+  """Hardened GLT_TEST_TIMEOUT parse: a malformed value must warn and
+  fall back, never crash collection of the whole suite (the same
+  discipline as GLT_SPAN_BUFFER / GLT_HEARTBEAT_INTERVAL — regression-
+  tested in tests/test_recovery.py)."""
+  if raw in (None, ''):
+    return default
+  try:
+    return int(raw)
+  except (TypeError, ValueError):
+    import warnings
+    warnings.warn(f'GLT_TEST_TIMEOUT={raw!r} is not an integer — '
+                  f'using the default {default}s')
+    return default
+
+
+_DEFAULT_TIMEOUT = _parse_timeout(os.environ.get('GLT_TEST_TIMEOUT'))
 
 
 class TestDeadlineError(Exception):
@@ -112,7 +128,7 @@ def rng():
 # to debug a failure with the guards off.
 
 _STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
-                   'test_serving', 'test_storage')
+                   'test_serving', 'test_storage', 'test_recovery')
 
 
 @pytest.fixture(autouse=True)
