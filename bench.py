@@ -433,6 +433,19 @@ BENCH_KEY_REGISTRY = {
     'recovery_overhead_pct': 'checkpointed vs plain scanned epoch wall '
                              'overhead, % (default cadence; gate <5%)',
     'recovery_config': 'graph/cadence/kill shape of the recovery figures',
+    # chunk-staged remote scan (distributed/remote_scan.py,
+    # docs/remote_scan.md): a server-client epoch over K-batch blocks
+    # vs the collocated DistScanTrainer epoch at the same scale — the
+    # decoupled-topology-at-scanned-speed gate (CPU replica here; the
+    # on-chip figures land with the TPU relay)
+    'remote_scan_epoch_wall_s': 'chunk-staged remote epoch wall s',
+    'remote_scan_epoch_dispatches': 'client dispatches for that epoch '
+                                    '(pin: ceil(steps/K) + 2)',
+    'remote_block_stage_ms_p99': 'remote.block_stage_ms p99 — block '
+                                 'staging latency ahead of the scan',
+    'remote_vs_collocated_ratio': 'remote / collocated scanned epoch '
+                                  'wall (gate: ~1.3x)',
+    'remote_scan_config': 'graph/block/server shape of the figures',
     # serving tier (PR 7): offline materialization + online endpoint
     'embed_epoch_wall_s': 'full-graph layer-wise materialization wall s',
     'embed_epoch_dispatches': 'materialization dispatches, all layers',
@@ -462,7 +475,7 @@ BENCH_KEY_REGISTRY = {
 BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
     'hetero_step', 'hetero_ref', 'feature_exchange', 'serving',
-    'oversub', 'recovery',
+    'oversub', 'recovery', 'remote_scan',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -490,6 +503,9 @@ BENCH_LOWER_IS_BETTER = frozenset({
     # a checkpoint that gets expensive (bytes) or taxing (overhead)
     # regresses silently otherwise — the issue's gate pair
     'checkpoint_bytes', 'recovery_overhead_pct',
+    # the chunk-staged remote gate pair: the remote/collocated wall
+    # ratio and the block staging latency ahead of the scan
+    'remote_vs_collocated_ratio', 'remote_block_stage_ms_p99',
     'serving_p50_ms', 'serving_p99_ms',
     'hetero_rgnn_step_ms_bf16', 'hetero_rgnn_train_program_ms',
     'hetero_rgat_step_ms_bf16', 'hetero_rgat_train_program_ms',
@@ -1367,6 +1383,124 @@ def main():
   except Exception as e:
     result['recovery_overhead_pct'] = None
     result['recovery_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- chunk-staged remote scan (distributed/remote_scan.py) ----
+  # The decoupled-topology gate (docs/remote_scan.md): a server-client
+  # epoch over K-batch blocks (in-process RPC server — a CPU replica
+  # of the sampling cluster) vs the collocated DistScanTrainer epoch
+  # at the same scale: same seeds-per-step grid, fanouts, feature
+  # width and model. Both walls time a WARMED epoch (compiles
+  # amortized — the steady-state production shape). Fetch-bearing on
+  # the server side only; the client epoch stays dispatch-clean.
+  try:
+    import jax.numpy as jnp
+    import optax
+    from benchmarks.bench_dist_loader import make_dist_fixture
+    from graphlearn_tpu import metrics as glt_metrics
+    from graphlearn_tpu.distributed import dist_client
+    from graphlearn_tpu.distributed.dist_server import DistServer
+    from graphlearn_tpu.distributed.rpc import RpcServer
+    from graphlearn_tpu.models import GraphSAGE as _RSAGE
+    from graphlearn_tpu.models import train as _rtrain
+    rs_n, rs_deg, rs_f = 100_000, 10, 32
+    rs_batch, rs_steps, rs_k, rs_classes = 256, 16, 4, 16
+    rs_fanouts = [10, 5]
+    rs_rng = np.random.default_rng(29)
+    rs_rows = rs_rng.integers(0, rs_n, rs_n * rs_deg)
+    rs_cols = rs_rng.integers(0, rs_n, rs_n * rs_deg)
+    rs_feat = rs_rng.standard_normal((rs_n, rs_f)).astype(np.float32)
+    rs_labels = rs_rng.integers(0, rs_classes, rs_n)
+    rs_seeds = rs_rng.integers(0, rs_n, rs_batch * rs_steps)
+
+    rs_ds = glt.data.Dataset()
+    rs_ds.init_graph(np.stack([rs_rows, rs_cols]), graph_mode='CPU',
+                     num_nodes=rs_n)
+    rs_ds.init_node_features(rs_feat)
+    rs_ds.init_node_labels(rs_labels)
+    rs_srv = DistServer(rs_ds)
+    rs_rpc = RpcServer(handlers={
+        'create_block_producer': rs_srv.create_block_producer,
+        'block_producer_num_batches': rs_srv.block_producer_num_batches,
+        'block_produce': rs_srv.block_produce,
+        'block_fetch': rs_srv.block_fetch,
+        'destroy_block_producer': rs_srv.destroy_block_producer,
+        'heartbeat': rs_srv.heartbeat,
+        'exit': rs_srv.exit})
+    dist_client.init_client(1, 1, 0, [(rs_rpc.host, rs_rpc.port)])
+    rs_trainer = None
+    try:
+      rs_model = _RSAGE(hidden_dim=64, out_dim=rs_classes, num_layers=2)
+      rs_tx = optax.adam(1e-3)
+      rs_loader = glt.loader.NeighborLoader(
+          rs_ds, rs_fanouts, rs_seeds, batch_size=rs_batch,
+          shuffle=False)
+      rs_template = _rtrain.batch_to_dict(next(iter(rs_loader)))
+      rs_state, _ = _rtrain.create_train_state(
+          rs_model, jax.random.PRNGKey(0), rs_template, optimizer=rs_tx)
+      rs_opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+          server_rank=0)
+      rs_trainer = glt.distributed.RemoteScanTrainer(
+          rs_fanouts, rs_seeds, rs_model, rs_tx, rs_classes,
+          batch_size=rs_batch, chunk_size=rs_k, worker_options=rs_opts,
+          seed=0)
+      rs_state, _, _ = rs_trainer.run_epoch(rs_state)     # warm epoch
+      glt_metrics.reset('remote.')
+      with glt.utils.count_dispatches() as rs_dc:
+        rs_t0 = time.perf_counter()
+        rs_state, rs_losses, _ = rs_trainer.run_epoch(rs_state)
+        np.asarray(rs_losses)                             # drain
+        rs_wall = time.perf_counter() - rs_t0
+    finally:
+      # shutdown BEFORE the client/server teardown, and also on a
+      # failed section: a leaked heartbeat/stager thread would probe a
+      # None client for the rest of the bench run
+      if rs_trainer is not None:
+        rs_trainer.shutdown()
+      dist_client._client.close()
+      dist_client._client = None
+      rs_srv.exit()
+      rs_rpc.shutdown()
+    result['remote_scan_epoch_wall_s'] = round(rs_wall, 3)
+    result['remote_scan_epoch_dispatches'] = sum(
+        v for s, v in rs_dc.counts.items() if s.startswith('remote_'))
+    pct = glt_metrics.histogram('remote.block_stage_ms').percentiles()
+    if pct.get('p99') is not None:
+      result['remote_block_stage_ms_p99'] = round(pct['p99'], 3)
+
+    # collocated DistScanTrainer at the same scale: dp_ shards whose
+    # per-shard batch keeps the global seeds-per-step grid equal
+    rs_p = min(8, max(1, len(jax.devices())))
+    while rs_batch % rs_p:
+      rs_p -= 1
+    _, rs_dds, rs_mesh = make_dist_fixture(
+        rs_rows, rs_cols, rs_n, rs_p, feat_dim=rs_f, split_ratio=0.2,
+        labels=rs_labels, feat_rng=rs_rng)
+    rs_dloader = glt.distributed.DistNeighborLoader(
+        rs_dds, rs_fanouts, rs_seeds, batch_size=rs_batch // rs_p,
+        shuffle=False, drop_last=True, seed=0, mesh=rs_mesh)
+    rs_dtrainer = glt.loader.DistScanTrainer(
+        rs_dloader, rs_model, rs_tx, rs_classes, chunk_size=rs_k)
+    rs_first = next(iter(rs_dloader))
+    rs_dparams = rs_model.init(jax.random.PRNGKey(0),
+                               np.asarray(rs_first.x)[0],
+                               np.asarray(rs_first.edge_index)[0],
+                               np.asarray(rs_first.edge_mask)[0])
+    rs_dstate = _rtrain.TrainState(rs_dparams, rs_tx.init(rs_dparams),
+                                   jnp.zeros((), jnp.int32))
+    rs_dstate, _, _ = rs_dtrainer.run_epoch(rs_dstate)    # warm epoch
+    rs_t0 = time.perf_counter()
+    rs_dstate, rs_dlosses, _ = rs_dtrainer.run_epoch(rs_dstate)
+    np.asarray(rs_dlosses)                                # drain
+    rs_dwall = time.perf_counter() - rs_t0
+    result['remote_vs_collocated_ratio'] = round(
+        rs_wall / max(rs_dwall, 1e-9), 3)
+    result['remote_scan_config'] = (
+        f'N={rs_n}, deg={rs_deg}, F={rs_f}, fanouts {rs_fanouts}, '
+        f'batch {rs_batch} x {rs_steps} steps, K={rs_k}; 1 in-proc '
+        f'server (CPU replica) vs collocated mesh P={rs_p}')
+  except Exception as e:
+    result['remote_scan_epoch_wall_s'] = None
+    result['remote_scan_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- serving tier (PR 7): offline materialization + online QPS ----
   # LAST measured section by design: the serving path fetches rows per

@@ -60,13 +60,15 @@ class Config:
   hot_sync_modules: Tuple[str, ...] = (
       'loader/scan_epoch.py', 'loader/pipeline.py',
       'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
+      'distributed/remote_scan.py', 'distributed/block_producer.py',
       'ops/', 'serving/', 'storage/', 'recovery/')
   # rule dispatch-instrumentation: modules whose jit entrypoints must
   # record dispatches (the dispatch-budget tests' instrumented surface)
   dispatch_modules: Tuple[str, ...] = (
       'loader/scan_epoch.py', 'loader/pipeline.py', 'loader/node_loader.py',
       'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
-      'distributed/dist_loader.py', 'sampler/neighbor_sampler.py',
+      'distributed/dist_loader.py', 'distributed/remote_scan.py',
+      'distributed/block_producer.py', 'sampler/neighbor_sampler.py',
       'data/unified_tensor.py', 'serving/', 'storage/', 'recovery/')
   # cross-module jit factories the per-module dataflow can't see: calls
   # to these names yield jitted callables (models/train.py builders)

@@ -16,6 +16,9 @@ from .dist_options import (CollocatedDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
 from .dist_sampling_producer import (DistCollocatedSamplingProducer,
                                      DistMpSamplingProducer)
+from .block_producer import (BlockSampleProducer, block_mb_per_chunk,
+                             stack_block_frames)
+from .remote_scan import RemoteBlockStager, RemoteScanTrainer
 from .dist_server import (DistServer, get_server, init_server,
                           wait_and_shutdown_server)
 from .dist_client import (async_request_server, init_client,

@@ -1,0 +1,293 @@
+"""Server-side K-batch block production for the chunk-staged remote scan.
+
+The per-batch server-client path (dist_server.py producers + the remote
+loaders) streams ONE SampleMessage per RPC poll — ≥2 dispatches plus
+host Python per training step on the client. The chunk-staged hybrid
+(distributed/remote_scan.py, docs/remote_scan.md) moves the unit of
+exchange to the K-batch BLOCK: the server replays the SAME
+counter-addressed sampler stream the mp worker path draws
+(``_sampling_worker_loop``: ``worker_seed = cfg.seed * 1000003 + rank``,
+one ``fold_in`` call per batch) and stacks K consecutive batches into
+one fixed-shape frame the client uploads once and trains as one scanned
+chunk program.
+
+Counter addressing is the whole design: batch ``j`` of epoch ``e`` uses
+sampler call index ``e * num_batches + j``, so block ``b`` of any epoch
+is a PURE FUNCTION of (seed share, sampling config, epoch, block index)
+— any server holding the share can produce it, which is what makes
+chunk-granular failover exact (a survivor re-replays a dead server's
+unfetched blocks bit-identically) and what makes a mid-epoch resume
+(recovery/checkpoint.py) need no server-side state beyond the share.
+
+Frame shapes are CLOSED by construction: the fused sampler pads every
+batch to its capacity plan (one shape per (batch_cap, fanouts)), so a
+stacked block is [k, cap, ...] with only the block length ``k`` varying
+(full blocks at K, one tail). Where raggedness does appear (defensive —
+a future typed producer), the staging-slab convention applies:
+pow2-padded leading axes with INT32_MAX pad ids
+(:func:`stack_block_frames`), so the client-side executable set stays
+closed.
+
+Wire dtype (the PR 3 convention, distributed/dist_feature.py): with
+``wire_dtype='bf16'`` the frame's feature payload ships at half width
+and the client's chunk program upcasts to f32 after device upload —
+~2x fewer block bytes, a precision delta only (bit-identity contracts
+hold at ``wire_dtype=None``).
+"""
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import spans
+from ..sampler import NodeSamplerInput, SamplingConfig, SamplingType
+from ..storage.staging import INT32_MAX, pow2_slab_cap
+from ..utils.faults import fault_point
+from .message import output_to_message
+
+#: wire-dtype spellings accepted over the RPC (strings travel cleanly;
+#: the jnp dtype object itself never crosses the wire)
+_BF16_NAMES = ('bf16', 'bfloat16')
+
+
+def _pad_pow2_axis0(arrs: List[np.ndarray]) -> List[np.ndarray]:
+  """Pad ragged leading axes to one pow2 cap — the staging-slab
+  convention (storage/staging.py): integer id slots pad with INT32_MAX
+  (no searchsorted/gather can match them), everything else with
+  zeros."""
+  cap = pow2_slab_cap(max(int(a.shape[0]) for a in arrs))
+  out = []
+  for a in arrs:
+    n = int(a.shape[0])
+    if n == cap:
+      out.append(a)
+      continue
+    pad_val = INT32_MAX if np.issubdtype(a.dtype, np.integer) else 0
+    padded = np.full((cap,) + a.shape[1:], pad_val, a.dtype)
+    padded[:n] = a
+    out.append(padded)
+  return out
+
+
+def stack_block_frames(msgs: List[dict]) -> Dict[str, np.ndarray]:
+  """Stack K per-batch SampleMessages into one block frame: every key
+  becomes ``[k, ...]``. Uniform shapes (the fused sampler's capacity
+  plan) stack directly; ragged leading axes pow2-pad per
+  :func:`_pad_pow2_axis0`; anything else is a closed-shape violation
+  and raises."""
+  frame: Dict[str, np.ndarray] = {}
+  for key in msgs[0]:
+    arrs = [np.asarray(m[key]) for m in msgs if key in m]
+    if len(arrs) != len(msgs):
+      continue   # key not present in every batch: not stackable
+    shapes = {a.shape for a in arrs}
+    if len(shapes) > 1:
+      trailing = {a.shape[1:] for a in arrs}
+      if len(trailing) > 1 or any(a.ndim == 0 for a in arrs):
+        raise ValueError(
+            f'block frame key {key!r} has non-uniform trailing shapes '
+            f'{sorted(shapes)} — the closed-shape contract '
+            '(docs/remote_scan.md) is broken')
+      arrs = _pad_pow2_axis0(arrs)
+    frame[key] = np.stack(arrs)
+  return frame
+
+
+def block_mb_per_chunk(k: int, node_cap: int, edge_cap: int,
+                       feat_dim: int, wire_dtype: Optional[str] = None,
+                       label_bytes: int = 4) -> float:
+  """Analytic block-frame MB for one K-batch chunk — the remote-scan
+  counterpart of ``dist_feature.feature_exchange_mb`` (same role: size
+  the wire before running it). Counts the payload the client uploads
+  (features + labels + edge lists + masks + seed counts); the ack-only
+  host keys ('batch', 'node') ride the frame too but never reach the
+  device."""
+  x_bytes = 2 if (wire_dtype or '').lower() in _BF16_NAMES else 4
+  per_batch = (node_cap * feat_dim * x_bytes      # x
+               + node_cap * label_bytes           # y
+               + edge_cap * (4 + 4 + 1)           # row + col + mask
+               + 8)                               # nseed/overflow scalars
+  return k * per_batch / 1e6
+
+
+class BlockSampleProducer:
+  """One server-side block stream: the chunk-staged path's producer.
+
+  Scope: homogeneous supervised NODE sampling (the fused-trainer scope
+  — loader/pipeline.py): typed/hetero seeds and link inputs are
+  rejected at construction, mirroring the chunk program's client-side
+  contract.
+
+  Args:
+    dataset: the server's Dataset (graph + features + labels).
+    sampler_input: seed share (array or NodeSamplerInput, untyped).
+    sampling_config: the client's SamplingConfig — ``seed`` must
+      already carry the per-server fold (``(seed or 0) * 7919 + i``,
+      exactly the per-batch remote loaders' convention) so the block
+      stream bit-matches the per-batch path's worker-0 stream.
+    wire_dtype: None (full-width f32 features) or 'bf16'/'bfloat16'.
+  """
+
+  def __init__(self, dataset, sampler_input,
+               sampling_config: SamplingConfig,
+               wire_dtype: Optional[str] = None):
+    import graphlearn_tpu as glt
+    cfg = sampling_config
+    if cfg.sampling_type != SamplingType.NODE:
+      raise ValueError('block producers cover NODE sampling only — '
+                       'link streams keep the per-batch path '
+                       '(docs/remote_scan.md)')
+    if isinstance(dataset.graph, dict):
+      raise ValueError('block producers are homogeneous-only (the '
+                       'chunk-staged trainer scope); hetero graphs '
+                       'keep the per-batch mp producers')
+    inp = NodeSamplerInput.cast(sampler_input)
+    if inp.input_type is not None:
+      raise ValueError('block producers take untyped seeds '
+                       '(homogeneous scope)')
+    if wire_dtype is not None and \
+        str(wire_dtype).lower() not in _BF16_NAMES:
+      raise ValueError(f'unknown wire_dtype {wire_dtype!r}; pass None '
+                       "or 'bf16'")
+    self.dataset = dataset
+    self.config = cfg
+    self.seeds = np.asarray(inp.node).reshape(-1)
+    self.wire_dtype = (str(wire_dtype).lower()
+                       if wire_dtype is not None else None)
+    # the mp worker-0 stream, exactly (_sampling_worker_loop): the
+    # per-batch path folds worker rank into the seed; blocks are a
+    # single-stream producer, so rank is 0 by construction
+    worker_seed = (0 if cfg.seed is None else cfg.seed) * 1000003 + 0
+    self._sampler = glt.sampler.NeighborSampler(
+        dataset.graph, cfg.num_neighbors, with_edge=cfg.with_edge,
+        with_weight=cfg.with_weight, edge_dir=cfg.edge_dir,
+        seed=worker_seed)
+    self._order_cache: Optional[tuple] = None   # (epoch, order)
+    self._frames: Dict[Tuple[int, int, int], dict] = {}
+    # two locks so the produce-ahead overlap is real: _cache_lock
+    # guards the frame dict only (a fetch that HITS the cache returns
+    # while a produce builds the next frame), _build_lock serializes
+    # the sampler's _call_count mutation across builder threads
+    self._cache_lock = threading.Lock()
+    self._build_lock = threading.Lock()
+
+  # --------------------------------------------------------- addressing
+
+  def num_batches(self) -> int:
+    """Batches per epoch of this stream — the per-batch producers'
+    ``num_expected`` for a single worker."""
+    n = self.seeds.shape[0]
+    bs = self.config.batch_size
+    return n // bs if self.config.drop_last else -(-n // bs)
+
+  def _epoch_order(self, epoch: int) -> np.ndarray:
+    """This epoch's seed order, memoized one epoch at a time (every
+    block of an epoch shares it — recomputing a large share's
+    permutation per block would be O(n * blocks)). shuffle=False is
+    the identity (the failover/bit-identity contract); shuffle=True
+    draws an EPOCH-ADDRESSED permutation (pure function of
+    (seed, epoch)) so a resume replays the same order — but the
+    per-batch path's stateful host rng draws a different stream, so
+    shuffle epochs trade the bit-identity-to-per-batch contract for
+    coverage-only equality."""
+    cached = self._order_cache
+    if cached is not None and cached[0] == epoch:
+      return cached[1]
+    n = self.seeds.shape[0]
+    if not self.config.shuffle:
+      order = np.arange(n)
+    else:
+      rng = np.random.default_rng(
+          ((self.config.seed or 0) + 1) * 2654435761 + epoch)
+      order = rng.permutation(n)
+    self._order_cache = (epoch, order)
+    return order
+
+  # --------------------------------------------------------- production
+
+  def _batch_message(self, order: np.ndarray, epoch: int, j: int) -> dict:
+    """Batch ``j`` of epoch ``epoch``: position the counter stream and
+    draw — ``_call_count`` is SET (not advanced) so any (epoch, batch)
+    is random-access, the property failover and resume rely on."""
+    bs = self.config.batch_size
+    idx = order[j * bs:(j + 1) * bs]
+    self._sampler._call_count = epoch * self.num_batches() + j
+    out = self._sampler.sample_from_nodes(
+        NodeSamplerInput(self.seeds[idx]), batch_cap=bs)
+    x = y = None
+    if self.config.collect_features and \
+        self.dataset.node_features is not None:
+      x = self.dataset.node_features.cpu_get(
+          np.maximum(np.asarray(out.node), 0))
+    if self.dataset.node_labels is not None:
+      labels = np.asarray(self.dataset.node_labels)
+      y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
+    return output_to_message(out, x, y)
+
+  def build_frame(self, epoch: int, start: int, k: int) -> dict:
+    """The block frame covering batches ``[start, start + k)`` of the
+    epoch order, stacked into ``[k, ...]`` arrays, train-side int
+    payloads narrowed to int32 (the x64-off client must not silently
+    downcast on upload) and the feature payload cast to the wire
+    dtype. Blocks are addressed by their FIRST BATCH index, so the
+    client's chunk size never has to be pinned server-side — a
+    ``max_steps``-shortened tail is just a shorter range."""
+    nb = self.num_batches()
+    if not (0 <= start and start + k <= nb and k >= 1):
+      raise ValueError(f'block [{start}, {start + k}) outside this '
+                       f"stream's {nb}-batch epoch")
+    with spans.span('remote.block_stage', epoch=int(epoch),
+                    start=int(start), k=int(k)):
+      fault_point('remote.block_stage')
+      order = self._epoch_order(epoch)
+      msgs = [self._batch_message(order, epoch, j)
+              for j in range(start, start + k)]
+      frame = stack_block_frames(msgs)
+    if 'y' in frame:
+      frame['y'] = frame['y'].astype(np.int32)
+    if self.wire_dtype is not None and 'x' in frame:
+      import ml_dtypes
+      frame['x'] = frame['x'].astype(ml_dtypes.bfloat16)
+    frame['#META.num_batches'] = np.asarray(len(msgs), np.int32)
+    return frame
+
+  # ------------------------------------------------------------- serving
+
+  def produce(self, epoch: int, start: int, k: int) -> bool:
+    """Stage block (epoch, start, k) into the frame cache — the server
+    half of the client's produce-ahead pipelining (the stager fires
+    this for block c+1 while fetching block c). The build runs OUTSIDE
+    the cache lock, so a concurrent cache-hit fetch is never blocked
+    behind it."""
+    key = (int(epoch), int(start), int(k))
+    with self._cache_lock:
+      if key in self._frames:
+        return True
+    with self._build_lock:
+      with self._cache_lock:      # a racing produce may have landed it
+        if key in self._frames:
+          return True
+      frame = self.build_frame(epoch, start, k)
+      with self._cache_lock:
+        self._frames[key] = frame
+    return True
+
+  def fetch(self, epoch: int, start: int, k: int) -> dict:
+    """The block frame, from cache (pop) or built on demand. Pure —
+    a retried fetch after a lost response rebuilds the identical
+    frame, so the RPC is safely idempotent. A cache-miss build waits
+    behind any in-flight produce (one sampler, one stream)."""
+    key = (int(epoch), int(start), int(k))
+    with self._cache_lock:
+      frame = self._frames.pop(key, None)
+    if frame is None:
+      with self._build_lock:
+        with self._cache_lock:    # the produce we waited on may have it
+          frame = self._frames.pop(key, None)
+        if frame is None:
+          frame = self.build_frame(epoch, start, k)
+    return frame
+
+  def cached_blocks(self) -> int:
+    with self._cache_lock:
+      return len(self._frames)

@@ -390,6 +390,13 @@ class _RemoteLoaderBase:
   once. The server's worker_key idempotent-producer mechanism makes the
   re-requests safe. Degradations are counted in utils/trace.py
   ('resilience.failover', 'resilience.server_dead').
+
+  This family is the PER-BATCH remote path (>= 2 RPC dispatches + host
+  Python per step). For supervised homogeneous node classification the
+  chunk-staged ``distributed.RemoteScanTrainer`` (docs/remote_scan.md)
+  runs the same server-client topology at scanned speed — K-batch
+  blocks, ceil(steps/K)+2 client dispatches, chunk-granular
+  ack/failover — and is bit-identical to this path at shuffle=False.
   """
 
   #: Node loaders ack received seeds from each batch's 'batch' ids and

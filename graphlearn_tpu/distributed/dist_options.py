@@ -52,6 +52,18 @@ class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
   link batches carry no seed provenance to ack). ``rpc_timeout`` doubles
   as the total-idle budget: an epoch that receives nothing for that
   long fails with a contextual QueueTimeoutError.
+
+  Chunk-staged scan tunables (``distributed.RemoteScanTrainer``,
+  docs/remote_scan.md): ``block_wire_dtype='bf16'`` ships block
+  feature payloads at half width (f32 upcast happens inside the chunk
+  program after device upload — ~2x fewer block bytes, a precision
+  delta only); ``block_ahead`` is the client prefetch depth (2 = the
+  classic double buffer: block c+1 stages while chunk c trains);
+  ``block_timeout`` bounds how long a chunk boundary waits for its
+  staged block before degrading to a synchronous fetch of the same
+  block. With ``failover`` on, a dead server's unfetched BLOCKS are
+  re-replayed by survivors from the same counter stream
+  (shuffle=False only).
   """
   server_rank: Optional[Union[int, List[int]]] = None
   buffer_size: Optional[Union[int, str]] = None
@@ -61,6 +73,9 @@ class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
   heartbeat_interval: float = 1.0
   heartbeat_miss: int = 3
   failover: bool = True
+  block_wire_dtype: Optional[str] = None
+  block_ahead: int = 2
+  block_timeout: float = 30.0
 
 
 AllDistSamplingWorkerOptions = Union[

@@ -36,6 +36,11 @@ class DistServer:
   def __init__(self, dataset, producer_ttl: Optional[float] = None):
     self.dataset = dataset
     self._producers: Dict[int, DistMpSamplingProducer] = {}
+    # chunk-staged block streams (distributed/block_producer.py,
+    # docs/remote_scan.md): pure counter-addressed replays — no
+    # subprocesses, no shm ring, so their lifecycle is just this dict
+    self._block_producers: Dict[int, object] = {}
+    self._block_key_to_id: Dict[str, int] = {}
     self._buffers: Dict[int, ShmChannel] = {}
     # per-producer fetch locks: destroy (client call OR idle reaper)
     # must not close a shm ring while a fetch thread is blocked inside
@@ -71,10 +76,15 @@ class DistServer:
     with self._lock:
       stale = [pid for pid, ts in self._last_active.items()
                if now - ts > self.producer_ttl]
+      stale_blocks = {pid for pid in stale
+                      if pid in self._block_producers}
     for pid in stale:
       from ..utils import trace
       trace.counter_inc('resilience.producer_reaped')
-      self.destroy_sampling_producer(pid)
+      if pid in stale_blocks:
+        self.destroy_block_producer(pid)
+      else:
+        self.destroy_sampling_producer(pid)
     return len(stale)
 
   # -- producer lifecycle (reference: dist_server.py:104-147) --------------
@@ -241,6 +251,85 @@ class DistServer:
         buf.close()
     return True
 
+  # -- chunk-staged block streams (distributed/block_producer.py;
+  # docs/remote_scan.md). Blocks are pure functions of (share, config,
+  # epoch, batch range), so every handler here is idempotent by
+  # construction and the client calls them with retry under the fault
+  # registry (docs/failure_model.md). ----------------------------------
+
+  def create_block_producer(self, seeds, sampling_config,
+                            wire_dtype: Optional[str] = None,
+                            worker_key: Optional[str] = None) -> int:
+    """Register a block stream over a seed share. ``worker_key`` dedups
+    re-creates (client retries, failover replay producers on
+    survivors) exactly like the sampling producers' key."""
+    import dataclasses
+
+    from .block_producer import BlockSampleProducer
+    with self._lock:
+      if worker_key is not None and worker_key in self._block_key_to_id:
+        pid = self._block_key_to_id[worker_key]
+        self._touch(pid)
+        return pid
+      pid = self._next_id
+      self._next_id += 1
+      # the server's dataset is the authority on edge orientation —
+      # same replace as create_sampling_producer
+      cfg = dataclasses.replace(sampling_config,
+                                edge_dir=self.dataset.edge_dir)
+      self._block_producers[pid] = BlockSampleProducer(
+          self.dataset, seeds, cfg, wire_dtype=wire_dtype)
+      self._touch(pid)
+      if worker_key is not None:
+        self._block_key_to_id[worker_key] = pid
+      return pid
+
+  def _live_block_producer(self, producer_id: int):
+    producer = self._block_producers.get(producer_id)
+    if producer is None:
+      raise RuntimeError(
+          f'block producer {producer_id} unknown on this server — it '
+          'was destroyed or idle-reaped (producer_ttl); recreate the '
+          'remote scan trainer to register a fresh stream')
+    return producer
+
+  def block_producer_num_batches(self, producer_id: int) -> int:
+    """Exact batches per epoch of this block stream (single stream —
+    the per-batch producers' num_expected analog)."""
+    with self._lock:
+      producer = self._live_block_producer(producer_id)
+      self._touch(producer_id)
+    return producer.num_batches()
+
+  def block_produce(self, producer_id: int, epoch: int, start: int,
+                    k: int) -> bool:
+    """Stage block (epoch, [start, start+k)) into the frame cache —
+    the produce half of the client's produce-c+1-while-fetching-c
+    pipelining."""
+    with self._lock:
+      producer = self._live_block_producer(producer_id)
+      self._touch(producer_id)
+    return producer.produce(epoch, start, k)
+
+  def block_fetch(self, producer_id: int, epoch: int, start: int,
+                  k: int) -> dict:
+    """The block frame (cache pop, or built on demand) — pure, so a
+    retried fetch after a lost response rebuilds identical bytes."""
+    with self._lock:
+      producer = self._live_block_producer(producer_id)
+      self._touch(producer_id)
+    return producer.fetch(epoch, start, k)
+
+  def destroy_block_producer(self, producer_id: int) -> bool:
+    """Idempotent, like destroy_sampling_producer."""
+    with self._lock:
+      self._block_producers.pop(producer_id, None)
+      self._last_active.pop(producer_id, None)
+      for key, pid in list(self._block_key_to_id.items()):
+        if pid == producer_id:
+          del self._block_key_to_id[key]
+    return True
+
   def heartbeat(self) -> dict:
     """Cheap liveness probe (resilience.Heartbeat polls this): answers
     while the RPC loop is alive. Deliberately LOCK-FREE — self._lock is
@@ -313,6 +402,8 @@ class DistServer:
     fan-out) is a no-op."""
     for pid in list(self._producers):
       self.destroy_sampling_producer(pid)
+    for pid in list(self._block_producers):
+      self.destroy_block_producer(pid)
     self._exit.set()
     return True
 
@@ -357,6 +448,11 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
           'start_new_epoch_sampling': s.start_new_epoch_sampling,
           'fetch_one_sampled_message': s.fetch_one_sampled_message,
           'destroy_sampling_producer': s.destroy_sampling_producer,
+          'create_block_producer': s.create_block_producer,
+          'block_producer_num_batches': s.block_producer_num_batches,
+          'block_produce': s.block_produce,
+          'block_fetch': s.block_fetch,
+          'destroy_block_producer': s.destroy_block_producer,
           'get_dataset_meta': s.get_dataset_meta,
           'heartbeat': s.heartbeat,
           'get_metrics': s.get_metrics,

@@ -42,10 +42,15 @@ _RECOMPUTE_MSG = (
 _DIST_REMOTE_MSG = (
     'scanned/fused distributed epochs are COLLOCATED-MESH only: pass a '
     'DistNeighborLoader over the training mesh. Remote (server-client) '
-    'and mp-worker loaders keep the per-step host loop — their failover '
-    'acks need per-batch host visibility (docs/failure_model.md: a dead '
-    "server's unacked seeds are redistributed per batch; inside a "
-    'scanned chunk there is no per-batch host point to ack from).')
+    'loaders have their own scanned path — distributed.'
+    'RemoteScanTrainer, the chunk-staged hybrid (docs/remote_scan.md): '
+    'sampling servers replay the counter-addressed stream into K-batch '
+    'blocks, the client double-buffers block c+1 over RPC while chunk '
+    'c trains, and acks/failover run at CHUNK granularity (failover '
+    'needs shuffle=False — survivors re-replay a dead server\'s blocks '
+    'from the same counter stream). Mp-worker loaders keep the '
+    'per-step host loop: their worker-restart replay acks batches one '
+    'by one (docs/failure_model.md).')
 
 
 class FusedEpochTrainer:
@@ -281,10 +286,22 @@ class DistFusedEpochTrainer:
     if not isinstance(loader, DistLoader):
       raise ValueError(f'{self._NAME}: {type(loader).__name__} is not a '
                        f'collocated DistLoader. {_DIST_REMOTE_MSG}')
-    if isinstance(loader, (DistLinkNeighborLoader, DistSubGraphLoader)):
-      raise ValueError(f'{self._NAME} covers supervised NODE '
-                       'classification; link/subgraph loaders keep the '
-                       'per-step loop')
+    if isinstance(loader, DistLinkNeighborLoader):
+      raise ValueError(
+          f'{self._NAME} covers supervised NODE classification; link '
+          'loaders keep the per-step loop — link batches train on '
+          'edge_label metadata the fused chunk program does not '
+          'collate, and they carry no per-seed ack provenance for any '
+          'chunk- or batch-granular failover (docs/failure_model.md '
+          "'Limits'; the chunk-staged remote path, "
+          'distributed.RemoteScanTrainer, is node-only for the same '
+          'reason)')
+    if isinstance(loader, DistSubGraphLoader):
+      raise ValueError(
+          f'{self._NAME} covers supervised NODE classification; '
+          'subgraph loaders yield induced subgraphs with no '
+          'train-step contract to fuse into a scanned chunk — '
+          'iterate them per step')
     if loader.overflow_policy == 'recompute':
       raise ValueError(_RECOMPUTE_MSG)
     sampler = loader.sampler

@@ -55,7 +55,12 @@ epoch-as-a-program contract over the collocated mesh loop, with the
 scan body composing the sharded sampler's all_to_all hop engine, the
 cached miss-only feature exchange, and the pmean'd data-parallel train
 step inside ONE shard_map chunk program (PERF.md 'Scanned distributed
-epoch').
+epoch'). The REMOTE (server-client) topology gets the same contract
+from `distributed.RemoteScanTrainer` (docs/remote_scan.md): sampling
+servers replay the counter-addressed stream into K-batch blocks and
+the client scans a train-only chunk program over device-resident
+blocks — same ceil(steps/K)+2 budget, same stage/ack hook seams, ack
+and failover at chunk granularity.
 """
 from typing import Optional
 
@@ -504,12 +509,13 @@ class DistScanTrainer(DistFusedEpochTrainer):
     data-parallel train step. The calibrated-caps overflow flag
     (already psum-replicated by the engine) ORs into the carry.
 
-  Collocated-mesh only: remote/server-client loaders keep the per-step
-  loop (their failover acks need per-batch host visibility —
-  docs/failure_model.md), and ``overflow_policy='recompute'`` is
-  rejected (per-batch host sync). On failover/restart the scan carry
-  and cache state are rebuilt — failover granularity is the CHUNK, not
-  the batch.
+  Collocated-mesh only: remote/server-client topologies run their own
+  scanned path (``distributed.RemoteScanTrainer`` — the chunk-staged
+  hybrid over server-produced K-batch blocks, docs/remote_scan.md;
+  mp-worker loaders keep the per-step loop), and
+  ``overflow_policy='recompute'`` is rejected (per-batch host sync).
+  On failover/restart the scan carry and cache state are rebuilt —
+  failover granularity is the CHUNK, not the batch.
 
   Args:
     loader: collocated DistNeighborLoader (homo or hetero) with

@@ -68,6 +68,16 @@ REGISTERED_METRICS = frozenset({
     'storage.hot_rows',
     'storage.warm_rows',
     'storage.disk_rows',
+    # chunk-staged remote scan (distributed/remote_scan.py +
+    # block_producer.py, docs/remote_scan.md): K-batch block exchange
+    # between sampling servers and the scanned client
+    'remote.blocks',
+    'remote.block_bytes',
+    'remote.block_mb_per_chunk',
+    'remote.block_fetch_ms',
+    'remote.block_stage_ms',
+    'remote.prefetch_miss',
+    'remote.failover_blocks',
     # chunk-granular recovery (graphlearn_tpu/recovery/): async exact
     # checkpointing at chunk boundaries + mid-epoch resume + scanned
     # failover rollback (docs/recovery.md)
@@ -113,6 +123,10 @@ REGISTERED_SPANS = frozenset({
     # out-of-core staging pipeline (storage/staging.py): one span per
     # staged chunk on the worker thread
     'storage.stage',
+    # chunk-staged remote scan (docs/remote_scan.md): one span per
+    # server-side block build and one per client-side block fetch
+    'remote.block_stage',
+    'remote.block_fetch',
     # chunk-granular recovery (recovery/): one span per snapshot write
     # (worker thread or sync fallback) and one wrapping each mid-epoch
     # resume; the failover rollback reuses `loader.failover` with the

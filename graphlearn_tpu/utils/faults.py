@@ -62,6 +62,8 @@ REGISTERED_SITES = frozenset({
     'heartbeat.probe',
     'storage.stage',
     'storage.promote',
+    'remote.block_stage',
+    'remote.block_fetch',
     'recovery.save',
     'recovery.restore',
     'recovery.roll_back',

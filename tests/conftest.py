@@ -128,7 +128,8 @@ def rng():
 # to debug a failure with the guards off.
 
 _STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
-                   'test_serving', 'test_storage', 'test_recovery')
+                   'test_serving', 'test_storage', 'test_recovery',
+                   'test_remote_scan')
 
 
 @pytest.fixture(autouse=True)
