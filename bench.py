@@ -177,6 +177,22 @@ def _traced_step_ms(jax, run_step, trace_dir, prog_prefix):
   return sum(ms for ms, _ in progs.values()), train_ms
 
 
+def _traced_call_ms(jax, fn, trace_dir, prog_prefix, iters=20):
+  """Per-call device ms of ONE jitted program: warmup, trace ``iters``
+  calls, read the ``prog_prefix`` program's average from the device
+  trace (None when the lane is missing — non-TPU backends)."""
+  jax.block_until_ready(fn())                     # compile + warmup
+  shutil.rmtree(trace_dir, ignore_errors=True)
+  jax.profiler.start_trace(trace_dir)
+  outs = [fn() for _ in range(iters)]
+  jax.block_until_ready(outs)
+  jax.profiler.stop_trace()
+  for n, (ms, _) in _device_program_ms(trace_dir).items():
+    if n.startswith(prog_prefix):
+      return float(ms)
+  return None
+
+
 def _run_hetero_e2e(jax, trace_dir, conv='sage', n_paper=100_000,
                     n_author=357_041, feat_dim=1024, hb=1024, hops=2,
                     variant='tree'):
@@ -409,9 +425,30 @@ BENCH_KEY_REGISTRY = {
     'feature_exchange_mb_per_batch_fullwidth': 'full-width posture MB',
     'feature_exchange_reduction_x': 'fullwidth / miss-only MB ratio',
     'feature_exchange_config': 'P/width/F/bucket/split/wire of the figure',
-    # RUN_MEAN_IMPL decision pair (VERDICT r5)
+    # RUN_MEAN_IMPL decision pair (VERDICT r5) + the auto-landed verdict
+    # (ISSUE 13: models.run_impl_decision applies the >3% margin rule so
+    # the next round flips the models.RUN_MEAN_IMPL default — or pins
+    # GLT_RUN_MEAN_IMPL — with a one-line, evidence-linked change)
     'run_mean_impl_reshape_ms': 'e2e step ms with RUN_MEAN_IMPL=reshape',
     'run_mean_impl_window_ms': 'e2e step ms with RUN_MEAN_IMPL=window',
+    'run_mean_impl_decision': "auto-landed winner ('reshape'/'window'; "
+                              'null when either leg failed)',
+    'run_mean_impl_decision_config': 'evidence string behind the '
+                                     'decision (both ms + margin rule)',
+    # kernel campaign r13 (ops/gather_pallas.py v2 + ops/sample_fused.py,
+    # benchmarks/prof_gather2.py): device-trace A/B of the run-segmented
+    # multi-row DMA gather and the fused sample+gather hop vs their XLA
+    # paths — ratios < 1.0 are the measured-win condition for flipping
+    # UnifiedTensor.use_pallas_v2 / NeighborSampler(use_fused_hop=True)
+    'gather2_ms': 'gather v2 kernel device ms/call (sorted-unique id '
+                  'probe, default block_rows/run_span)',
+    'gather2_vs_take_ratio': 'gather2_ms / XLA take ms on the same '
+                             'probe (< 1.0 = kernel wins)',
+    'gather2_config': 'probe + autotune config behind the gather2 keys',
+    'fused_hop_ms': 'fused sample+gather hop kernel device ms/call',
+    'fused_hop_vs_xla_ratio': 'fused_hop_ms / XLA uniform_sample hop ms '
+                              '(< 1.0 = kernel wins)',
+    'fused_hop_config': 'probe config behind the fused_hop keys',
     # out-of-core tiered storage (storage/, ROADMAP item 2): a scanned
     # epoch whose feature table is >= 4x the HBM(hot)+RAM(warm) budget,
     # vs the identical all-HBM epoch — the oversubscription gate
@@ -475,7 +512,7 @@ BENCH_KEY_REGISTRY = {
 BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
     'hetero_step', 'hetero_ref', 'feature_exchange', 'serving',
-    'oversub', 'recovery', 'remote_scan',
+    'oversub', 'recovery', 'remote_scan', 'gather2', 'fused_hop',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -498,6 +535,10 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'dist_scan_epoch_dispatches', 'dist_scan_epoch_wall_s',
     'feature_exchange_mb_per_batch',
     'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
+    # the kernel-campaign ratio pair: a ratio drifting UP means the
+    # kernels lost ground vs XLA round over round (compiler regressions
+    # included) — gate it like any latency key
+    'gather2_vs_take_ratio', 'fused_hop_vs_xla_ratio',
     'embed_epoch_wall_s', 'embed_epoch_dispatches',
     'oversub_epoch_wall_s', 'staged_mb_per_chunk',
     # a checkpoint that gets expensive (bytes) or taxing (overhead)
@@ -1120,8 +1161,101 @@ def main():
           result[f'{key}_error'] = f'{type(e).__name__}: {e}'[:200]
     finally:
       models_lib.RUN_MEAN_IMPL = prev_impl
+    # auto-land the winner (ISSUE 13): when both legs produced numbers,
+    # write the decision into the record so the next round can flip the
+    # models.RUN_MEAN_IMPL default (or pin GLT_RUN_MEAN_IMPL) with a
+    # one-line change citing this record — no manual probe run needed
+    dec, why = models_lib.run_impl_decision(
+        result.get('run_mean_impl_reshape_ms'),
+        result.get('run_mean_impl_window_ms'))
+    result['run_mean_impl_decision'] = dec
+    result['run_mean_impl_decision_config'] = (
+        f'{why}; basis: exact-variant bf16 e2e step ({E2E_ITERS} traced '
+        'iters); apply by editing models.RUN_MEAN_IMPL citing this '
+        'record')
   except Exception as e:
     result['run_mean_impl_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- kernel campaign r13: gather v2 + fused hop vs their XLA paths
+  # (device-trace A/B; ratios < 1.0 flip the per-kernel routing flags —
+  # UnifiedTensor.use_pallas_v2 / NeighborSampler(use_fused_hop=True)).
+  # The full autotune grid lives in benchmarks/prof_gather2.py; bench
+  # tracks one representative config per kernel round over round.
+  try:
+    import jax.numpy as jnp
+    if backend != 'tpu':
+      raise RuntimeError(
+          f'backend {backend}: kernel-path device-trace claims are '
+          'TPU-only (CPU interpret parity lives in tests/test_ops.py)')
+    g2_table = jnp.asarray(
+        np.random.default_rng(5).standard_normal((NUM_NODES, 128))
+        .astype(np.float32))
+    # chunk-structured sorted-unique ids: gather v2's target workload is
+    # the tiered staging / slab gather, whose planned miss sets are
+    # CHUNK-contiguous (rows group per disk chunk — storage/planner) —
+    # 1024 random 128-row chunks = 131072 ids, sorted, every chunk a
+    # stretch of consecutive rows, so the probe actually exercises the
+    # multi-row run-DMA path. (A uniform sorted sample of 131k from 1M
+    # has ~zero full 8-runs: P ~ 0.13^7 — it would measure only the
+    # v1-equivalent single-DMA path plus plan overhead.)
+    g2_starts = np.sort(np.random.default_rng(6).choice(
+        NUM_NODES // 128, 1024, replace=False)) * 128
+    g2_ids = jnp.asarray(
+        (g2_starts[:, None] + np.arange(128)[None, :])
+        .reshape(-1).astype(np.int32))
+    from graphlearn_tpu.ops.gather_pallas import _gather_rows_hbm2_impl
+
+    def _g2_take(t, i):
+      return jnp.take(t, i, axis=0)
+    take_fn = jax.jit(_g2_take)
+    g2_ms = _traced_call_ms(
+        jax, lambda: _gather_rows_hbm2_impl(g2_table, g2_ids, 256, 8,
+                                            True, False),
+        '/tmp/glt_bench_gather2', 'jit__gather_rows_hbm2_impl')
+    take_ms = _traced_call_ms(jax, lambda: take_fn(g2_table, g2_ids),
+                              '/tmp/glt_bench_g2take', 'jit__g2_take')
+    result['gather2_ms'] = round(g2_ms, 3) if g2_ms else None
+    result['gather2_vs_take_ratio'] = (
+        round(g2_ms / take_ms, 3) if g2_ms and take_ms else None)
+    result['gather2_config'] = (
+        f'[{NUM_NODES}, 128] f32 table, 1024 x 128-row contiguous '
+        'chunks = 131072 sorted-unique ids (presorted=True, the '
+        'staging-slab shape), block_rows=256, run_span=8 vs jnp.take')
+  except Exception as e:
+    result['gather2_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  try:
+    import jax.numpy as jnp
+    if backend != 'tpu':
+      raise RuntimeError(
+          f'backend {backend}: kernel-path device-trace claims are '
+          'TPU-only (CPU interpret parity lives in tests/test_ops.py)')
+    fh_ga = s_cal._graph_arrays()
+    fh_meta = s_cal._csr_meta()
+    fh_blocks = glt.ops.build_indices128(fh_ga['indices'], min_rows=5)
+    fh_seeds = jnp.asarray(np.random.default_rng(7).integers(
+        0, NUM_NODES, BATCH * FANOUT[0]).astype(np.int32))
+    fh_mask = jnp.ones((BATCH * FANOUT[0],), bool)
+    fh_key = jax.random.fold_in(jax.random.PRNGKey(0), 1)
+    fh_k = FANOUT[1]
+    fh_ms = _traced_call_ms(
+        jax, lambda: glt.ops.sample_hop_fused(
+            fh_ga['indptr'], fh_ga['indices'], fh_blocks, fh_seeds,
+            fh_mask, fh_k, fh_key, meta=fh_meta),
+        '/tmp/glt_bench_fusedhop', 'jit_sample_hop_fused')
+    xla_ms = _traced_call_ms(
+        jax, lambda: glt.ops.uniform_sample(
+            fh_ga['indptr'], fh_ga['indices'], fh_seeds, fh_mask, fh_k,
+            fh_key, meta=fh_meta),
+        '/tmp/glt_bench_xlahop', 'jit_uniform_sample')
+    result['fused_hop_ms'] = round(fh_ms, 3) if fh_ms else None
+    result['fused_hop_vs_xla_ratio'] = (
+        round(fh_ms / xla_ms, 3) if fh_ms and xla_ms else None)
+    result['fused_hop_config'] = (
+        f'one hop, {BATCH * FANOUT[0]} seeds x k={fh_k}, window=512, '
+        'block_seeds=128, bench CSR vs ops.uniform_sample')
+  except Exception as e:
+    result['fused_hop_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- hetero (IGBH-shaped RGNN/RGAT) train step --------------------
   try:

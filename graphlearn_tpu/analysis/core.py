@@ -69,7 +69,10 @@ class Config:
       'distributed/dist_feature.py', 'distributed/dist_neighbor_sampler.py',
       'distributed/dist_loader.py', 'distributed/remote_scan.py',
       'distributed/block_producer.py', 'sampler/neighbor_sampler.py',
-      'data/unified_tensor.py', 'serving/', 'storage/', 'recovery/')
+      'data/unified_tensor.py', 'serving/', 'storage/', 'recovery/',
+      # Pallas kernel modules (ISSUE 13): their host-level routing
+      # wrappers dispatch module-jitted impls and must stay budgeted
+      'ops/gather_pallas.py', 'ops/sample_fused.py')
   # cross-module jit factories the per-module dataflow can't see: calls
   # to these names yield jitted callables (models/train.py builders)
   known_jit_factories: Tuple[str, ...] = ('make_train_step',)

@@ -142,6 +142,11 @@ class UnifiedTensor:
     import jax.numpy as jnp
     if self._host_rows_n == 0:
       if self._pallas_ok():
+        if self.use_pallas_v2:
+          from ..ops import gather_rows_hbm2
+          return gather_rows_hbm2(self._device_part, jnp.asarray(ids),
+                                  block_rows=self.pallas_v2_block_rows,
+                                  run_span=self.pallas_v2_run_span)
         from ..ops import gather_rows_hbm
         return gather_rows_hbm(self._device_part, jnp.asarray(ids))
       return jnp.take(self._device_part, jnp.asarray(ids), axis=0)
@@ -180,14 +185,21 @@ class UnifiedTensor:
   use_pallas = False   # opt-in: device traces show XLA's take is faster
   # for the all-hot row gather on v5e (1.20 vs 1.41 ms/call, PERF.md);
   # the kernel remains available for rigs where the balance differs
+  use_pallas_v2 = False   # opt-in: the run-segmented multi-row DMA
+  # gather (ops.gather_rows_hbm2) — the same evidence-gated contract:
+  # auto-route only once benchmarks/prof_gather2.py shows a measured
+  # win on the serving rig. When both flags are set, v2 wins.
+  pallas_v2_block_rows = 256   # autotune grid knobs (prof_gather2)
+  pallas_v2_run_span = 8
 
   def _pallas_ok(self) -> bool:
-    """All-hot gathers use the Pallas row-DMA kernel only when opted in
-    AND the table is single-device TPU-resident with a 128-lane-aligned
-    feature dim."""
+    """All-hot gathers use a Pallas row-DMA kernel only when opted in
+    (either generation's flag) AND the table is single-device
+    TPU-resident with a 128-lane-aligned feature dim."""
     import jax
     t = self._device_part
-    return (self.use_pallas and jax.default_backend() == 'tpu' and
+    return ((self.use_pallas or self.use_pallas_v2) and
+            jax.default_backend() == 'tpu' and
             t is not None and t.shape[1] % 128 == 0 and
             len(t.sharding.device_set) == 1)
 

@@ -81,6 +81,14 @@ REGISTERED_METRICS = frozenset({
     # chunk-granular recovery (graphlearn_tpu/recovery/): async exact
     # checkpointing at chunk boundaries + mid-epoch resume + scanned
     # failover rollback (docs/recovery.md)
+    # Pallas kernel routing (ops/gather_pallas.py, ops/sample_fused.py +
+    # sampler/neighbor_sampler.py): evidence-gated kernel-path
+    # observability — how often the measured-win flags actually route
+    # through a kernel vs fall back to XLA (docs/observability.md)
+    'ops.gather_runs',
+    'ops.gather_fallbacks',
+    'ops.fused_hop_calls',
+    'ops.gather_ms',
     'checkpoint.saves',
     'checkpoint.bytes',
     'checkpoint.save_ms',

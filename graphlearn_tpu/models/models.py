@@ -108,7 +108,24 @@ def _masked_run_softmax(e, mask, out_dtype, negative_slope):
   MergeGATConv): leaky_relu, mask to -inf, TRUE per-run max
   stabilization (clamping at 0 would underflow exp when every valid
   logit is very negative — the same stabilization GATConv's segment
-  softmax uses; all-masked runs fall back to 0), exp, denom floor."""
+  softmax uses; all-masked runs fall back to 0), exp, denom floor.
+  Dispatches on RUN_SOFTMAX_IMPL (see above): 'window' keeps the whole
+  f32 chain on the flat [runs*k, H] layout."""
+  if RUN_SOFTMAX_IMPL == 'window':
+    f, k, h = e.shape
+    ef = nn.leaky_relu(e.reshape(f * k, h), negative_slope)
+    mf = mask.reshape(f * k)
+    ef = jnp.where(mf[:, None], ef, -jnp.inf)
+    mx = jax.lax.reduce_window(ef, -jnp.inf, jax.lax.max, (k, 1), (k, 1),
+                               'VALID')                          # [f, h]
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    ex = jnp.where(mf[:, None],
+                   jnp.exp(ef - jnp.repeat(mx, k, axis=0)), 0.0)
+    denom = jnp.maximum(
+        jax.lax.reduce_window(ex, 0.0, jax.lax.add, (k, 1), (k, 1),
+                              'VALID'), 1e-9)
+    return (ex / jnp.repeat(denom, k, axis=0)).reshape(
+        f, k, h).astype(out_dtype)
   e = nn.leaky_relu(e, negative_slope)
   e = jnp.where(mask[..., None], e, -jnp.inf)
   mx = e.max(axis=1, keepdims=True)
@@ -127,6 +144,38 @@ def _masked_run_mean(vals, mask):
   return s * inv[:, None]
 
 
+def _impl_from_env(var: str, default: str, allowed) -> str:
+  """Flat-layout decision machinery: the measured default below can be
+  overridden per run (GLT_RUN_MEAN_IMPL / GLT_RUN_SOFTMAX_IMPL) — the
+  deployment-side half of bench.py's ``run_mean_impl_decision`` key,
+  which records the A/B winner so the next round can flip the default
+  here with a one-line, evidence-linked change."""
+  import os
+  v = os.environ.get(var, '').strip()
+  if not v:
+    return default
+  if v not in allowed:
+    raise ValueError(f'{var}={v!r}: expected one of {sorted(allowed)}')
+  return v
+
+
+def run_impl_decision(reshape_ms, window_ms, rel_margin: float = 0.03):
+  """The auto-land rule shared by bench.py's RUN_MEAN_IMPL A/B section:
+  'window' wins only on a > ``rel_margin`` relative improvement (a
+  within-noise tie keeps the incumbent 'reshape', the measured round-4
+  configuration). Returns (decision, evidence-string); None inputs
+  (a failed leg) return (None, reason)."""
+  if reshape_ms is None or window_ms is None:
+    return None, 'undecided: missing ' + (
+        'both legs' if reshape_ms is None and window_ms is None else
+        ('reshape leg' if reshape_ms is None else 'window leg'))
+  if window_ms < reshape_ms * (1.0 - rel_margin):
+    return 'window', (f'window {window_ms:.3f} ms beats reshape '
+                      f'{reshape_ms:.3f} ms by >{rel_margin:.0%}')
+  return 'reshape', (f'reshape {reshape_ms:.3f} ms holds (window '
+                     f'{window_ms:.3f} ms, margin {rel_margin:.0%})')
+
+
 # Run-aggregation implementation for the dense convs' mean kernels.
 # 'reshape' (default): reduce over axis 1 of a [runs, k, F] view — the
 # 3D reshape forces a relayout copy on TPU when k is not tile-aligned
@@ -135,8 +184,21 @@ def _masked_run_mean(vals, mask):
 # [runs*k, F] layout and reduce k-runs with lax.reduce_window
 # (window/stride k on the row axis) — no 3D view materialized.
 # Numerically identical (equivalence tests run under both); A/B traced
-# by benchmarks/prof_copytax.py on the chip.
-RUN_MEAN_IMPL = 'reshape'
+# by benchmarks/prof_copytax.py on the chip and auto-decided by
+# bench.py's ``run_mean_impl_decision`` key (run_impl_decision above).
+RUN_MEAN_IMPL = _impl_from_env('GLT_RUN_MEAN_IMPL', 'reshape',
+                               ('reshape', 'window'))
+
+# Same fork for the dense GAT convs' run softmax (TreeGATConv /
+# MergeGATConv): the f32 [runs, k, H] softmax chain carries the same
+# never-tile-aligned k as the mean kernels, and the round-4 trace left a
+# ~1 ms/step tail of softmax-backward transposed layouts. 'window' runs
+# the whole chain (leaky_relu -> per-run max -> exp -> per-run sum ->
+# normalize) on the FLAT [runs*k, H] layout with lax.reduce_window
+# reductions — the further flat-layout rewrite of ISSUE 13(c);
+# equivalence-tested under both, A/B'd by prof_copytax --softmax-ab.
+RUN_SOFTMAX_IMPL = _impl_from_env('GLT_RUN_SOFTMAX_IMPL', 'reshape',
+                                  ('reshape', 'window'))
 
 
 def _masked_flat_run_mean(x, mask, k):

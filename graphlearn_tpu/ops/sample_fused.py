@@ -1,0 +1,249 @@
+"""Fused sample+gather CSR hop: the neighbor-slot draw and the adjacency
+gather in ONE Pallas pass.
+
+The hardware-matched-sampler argument (GNNSampler, arxiv 2108.11571;
+sampler accelerators, arxiv 2209.02916) instantiated for TPU: XLA lowers
+``ops.uniform_sample``'s hop as (a) the [B, K] offset draw, (b) an
+HBM-materialized [B, K] ``epos`` intermediate, and (c) a LATENCY-BOUND
+element gather over the [E] CSR indices array — one DMA transaction per
+sampled edge (~140M elem/s, PERF.md). But a seed's neighbor segment
+``indices[start : start+deg]`` is CONTIGUOUS in HBM, so this kernel
+stages it with ONE aligned multi-row DMA per seed and resolves all k
+draws against the staged window with dense VPU one-hot selection —
+k transactions collapse to ~1 for every seed whose segment fits the
+window, and the sampled edges never round-trip through an
+HBM-materialized intermediate.
+
+Bit-matching contract: the draw itself (offsets, validity mask, epos)
+is computed OUTSIDE the kernel with byte-for-byte the same jnp ops as
+``ops.uniform_sample`` fed by the same counter-addressed fold_in key —
+so the kernel's only job is ``indices[epos]``, and the XLA fallback
+(off-TPU, or routing flag off) IS ``ops.uniform_sample``'s stream:
+identical edges, identical epos, identical mask, on every path.
+
+Layout: the CSR indices ship as a FILL-padded aligned ``[ceil(E/128),
+128]`` block view (``build_indices128`` — the 128-lane cousin of block
+sampling's [E/16, 16] view). Per seed the kernel branches:
+
+  deg fits the window  -> one [NR, 128]-row DMA staging the aligned
+                          superset of [start, start+deg) (NR =
+                          window//128 + 1 covers any start alignment);
+  deg > window (hubs)  -> k single-[128]-row DMAs, one per sampled
+                          position — no worse than XLA's k element
+                          transactions, and hop-local (no fallback
+                          cliff: a single hub in the frontier does not
+                          de-optimize the rest of the batch).
+
+Routing is evidence-gated like every kernel in this repo:
+``NeighborSampler(use_fused_hop=...)`` defaults to False, the XLA path
+stays bit-identical, and interpret-mode parity tests pin the kernel
+against ``ops.uniform_sample`` on CPU (tests/test_ops.py).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .unique import FILL
+
+LANES = 128
+
+
+def build_indices128(indices, min_rows: int = 0):
+  """[E] CSR indices -> FILL-padded aligned [max(ceil(E/128), min_rows),
+  128] view (device-side; a free reshape plus tail pad)."""
+  e = int(indices.shape[0])
+  rows = max(-(-e // LANES), min_rows, 1)
+  pad = rows * LANES - e
+  ind = jnp.asarray(indices).astype(jnp.int32)
+  if pad:
+    ind = jnp.concatenate([ind, jnp.full((pad,), FILL, jnp.int32)])
+  return ind.reshape(rows, LANES)
+
+
+def _draw(start, deg, seed_mask, k: int, key):
+  """ops.uniform_sample's offset draw, byte for byte (the bit-matching
+  contract lives or dies on this staying IDENTICAL to neighbor.py)."""
+  b = seed_mask.shape[0]
+  u = jax.random.uniform(key, (b, k))
+  rand_off = jnp.floor(u * deg[:, None].astype(u.dtype)).astype(jnp.int32)
+  rand_off = jnp.minimum(rand_off, jnp.maximum(deg[:, None] - 1, 0))
+  seq_off = jnp.arange(k, dtype=jnp.int32)[None, :]
+  offsets = jnp.where(deg[:, None] > k, rand_off, seq_off)
+  mask = seed_mask[:, None] & (offsets < deg[:, None])
+  epos = start[:, None] + offsets
+  return epos, mask
+
+
+def _hop_kernel_factory(k, nr, nbk):
+  def kernel(plan_ref, blocks_ref, epos_ref, meta_ref, out_ref, win, big,
+             sem_w, sem_b):
+    from jax.experimental import pallas as pl
+    i = pl.program_id(0)
+    bs = out_ref.shape[0]
+
+    def dmas(s):
+      from jax.experimental.pallas import tpu as pltpu
+      row0 = plan_ref[i * bs + s, 0]
+      small = plan_ref[i * bs + s, 1]
+      window = pltpu.make_async_copy(blocks_ref.at[pl.ds(row0, nr)],
+                                     win.at[s], sem_w.at[s])
+      return small, window
+
+    def row_dma(s, j):
+      from jax.experimental.pallas import tpu as pltpu
+      r = jnp.clip(epos_ref[s, j] // LANES, 0, nbk - 1)
+      return pltpu.make_async_copy(blocks_ref.at[r], big.at[s, j],
+                                   sem_b.at[s, j])
+
+    def issue(s, carry):
+      small, window = dmas(s)
+
+      @pl.when(small == 1)
+      def _():
+        window.start()
+
+      @pl.when(small == 0)
+      def _():
+        def issue_j(j, c):
+          row_dma(s, j).start()
+          return c
+        jax.lax.fori_loop(0, k, issue_j, None, unroll=True)
+      return carry
+
+    jax.lax.fori_loop(0, bs, issue, None)
+
+    def drain(s, carry):
+      small, window = dmas(s)
+
+      @pl.when(small == 1)
+      def _():
+        window.wait()
+
+      @pl.when(small == 0)
+      def _():
+        def drain_j(j, c):
+          row_dma(s, j).wait()
+          return c
+        jax.lax.fori_loop(0, k, drain_j, None, unroll=True)
+      return carry
+
+    jax.lax.fori_loop(0, bs, drain, None)
+
+    # dense VPU extraction over the staged windows (one-hot contraction,
+    # NOT take_along_axis — the same rule as ops.uniform_sample_padded)
+    epos = epos_ref[:]                               # [bs, k]
+    row0 = meta_ref[:, 0]                            # [bs]
+    small = meta_ref[:, 1]
+    wflat = win[:].reshape(bs, nr * LANES)
+    pos_l = jnp.clip(epos - row0[:, None] * LANES, 0, nr * LANES - 1)
+    lanes_w = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nr * LANES), 2)
+    small_nbrs = jnp.sum(wflat[:, None, :] * (pos_l[:, :, None] == lanes_w),
+                         axis=-1)
+    lanes_b = jax.lax.broadcasted_iota(jnp.int32, (1, 1, LANES), 2)
+    big_nbrs = jnp.sum(big[:] * ((epos % LANES)[:, :, None] == lanes_b),
+                       axis=-1)
+    sel = jnp.where(small[:, None] == 1, small_nbrs, big_nbrs)  # [bs, k]
+    out_ref[:] = jnp.concatenate(
+        [sel, jnp.zeros((bs, LANES - k), jnp.int32)], axis=1)
+  return kernel
+
+
+def _gather_epos_pallas(blocks128, start, deg, safe_epos, k: int,
+                        window: int, block_seeds: int, interpret: bool):
+  """``indices[safe_epos]`` via per-seed staged windows (see module
+  docstring); values at masked slots are whatever row 0 holds — callers
+  mask them, exactly like the XLA path's ``indices[safe_epos]``."""
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  b = start.shape[0]
+  assert window % LANES == 0 and window > 0
+  nr = window // LANES + 1      # covers any start%128 alignment
+  nbk = blocks128.shape[0]
+  assert nbk >= nr, 'build_indices128(min_rows=nr) guarantees this'
+  assert 0 < k <= LANES
+  bs = min(block_seeds, b)
+  pad = (-b) % bs
+  row0 = jnp.clip(start // LANES, 0, nbk - nr).astype(jnp.int32)
+  # every sampled position of a 'small' seed lies inside its window:
+  # epos < start + deg <= row0*128 + nr*128 (clamped row0 only lowers
+  # the base, and the window top then reaches the padded array end)
+  small = ((start - row0 * LANES + deg) <= nr * LANES).astype(jnp.int32)
+  plan = jnp.stack([row0, small], axis=1)            # [b, 2]
+  epos32 = safe_epos.astype(jnp.int32)
+  if pad:
+    plan = jnp.concatenate(
+        [plan, jnp.tile(jnp.array([[0, 1]], jnp.int32), (pad, 1))])
+    epos32 = jnp.concatenate([epos32, jnp.zeros((pad, k), jnp.int32)])
+  grid = (b + pad) // bs
+
+  out = pl.pallas_call(
+      _hop_kernel_factory(k, nr, nbk),
+      grid_spec=pltpu.PrefetchScalarGridSpec(
+          num_scalar_prefetch=1,
+          grid=(grid,),
+          in_specs=[
+              pl.BlockSpec(memory_space=pl.ANY),               # blocks128
+              pl.BlockSpec((bs, k), lambda i, plan_ref: (i, 0)),   # epos
+              pl.BlockSpec((bs, 2), lambda i, plan_ref: (i, 0)),   # meta
+          ],
+          out_specs=pl.BlockSpec((bs, LANES), lambda i, plan_ref: (i, 0)),
+          scratch_shapes=[
+              pltpu.VMEM((bs, nr, LANES), jnp.int32),
+              pltpu.VMEM((bs, k, LANES), jnp.int32),
+              pltpu.SemaphoreType.DMA((bs,)),
+              pltpu.SemaphoreType.DMA((bs, k)),
+          ],
+      ),
+      out_shape=jax.ShapeDtypeStruct((b + pad, LANES), jnp.int32),
+      interpret=interpret,
+  )(plan, blocks128, epos32, plan)
+  return out[:b, :k]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('k', 'window', 'block_seeds',
+                                    'interpret', 'force'))
+def sample_hop_fused(indptr, indices, blocks128, seeds, seed_mask, k: int,
+                     key, meta=None, window: int = 512,
+                     block_seeds: int = 128, interpret: bool = False,
+                     force: bool = False):
+  """One fused uniform CSR hop; same output contract — and the same
+  PRNG stream, bit for bit — as :func:`ops.uniform_sample`.
+
+  Args:
+    indptr/indices: the CSR (used by the fallback path and for
+      ``meta=None`` row lookup).
+    blocks128: :func:`build_indices128` aligned view (may be None —
+      forces the XLA fallback).
+    seeds/seed_mask/k/key/meta: exactly :func:`ops.uniform_sample`.
+    window: staged segment span per seed (multiple of 128; autotune axis
+      probed by benchmarks/prof_gather2.py). Seeds with deg > window
+      take the per-sample row-DMA path — never a whole-batch fallback.
+    block_seeds: seeds per grid step.
+    interpret: run the Pallas interpreter (CPU parity tests).
+    force: run the kernel off-TPU (tests); default falls back to the
+      XLA hop off-TPU.
+
+  Returns (nbrs [B, K], epos [B, K], mask [B, K]) — FILL/0-padded like
+  ``uniform_sample``.
+  """
+  safe_seeds = jnp.where(seed_mask, seeds, 0)
+  if meta is not None:
+    row = meta[safe_seeds]
+    start, deg = row[:, 0], row[:, 1]
+  else:
+    start = indptr[safe_seeds]
+    deg = indptr[safe_seeds + 1] - start
+  epos, mask = _draw(start, deg, seed_mask, k, key)
+  safe_epos = jnp.where(mask, epos, 0)
+  use_kernel = blocks128 is not None and (
+      interpret or force or jax.default_backend() == 'tpu')
+  if use_kernel:
+    picked = _gather_epos_pallas(blocks128, start, deg, safe_epos, k,
+                                 window, block_seeds, interpret)
+  else:
+    picked = indices[safe_epos]
+  nbrs = jnp.where(mask, picked, FILL)
+  return nbrs, safe_epos, mask

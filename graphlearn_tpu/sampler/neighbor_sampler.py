@@ -326,7 +326,8 @@ def hetero_tree_blocks(seed_caps: Dict[NodeType, int], etypes,
 
 @functools.lru_cache(maxsize=None)
 def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
-                   num_graph_nodes, padded=False, block_num_edges=0):
+                   num_graph_nodes, padded=False, block_num_edges=0,
+                   fused_hop=False, fused_hop_window=512):
   """Jitted whole-multi-hop sample program, cached at MODULE level on its
   static signature: every sampler instance with the same config (e.g. the
   train and eval loaders of one run) shares one traced/compiled
@@ -373,6 +374,17 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
       elif weighted:
         nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
                                             fmask, k, keys[i])
+      elif fused_hop:
+        # fused sample+gather Pallas hop (ops/sample_fused.py): same
+        # fold_in stream as uniform_sample bit for bit — tab carries the
+        # [E/128, 128] aligned indices view, deg the csr_meta row table.
+        # Off-TPU the op routes its own XLA fallback, so the flag is
+        # safe to leave on in CPU tests ('interpret' forces the kernel
+        # through the Pallas interpreter for parity coverage).
+        nbrs, epos, m = ops.sample_hop_fused(
+            indptr, indices, tab, frontier, fmask, k, keys[i], meta=deg,
+            window=fused_hop_window,
+            interpret=(fused_hop == 'interpret'))
       else:
         # deg slot carries the [N, 2] csr_meta row table for plain
         # uniform sampling (see _fused_args / ops.uniform_sample)
@@ -417,6 +429,7 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
     full = full and caps[i + 1] == caps[i] * k
   fn.__name__ = f'sample_{mode}' + ('_padded' if padded else '') + \
       ('_block' if block_num_edges else '') + \
+      ('_fusedhop' if fused_hop else '') + \
       ('' if full else '_capped')
   fn.__qualname__ = fn.__name__
   return jax.jit(fn)
@@ -452,7 +465,8 @@ class NeighborSampler(BaseSampler):
                node_budget: Optional[int] = None, fused: bool = True,
                dedup: str = 'auto',
                padded_window: Optional[int] = None,
-               frontier_caps=None):
+               frontier_caps=None, use_fused_hop=False,
+               fused_hop_window: int = 512):
     import jax
     self.graph = graph
     self.num_neighbors = num_neighbors
@@ -551,6 +565,37 @@ class NeighborSampler(BaseSampler):
             f'padded_window={padded_window} < max fanout {max(fo)}: '
             'rows with degree > window would silently under-sample '
             '(the table caps per-row candidates at the window)')
+    # use_fused_hop: route uniform CSR hops through the fused
+    # sample+gather Pallas kernel (ops.sample_hop_fused — one staged
+    # segment DMA per seed instead of k element gathers). MEASURED-WIN
+    # flag, default False (the repo's evidence-gated routing pattern,
+    # like UnifiedTensor.use_pallas): the XLA path is bit-identical —
+    # same counter-addressed fold_in stream — so flipping it never
+    # changes samples. 'interpret' runs the kernel through the Pallas
+    # interpreter (CPU parity tests). fused_hop_window is the staged
+    # segment span per seed (multiple of 128; deg > window seeds take
+    # the per-sample row-DMA path inside the kernel).
+    if use_fused_hop:
+      if isinstance(graph, dict):
+        raise ValueError('use_fused_hop is homogeneous-only (the typed '
+                         'engine samples per etype; fuse there once the '
+                         'homo kernel has a measured win)')
+      if with_weight:
+        raise ValueError('use_fused_hop supports uniform sampling only '
+                         '(the weighted CDF bisection has no fused '
+                         'kernel)')
+      if padded_window is not None or strategy == 'block':
+        raise ValueError('use_fused_hop replaces the CSR hop itself — '
+                         'padded_window/block are alternative sampling '
+                         'backends, pick one')
+      if not fused:
+        raise ValueError('use_fused_hop requires the fused '
+                         'multi-hop program (fused=True)')
+      if fused_hop_window % 128 != 0 or fused_hop_window <= 0:
+        raise ValueError('fused_hop_window must be a positive multiple '
+                         'of 128 (aligned row DMAs)')
+    self.use_fused_hop = use_fused_hop
+    self.fused_hop_window = fused_hop_window
     self._padded_seed = 0 if seed is None else seed
     self._key = jax.random.PRNGKey(0 if seed is None else seed)
     self._call_count = 0    # host-side PRNG stream position
@@ -713,7 +758,9 @@ class NeighborSampler(BaseSampler):
         self.with_weight and g.edge_weights is not None,
         mode, g.num_nodes if mode == 'map_table' else 0,
         padded=self.padded_window is not None,
-        block_num_edges=nblk_edges)
+        block_num_edges=nblk_edges,
+        fused_hop=self.use_fused_hop,
+        fused_hop_window=self.fused_hop_window)
 
   def _padded_arrays(self):
     """Lazily built device-resident padded adjacency (homo).
@@ -759,6 +806,20 @@ class NeighborSampler(BaseSampler):
       self._garrs[key] = (ind.reshape(-1, ops.BLOCK), meta)
     return self._garrs[key]
 
+  def _indices128(self, etype=None):
+    """Lazily built FILL-padded [ceil(E/128), 128] aligned view of the
+    CSR indices for the fused hop kernel (ops.build_indices128; the
+    128-lane cousin of _block_arrays' [E/16, 16] view). min_rows keeps
+    the kernel's staged window slice in bounds on tiny graphs."""
+    g = self._get_graph(etype)
+    key = ('indices128', id(g), self.fused_hop_window)
+    if key not in self._garrs:
+      from ..ops.sample_fused import LANES
+      ga = self._graph_arrays(etype)
+      self._garrs[key] = ops.build_indices128(
+          ga['indices'], min_rows=self.fused_hop_window // LANES + 1)
+    return self._garrs[key]
+
   def _csr_meta(self, etype=None):
     """Packed [N, 2] (start, degree) row table for uniform sampling —
     one ROW gather replaces two indptr ELEMENT gathers per frontier
@@ -799,12 +860,16 @@ class NeighborSampler(BaseSampler):
       blocks, meta = self._block_arrays()
       return (ga['indptr'], ga['indices'], ga['eids'], cum, blocks,
               meta, None)
+    if self.use_fused_hop:
+      return (ga['indptr'], ga['indices'], ga['eids'], cum,
+              self._indices128(), self._csr_meta(), None)
     return (ga['indptr'], ga['indices'], ga['eids'], cum, None,
             None if weighted else self._csr_meta(), None)
 
   def _homo_fn(self, batch_cap: int, fanouts):
     sig = ('homo', batch_cap, tuple(fanouts), self.with_edge,
-           self.with_weight, self.padded_window, self.strategy)
+           self.with_weight, self.padded_window, self.strategy,
+           self.use_fused_hop, self.fused_hop_window)
     if sig not in self._fns:
       from ..metrics import programs
       self._fns[sig] = programs.instrument(
@@ -917,6 +982,11 @@ class NeighborSampler(BaseSampler):
     if self.fused:
       from ..utils.trace import record_dispatch
       fn = self._homo_fn(cap, fanouts)
+      if self.use_fused_hop:
+        # kernel-path observability: batches whose hop program routed
+        # through the fused Pallas kernel (len(fanouts) hops per call)
+        from .. import metrics
+        metrics.inc('ops.fused_hop_calls')
       record_dispatch('sample')
       res = fn(*self._fused_args(), jnp.asarray(padded), jnp.asarray(mask),
                key)

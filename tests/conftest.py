@@ -129,7 +129,12 @@ def rng():
 
 _STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
                    'test_serving', 'test_storage', 'test_recovery',
-                   'test_remote_scan')
+                   'test_remote_scan',
+                   # r13 kernel parity suites: the fused-hop stream and
+                   # gather-v2 tests must hold with the strict guard
+                   # rails armed (the kernels ride inside guarded scan
+                   # bodies in production)
+                   'test_ops')
 
 
 @pytest.fixture(autouse=True)

@@ -921,6 +921,77 @@ def test_flat_run_mean_window_impl_matches():
   np.testing.assert_allclose(o_ref, o_win, rtol=1e-5, atol=1e-5)
 
 
+def test_flat_run_softmax_window_impl_matches():
+  """The flat reduce_window run-softmax (RUN_SOFTMAX_IMPL='window' —
+  ISSUE 13's further flat-layout rewrite) matches the reshape kernel at
+  the kernel level (all-masked runs and very-negative logits included)
+  and through full TreeGATConv / MergeGATConv forwards, so the
+  prof_copytax --softmax-ab trace compares layouts, not semantics."""
+  import jax
+  import jax.numpy as jnp
+  from graphlearn_tpu.models import models as M
+  rng = np.random.default_rng(0)
+  f, k, h = 23, 5, 2
+  e = rng.standard_normal((f, k, h)).astype(np.float32) * 10
+  e[3] -= 200.0                       # underflow-prone run
+  m = rng.random((f, k)) < 0.6
+  m[5] = False                        # all-masked run
+  ref = np.asarray(M._masked_run_softmax(jnp.asarray(e), jnp.asarray(m),
+                                         jnp.float32, 0.2))
+  assert M.RUN_SOFTMAX_IMPL == 'reshape'
+  try:
+    M.RUN_SOFTMAX_IMPL = 'window'
+    win = np.asarray(M._masked_run_softmax(jnp.asarray(e),
+                                           jnp.asarray(m),
+                                           jnp.float32, 0.2))
+  finally:
+    M.RUN_SOFTMAX_IMPL = 'reshape'
+  np.testing.assert_allclose(ref, win, rtol=1e-6, atol=1e-6)
+
+  # end-to-end: tree GAT forward under both impls, same params
+  rng = np.random.default_rng(4)
+  n = 150
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rng.integers(0, n, 1200),
+                          rng.integers(0, n, 1200)]),
+                num_nodes=n, graph_mode='CPU')
+  ds.init_node_features(rng.standard_normal((n, 8)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, 3, n))
+  loader = glt.loader.NeighborLoader(ds, [3, 2], np.arange(16),
+                                     batch_size=8, seed=0, dedup='tree')
+  from graphlearn_tpu.models import train as train_lib
+  bd = train_lib.batch_to_dict(next(iter(loader)))
+  no, eo = train_lib.tree_hop_offsets(8, [3, 2])
+  model = glt.models.GAT(hidden_dim=8, out_dim=3, num_layers=2, heads=2,
+                         hop_node_offsets=no, hop_edge_offsets=eo,
+                         tree_dense=True, fanouts=(3, 2))
+  params = model.init(jax.random.PRNGKey(0), bd['x'], bd['edge_index'],
+                      bd['edge_mask'])
+  o_ref = np.asarray(model.apply(params, bd['x'], bd['edge_index'],
+                                 bd['edge_mask']))
+  try:
+    M.RUN_SOFTMAX_IMPL = 'window'
+    o_win = np.asarray(model.apply(params, bd['x'], bd['edge_index'],
+                                   bd['edge_mask']))
+  finally:
+    M.RUN_SOFTMAX_IMPL = 'reshape'
+  np.testing.assert_allclose(o_ref, o_win, rtol=1e-5, atol=1e-5)
+
+
+def test_run_impl_decision_rule():
+  """bench.py's auto-land rule (models.run_impl_decision): 'window'
+  needs a > margin win, ties and missing legs keep/record honestly."""
+  from graphlearn_tpu.models.models import run_impl_decision
+  assert run_impl_decision(10.0, 9.0)[0] == 'window'
+  assert run_impl_decision(10.0, 9.9)[0] == 'reshape'     # within noise
+  assert run_impl_decision(10.0, 10.5)[0] == 'reshape'
+  dec, why = run_impl_decision(None, 9.0)
+  assert dec is None and 'reshape leg' in why
+  dec, why = run_impl_decision(10.0, None)
+  assert dec is None and 'window leg' in why
+  assert run_impl_decision(None, None)[0] is None
+
+
 @pytest.mark.parametrize('use_caps', [
     True, pytest.param(False, marks=pytest.mark.slow)])  # tier-1 budget
 def test_hgt_merge_dense_matches_segment(use_caps):

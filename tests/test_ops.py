@@ -420,6 +420,179 @@ def test_gather_rows_hbm_interpret():
   np.testing.assert_allclose(np.asarray(out), table[[96, 0]])
 
 
+def test_gather_rows_hbm_force_misaligned_falls_back():
+  """Regression (ISSUE 13): force=True on a misaligned table width used
+  to reach Mosaic and fail to lower — force must yield to the 128-lane
+  alignment guard (with a warning) and return the bit-identical XLA
+  fallback instead. interpret=True keeps honoring force (the Pallas
+  interpreter has no lane constraint; the v1 test above relies on it)."""
+  import warnings
+  rng = np.random.default_rng(2)
+  table = rng.random((64, 100), np.float32)     # 100 % 128 != 0
+  ids = np.array([3, 0, 63, 17], np.int32)
+  for fn in (ops.gather_rows_hbm, ops.gather_rows_hbm2):
+    with warnings.catch_warnings(record=True) as wlog:
+      warnings.simplefilter('always')
+      out = fn(jnp.asarray(table), jnp.asarray(ids), force=True)
+    assert any('128-lane' in str(w.message) for w in wlog), fn
+    np.testing.assert_array_equal(np.asarray(out), table[ids])
+
+
+def test_plan_gather_runs_covers_every_slot_exactly_once():
+  """The v2 DMA plan is a partition: every slot is written by exactly
+  one copy — its own single, or the full-span run that starts at most
+  run_span-1 slots before it (and full runs never cross a block
+  boundary, never leave the table, and carry strictly consecutive
+  ids)."""
+  rng = np.random.default_rng(3)
+  n, block_rows, span = 500, 16, 4
+  for trial in range(5):
+    ids = np.sort(rng.integers(0, n, 64)).astype(np.int32)
+    if trial == 4:     # fully contiguous best case
+      ids = np.arange(100, 164, dtype=np.int32)
+    plan = np.asarray(ops.plan_gather_runs(jnp.asarray(ids), n,
+                                           block_rows, span))
+    kind, row = ops.decode_gather_plan(plan)
+    assert set(np.unique(kind)) <= {0, 1, 2}   # sign-bit-safe decode
+    np.testing.assert_array_equal(row, ids)
+    writes = np.zeros(ids.shape[0], np.int64)
+    for j, kd in enumerate(kind):
+      if kd == 0:
+        writes[j] += 1
+      elif kd == 1:
+        assert j % block_rows + span <= block_rows   # stays in block
+        assert ids[j] + span <= n                    # stays in table
+        np.testing.assert_array_equal(                # consecutive rows
+            ids[j:j + span], ids[j] + np.arange(span))
+        writes[j:j + span] += 1
+    np.testing.assert_array_equal(writes, 1)
+    if trial == 4:
+      # the contiguous case must actually produce run coverage, and
+      # covered slots must decode as _KIND_COVERED (regression: kind 2
+      # rides the int32 sign bit — a bare >> 30 read it as -2)
+      assert (kind == 1).any() and (kind == 2).any()
+
+
+def test_gather_rows_hbm2_interpret_parity():
+  """v2 kernel vs jnp.take through the interpreter: dtypes f32/bf16/
+  int32, ragged (non-block-multiple) id vectors, duplicate-heavy and
+  sorted-adversarial distributions, presorted fast path, out-of-range
+  clamping."""
+  rng = np.random.default_rng(4)
+  n, f = 300, 128
+  tables = {
+      'f32': rng.standard_normal((n, f)).astype(np.float32),
+      'bf16': jnp.asarray(rng.standard_normal((n, f)),
+                          dtype=jnp.bfloat16),
+      'int32': rng.integers(-5000, 5000, (n, f)).astype(np.int32),
+  }
+  id_sets = {
+      'random-ragged': rng.integers(0, n, 37).astype(np.int32),
+      'dup-heavy': np.repeat(rng.integers(0, n, 6), 7).astype(np.int32),
+      # sorted-adversarial: ascending but with gaps and stutters, so
+      # run detection sees every edge case (gap, dup, exact span)
+      'sorted-adversarial': np.sort(np.concatenate(
+          [np.arange(40, 52), [52, 52, 52], np.arange(200, 204),
+           rng.integers(0, n, 13)])).astype(np.int32),
+      'contig': np.arange(17, 81, dtype=np.int32),
+  }
+  for tname, table in tables.items():
+    tdev = jnp.asarray(table)
+    ref_np = np.asarray(tdev)
+    for iname, ids in id_sets.items():
+      if tname != 'f32' and iname in ('dup-heavy', 'contig'):
+        continue   # dtype coverage x 2 dists suffices; each extra
+        # (dtype, id-shape) pair compiles its own interpret kernel and
+        # the tier-1 wall budget is a guarded resource (conftest canary)
+      out = ops.gather_rows_hbm2(tdev, jnp.asarray(ids), block_rows=16,
+                                 run_span=4, interpret=True)
+      np.testing.assert_array_equal(np.asarray(out), ref_np[ids]), \
+          (tname, iname)
+      if tname == 'f32' and (np.diff(ids) >= 0).all():
+        out = ops.gather_rows_hbm2(tdev, jnp.asarray(ids),
+                                   block_rows=16, run_span=4,
+                                   presorted=True, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), ref_np[ids])
+  # clamping matches take's contract (same as v1)
+  t = jnp.asarray(tables['f32'])
+  out = ops.gather_rows_hbm2(t, jnp.asarray(np.array([900, -3], np.int32)),
+                             block_rows=4, run_span=2, interpret=True)
+  np.testing.assert_array_equal(np.asarray(out),
+                                np.asarray(t)[[n - 1, 0]])
+
+
+def _fused_hop_csr(rng, n, e, hub_deg=0):
+  rows = rng.integers(0, n, e)
+  if hub_deg:
+    rows = np.concatenate([np.zeros(hub_deg, np.int64), rows])
+  cols = rng.integers(0, n, rows.shape[0])
+  order = np.lexsort((cols, rows))
+  rows, cols = rows[order], cols[order]
+  indptr = np.concatenate(
+      [[0], np.cumsum(np.bincount(rows, minlength=n))]).astype(np.int32)
+  return jnp.asarray(indptr), jnp.asarray(cols.astype(np.int32))
+
+
+def test_sample_hop_fused_interpret_parity():
+  """Fused sample+gather hop vs ops.uniform_sample, bit for bit, under
+  the SAME key: uniform degrees, deg <= k keep-all, masked seeds, and a
+  hub whose degree exceeds the staged window (the per-sample row-DMA
+  path) — across windows and both meta/indptr row lookups."""
+  rng = np.random.default_rng(5)
+  n = 150
+  ip, ind = _fused_hop_csr(rng, n, 1200, hub_deg=700)
+  meta = jnp.stack([ip[:-1], ip[1:] - ip[:-1]], 1).astype(jnp.int32)
+  for window in (128, 256):
+    blocks = ops.build_indices128(ind, min_rows=window // 128 + 1)
+    for trial, k in ((0, 5), (1, 12)):
+      key = jax.random.fold_in(jax.random.PRNGKey(1), trial)
+      seeds = jnp.asarray(np.concatenate(
+          [[0], rng.integers(0, n, 23)]).astype(np.int32))
+      mask = jnp.asarray(rng.random(24) < 0.85)
+      # indptr-lookup variant once (window 128 only): each extra config
+      # compiles its own interpret kernel — tier-1 wall budget
+      metas = (meta, None) if window == 128 and k == 5 else (meta,)
+      for m in metas:
+        ref = ops.uniform_sample(ip, ind, seeds, mask, k, key, meta=m)
+        got = ops.sample_hop_fused(ip, ind, blocks, seeds, mask, k, key,
+                                   meta=m, window=window, block_seeds=8,
+                                   interpret=True)
+        for a, b, what in zip(ref, got, ('nbrs', 'epos', 'mask')):
+          np.testing.assert_array_equal(
+              np.asarray(a), np.asarray(b)), (window, k, what)
+
+
+def test_sample_hop_fused_stream_matches_sampler_counters():
+  """Same fold_in counters -> identical edges: a NeighborSampler with
+  use_fused_hop='interpret' (kernel exercised through the Pallas
+  interpreter INSIDE the fused multi-hop program) replays the plain
+  sampler's stream bit for bit across batches — nodes, edges, masks,
+  and the host key counter (GLT_STRICT arms the transfer guards via
+  conftest for this suite's env)."""
+  import graphlearn_tpu as glt
+  from graphlearn_tpu.sampler import NodeSamplerInput
+  rng = np.random.default_rng(6)
+  n, e = 200, 3000
+  rows, cols = rng.integers(0, n, e), rng.integers(0, n, e)
+  g = glt.data.Graph(glt.data.Topology(np.stack([rows, cols]),
+                                       num_nodes=n), 'CPU')
+  for dedup in ('merge', 'tree'):
+    s_ref = glt.sampler.NeighborSampler(g, [4, 3], seed=11, dedup=dedup,
+                                        with_edge=True)
+    s_fh = glt.sampler.NeighborSampler(g, [4, 3], seed=11, dedup=dedup,
+                                       with_edge=True,
+                                       use_fused_hop='interpret',
+                                       fused_hop_window=128)
+    for _ in range(3):
+      seeds = rng.integers(0, n, 16)
+      a = s_ref.sample_from_nodes(NodeSamplerInput(seeds), batch_cap=16)
+      b = s_fh.sample_from_nodes(NodeSamplerInput(seeds), batch_cap=16)
+      for field in ('node', 'row', 'col', 'edge', 'edge_mask'):
+        np.testing.assert_array_equal(np.asarray(getattr(a, field)),
+                                      np.asarray(getattr(b, field)))
+    assert s_ref._call_count == s_fh._call_count
+
+
 # ---------------------------------------------------------------- stitch
 
 def test_stitch_rows():

@@ -199,6 +199,46 @@ def test_scan_trainer_dispatch_count():
   assert dc_loop.counts['sample'] == steps
 
 
+def test_scan_dispatch_budget_with_fused_hop_kernel_routed():
+  """ISSUE 13 acceptance: routing the fused sample+gather Pallas hop
+  into the scanned epoch (use_fused_hop='interpret' exercises the real
+  kernel through the interpreter inside the scan body) keeps the epoch
+  at <= ceil(steps/K) + 2 dispatches under GLT_STRICT (conftest arms it
+  for this module) — the kernel lives INSIDE the chunk program, it adds
+  no dispatch sites — and the epoch stays bit-identical to the
+  XLA-hop scanned epoch: same fold_in counters, same edges, same
+  losses, same params."""
+  import jax
+  ds = make_dataset()
+  num_seeds = 44     # 6 steps at batch 8 (ragged tail), chunk 4
+  chunk, steps = 4, 6
+  model = GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2)
+  first = train_lib.batch_to_dict(next(iter(_make_loader(ds, num_seeds))))
+  state_ref, tx = train_lib.create_train_state(
+      model, jax.random.PRNGKey(0), first)
+  ref = glt.loader.ScanTrainer(_make_loader(ds, num_seeds), model, tx, 3,
+                               chunk_size=chunk)
+  state_ref, losses_ref, _ = ref.run_epoch(state_ref)
+
+  fh_loader = _make_loader(ds, num_seeds, use_fused_hop='interpret')
+  assert fh_loader.sampler.use_fused_hop == 'interpret'
+  state_fh, _ = train_lib.create_train_state(
+      model, jax.random.PRNGKey(0), first, optimizer=tx)
+  trainer = glt.loader.ScanTrainer(fh_loader, model, tx, 3,
+                                   chunk_size=chunk)
+  state_fh, losses_fh, _ = trainer.run_epoch(state_fh)   # compile epoch
+  np.testing.assert_array_equal(np.asarray(losses_fh),
+                                np.asarray(losses_ref))
+  for a, b in zip(jax.tree_util.tree_leaves(state_ref.params),
+                  jax.tree_util.tree_leaves(state_fh.params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+  # steady-state budget with the kernel routed in
+  with glt.utils.count_dispatches() as dc:
+    state_fh, losses_fh, _ = trainer.run_epoch(state_fh)
+  assert len(losses_fh) == steps
+  assert dc.total <= -(-steps // chunk) + 2, dc
+
+
 def test_retrace_budget_catches_chunk_length_perturbation():
   """Acceptance (PR 8): deliberately perturbing the chunk length
   retraces the chunk program, retrace_budget catches it under
