@@ -435,6 +435,19 @@ BENCH_KEY_REGISTRY = {
                               'null when either leg failed)',
     'run_mean_impl_decision_config': 'evidence string behind the '
                                      'decision (both ms + margin rule)',
+    # RUN_SOFTMAX_IMPL decision pair (ISSUE 14, the pending PR 13
+    # copy-tax residual): the dense-GAT run-softmax chain A/B'd on the
+    # RGAT e2e step, auto-decided by the same >3% margin rule
+    # (override per run with GLT_RUN_SOFTMAX_IMPL)
+    'run_softmax_impl_reshape_ms': 'RGAT e2e step ms with '
+                                   'RUN_SOFTMAX_IMPL=reshape',
+    'run_softmax_impl_window_ms': 'RGAT e2e step ms with '
+                                  'RUN_SOFTMAX_IMPL=window',
+    'run_softmax_impl_decision': "auto-landed winner ('reshape'/"
+                                 "'window'; null when either leg "
+                                 'failed)',
+    'run_softmax_impl_decision_config': 'evidence string behind the '
+                                        'softmax decision',
     # kernel campaign r13 (ops/gather_pallas.py v2 + ops/sample_fused.py,
     # benchmarks/prof_gather2.py): device-trace A/B of the run-segmented
     # multi-row DMA gather and the fused sample+gather hop vs their XLA
@@ -459,6 +472,30 @@ BENCH_KEY_REGISTRY = {
     'staged_mb_per_chunk': 'MB staged host->ring per scanned chunk',
     'oversub_bit_identical': 'tiered epoch losses == all-HBM losses',
     'oversub_config': 'graph/tier/oversubscription shape of the figures',
+    # device oversubscription THROUGH the shard exchange (storage/
+    # dist_scan.py, ISSUE 14): a scanned DISTRIBUTED epoch whose shards
+    # hold only hot prefixes + staged exchange slabs, vs the identical
+    # all-HBM DistScanTrainer epoch
+    'dist_oversub_epoch_wall_s': 'tiered dist scanned epoch wall s '
+                                 '(hot prefix + staged slabs)',
+    'dist_oversub_hbm_epoch_wall_s': 'all-HBM DistScanTrainer '
+                                     'reference epoch wall s',
+    'dist_oversub_ratio': 'tiered dist / all-HBM epoch wall '
+                          '(gate: ~1.5x)',
+    'dist_oversub_bit_identical': 'tiered dist epoch losses == all-HBM '
+                                  'losses (exact miss-exchange program)',
+    'dist_oversub_config': 'graph/mesh/prefix/oversubscription shape '
+                           'of the dist_oversub figures',
+    # zero-downtime sharded store rotation (serving/rotation.py): next
+    # version materializes onto per-shard disk tiers while the current
+    # serves, then swaps atomically under live threaded traffic
+    'rotation_swap_ms_p99': 'serving.rotation_swap_ms p99 over the '
+                            'bench rotations (the swap critical '
+                            'section, not the build)',
+    'rotation_failed_requests': 'requests failed during live rotation '
+                                '(gate: 0 — zero-downtime contract)',
+    'rotation_config': 'table/shards/traffic shape of the rotation '
+                       'figures',
     # chunk-granular recovery (recovery/, docs/recovery.md): a scanned
     # epoch checkpointed at the default cadence vs the plain epoch,
     # plus a kill-at-chunk-N + resume measuring the lost-work bound
@@ -511,8 +548,9 @@ BENCH_KEY_REGISTRY = {
 # run_mean_impl_reshape_ms_error)
 BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
-    'hetero_step', 'hetero_ref', 'feature_exchange', 'serving',
-    'oversub', 'recovery', 'remote_scan', 'gather2', 'fused_hop',
+    'run_softmax_impl', 'hetero_step', 'hetero_ref', 'feature_exchange',
+    'serving', 'oversub', 'dist_oversub', 'rotation', 'recovery',
+    'remote_scan', 'gather2', 'fused_hop',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -535,12 +573,18 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'dist_scan_epoch_dispatches', 'dist_scan_epoch_wall_s',
     'feature_exchange_mb_per_batch',
     'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
+    'run_softmax_impl_reshape_ms', 'run_softmax_impl_window_ms',
     # the kernel-campaign ratio pair: a ratio drifting UP means the
     # kernels lost ground vs XLA round over round (compiler regressions
     # included) — gate it like any latency key
     'gather2_vs_take_ratio', 'fused_hop_vs_xla_ratio',
     'embed_epoch_wall_s', 'embed_epoch_dispatches',
     'oversub_epoch_wall_s', 'staged_mb_per_chunk',
+    # the dist-oversubscription gate ratio (~1.5x) and the rotation
+    # pair: the swap critical section's p99 and the zero-downtime
+    # contract itself (any failed request is a regression from 0)
+    'dist_oversub_ratio', 'rotation_swap_ms_p99',
+    'rotation_failed_requests',
     # a checkpoint that gets expensive (bytes) or taxing (overhead)
     # regresses silently otherwise — the issue's gate pair
     'checkpoint_bytes', 'recovery_overhead_pct',
@@ -1176,6 +1220,40 @@ def main():
   except Exception as e:
     result['run_mean_impl_error'] = f'{type(e).__name__}: {e}'[:200]
 
+  # ---- RUN_SOFTMAX_IMPL A/B (the PR 13 copy-tax residual): the
+  # dense-GAT masked run-softmax chain ('window' = flat [f*k, H]
+  # reduce_window, models._masked_run_softmax) measured on the RGAT e2e
+  # step — the conv family that actually runs the softmax — with the
+  # SAME per-leg isolation and >3% auto-decision as run_mean above.
+  # Apply by editing models.RUN_SOFTMAX_IMPL or pinning
+  # GLT_RUN_SOFTMAX_IMPL, citing this record.
+  try:
+    from graphlearn_tpu.models import models as models_lib
+    prev_sm = models_lib.RUN_SOFTMAX_IMPL
+    try:
+      for impl in ('reshape', 'window'):
+        key = f'run_softmax_impl_{impl}_ms'
+        try:
+          models_lib.RUN_SOFTMAX_IMPL = impl
+          tot_i, _, _ = _run_hetero_e2e(
+              jax, f'/tmp/glt_bench_softmax_{impl}', conv='gat')
+          result[key] = round(float(tot_i), 3) if tot_i else None
+        except Exception as e:
+          result[key] = None
+          result[f'{key}_error'] = f'{type(e).__name__}: {e}'[:200]
+    finally:
+      models_lib.RUN_SOFTMAX_IMPL = prev_sm
+    dec, why = models_lib.run_impl_decision(
+        result.get('run_softmax_impl_reshape_ms'),
+        result.get('run_softmax_impl_window_ms'))
+    result['run_softmax_impl_decision'] = dec
+    result['run_softmax_impl_decision_config'] = (
+        f'{why}; basis: RGAT bf16 e2e step; apply by editing '
+        'models.RUN_SOFTMAX_IMPL (or pin GLT_RUN_SOFTMAX_IMPL) citing '
+        'this record')
+  except Exception as e:
+    result['run_softmax_impl_error'] = f'{type(e).__name__}: {e}'[:200]
+
   # ---- kernel campaign r13: gather v2 + fused hop vs their XLA paths
   # (device-trace A/B; ratios < 1.0 flip the per-kernel routing flags —
   # UnifiedTensor.use_pallas_v2 / NeighborSampler(use_fused_hop=True)).
@@ -1404,6 +1482,114 @@ def main():
   except Exception as e:
     result['oversub_epoch_wall_s'] = None
     result['oversub_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- DIST oversubscription through the shard exchange (storage/
+  # dist_scan.py, ISSUE 14): a scanned DISTRIBUTED epoch whose shards
+  # hold only a hot prefix + chunk-staged exchange slabs, A/B'd against
+  # the identical all-HBM DistScanTrainer epoch. Fetch-bearing by
+  # design (the prologue plan fetch + per-chunk slab uploads ARE the
+  # mechanism), so it sits with the other fetch-bearing sections.
+  try:
+    import tempfile
+    import time as _time
+
+    import jax.numpy as jnp
+    import optax
+    from graphlearn_tpu.models import GraphSAGE as _DSAGE
+    from graphlearn_tpu.models import train as _dtrain
+    from graphlearn_tpu.storage import (TieredDistFeature,
+                                        TieredDistScanTrainer)
+    from graphlearn_tpu.typing import GraphPartitionData
+    from jax.sharding import Mesh
+    do_n, do_deg, do_f = 16_384, 4, 64
+    do_p = min(4, max(1, len(jax.devices())))
+    do_batch, do_steps, do_k = 64, 16, 4        # per shard
+    do_rng = np.random.default_rng(31)
+    do_rows = np.repeat(np.arange(do_n), do_deg)
+    do_cols = (do_rows + do_rng.integers(1, do_n, do_rows.shape[0])) % do_n
+    do_pb = (np.arange(do_n) % do_p).astype(np.int32)
+    do_epb = do_pb[do_rows]
+    do_eids = np.arange(do_rows.shape[0])
+    do_labels = do_rng.integers(0, E2E_CLASSES, do_n)
+    do_feats = [(np.nonzero(do_pb == q)[0].astype(np.int64),
+                 do_rng.standard_normal(
+                     (int((do_pb == q).sum()), do_f)).astype(np.float32))
+                for q in range(do_p)]
+    do_parts = []
+    for q in range(do_p):
+      m = do_epb == q
+      do_parts.append(GraphPartitionData(
+          edge_index=np.stack([do_rows[m], do_cols[m]]),
+          eids=do_eids[m]))
+    do_seeds = do_rng.integers(0, do_n, do_p * do_batch * do_steps)
+    do_mesh = Mesh(np.array(jax.devices()[:do_p]), ('g',))
+    n_part = max(ids.shape[0] for ids, _ in do_feats)
+    do_hot = max(1, n_part // 8)                 # 8x >= the 4x gate
+
+    def do_loader(store):
+      dg = glt.distributed.DistGraph(do_p, 0, do_parts, do_pb, do_epb)
+      ds = glt.distributed.DistDataset(do_p, 0, dg, store,
+                                       node_labels=do_labels)
+      return glt.distributed.DistNeighborLoader(
+          ds, [4, 2], do_seeds, batch_size=do_batch, shuffle=False,
+          drop_last=True, seed=0, mesh=do_mesh)
+
+    do_model = _DSAGE(hidden_dim=64, out_dim=E2E_CLASSES, num_layers=2)
+    do_tx = optax.adam(1e-3)
+    hbm_loader = do_loader(glt.distributed.DistFeature(
+        do_p, do_feats, do_pb, do_mesh, split_ratio=0.1))
+    do_first = next(iter(hbm_loader))
+    do_params = do_model.init(jax.random.PRNGKey(0),
+                              np.asarray(do_first.x)[0],
+                              np.asarray(do_first.edge_index)[0],
+                              np.asarray(do_first.edge_mask)[0])
+    # host copy: run_epoch DONATES its state, and a replicated
+    # device_put can alias the original buffers — each arm must start
+    # from FRESH device arrays of the same values (run_scan_ab's rule)
+    do_params_host = jax.tree.map(np.asarray, do_params)
+
+    def do_state():
+      p = jax.tree.map(jnp.asarray, do_params_host)
+      return _dtrain.TrainState(p, do_tx.init(p),
+                                jnp.zeros((), jnp.int32))
+
+    def do_epoch(trainer):
+      state, _, _ = trainer.run_epoch(do_state())     # compile epoch
+      t0 = _time.perf_counter()
+      state, losses, _ = trainer.run_epoch(state)     # measured epoch
+      jax.block_until_ready(losses)
+      return _time.perf_counter() - t0, np.asarray(losses)
+
+    hbm_tr = glt.loader.DistScanTrainer(
+        do_loader(glt.distributed.DistFeature(
+            do_p, do_feats, do_pb, do_mesh, split_ratio=0.1)),
+        do_model, do_tx, E2E_CLASSES, chunk_size=do_k)
+    hbm_wall, hbm_losses = do_epoch(hbm_tr)
+    do_dir = tempfile.mkdtemp(prefix='glt_dist_oversub_')
+    t_tr = TieredDistScanTrainer(
+        do_loader(TieredDistFeature(
+            do_p, do_feats, do_pb, mesh=do_mesh, spill_dir=do_dir,
+            hot_prefix_rows=do_hot, split_ratio=0.1)),
+        do_model, do_tx, E2E_CLASSES, chunk_size=do_k)
+    try:
+      t_wall, t_losses = do_epoch(t_tr)
+    finally:
+      # also on a failed epoch: the stager worker thread (and its
+      # spill-dir mmaps) must not outlive this section
+      t_tr.close()
+    result['dist_oversub_epoch_wall_s'] = round(t_wall, 3)
+    result['dist_oversub_hbm_epoch_wall_s'] = round(hbm_wall, 3)
+    result['dist_oversub_ratio'] = round(t_wall / hbm_wall, 3)
+    result['dist_oversub_bit_identical'] = bool(
+        np.array_equal(hbm_losses, t_losses))
+    result['dist_oversub_config'] = (
+        f'N={do_n}, deg={do_deg}, F={do_f}, P={do_p} mesh, hot prefix '
+        f'{do_hot}/{n_part} rows/shard ({n_part / do_hot:.1f}x '
+        f'oversub), batch {do_batch}/shard x {do_steps} steps, '
+        f'K={do_k}')
+  except Exception as e:
+    result['dist_oversub_epoch_wall_s'] = None
+    result['dist_oversub_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- chunk-granular recovery (recovery/, docs/recovery.md) ----
   # Three measurements on one scanned fixture: (1) plain epoch wall,
@@ -1637,10 +1823,11 @@ def main():
     result['remote_scan_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- serving tier (PR 7): offline materialization + online QPS ----
-  # LAST measured section by design: the serving path fetches rows per
-  # batch (that IS the product — e2e latency includes the fetch), and
-  # on the axon runtime the first fetch degrades later dispatches
-  # (PERF.md), so nothing dispatch-sensitive may run after this point.
+  # The serving sections run LAST by design: the serving path fetches
+  # rows per batch (that IS the product — e2e latency includes the
+  # fetch), and on the axon runtime the first fetch degrades later
+  # dispatches (PERF.md), so nothing dispatch-sensitive may run after
+  # this point (the rotation section below is serving-tier too).
   # A smaller dedicated graph keeps the padded full-neighbor table
   # bounded; the config key records the shape.
   try:
@@ -1722,6 +1909,75 @@ def main():
         '(64, 256, 1024), max_wait 1ms')
   except Exception as e:
     result['serving_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- zero-downtime sharded store rotation (serving/rotation.py) ----
+  # The tentpole's serving half: rotate a RotatingShardedStore through
+  # several materialized versions under live threaded traffic —
+  # every request must be answered exactly once from ONE consistent
+  # version, and the gate pair is the swap critical section's p99 and
+  # the failed-request count (0, the zero-downtime contract).
+  try:
+    import tempfile
+    import threading
+
+    from graphlearn_tpu import metrics as glt_metrics
+    from graphlearn_tpu.serving import RotatingShardedStore, ServingEngine
+    rot_n, rot_f, rot_shards = 50_000, 64, 4
+    rot_rng = np.random.default_rng(13)
+    rot_base = rot_rng.standard_normal((rot_n, rot_f)).astype(np.float32)
+
+    def rot_table(v):
+      # version-tagged tables so a torn read would be detectable
+      return rot_base + np.float32(v)
+
+    glt_metrics.reset('serving.rotation')
+    rot_root = tempfile.mkdtemp(prefix='glt_rotation_')
+    rot_store = RotatingShardedStore(rot_root, rot_shards, rot_table(0),
+                                     warm_rows=1024)
+    rot_engine = ServingEngine(rot_store, buckets=(64, 256),
+                               max_wait_ms=1.0)
+    rot_stop = time.perf_counter() + 2.0
+    rot_done, rot_errs = [], []
+
+    def rot_client(seed):
+      try:
+        crng = np.random.default_rng(seed)
+        n_ok = 0
+        while time.perf_counter() < rot_stop:
+          ids = crng.integers(0, rot_n, 16)
+          rows = rot_engine.lookup(ids)
+          # consistency probe: one version across the whole response
+          vs = np.unique(np.round(rows[:, 0] - rot_base[ids, 0]))
+          assert vs.size == 1, f'torn read across versions: {vs}'
+          n_ok += 1
+        rot_done.append(n_ok)
+      except BaseException as e:  # noqa: BLE001
+        rot_errs.append(e)
+
+    with rot_engine:
+      threads = [threading.Thread(target=rot_client, args=(i,))
+                 for i in range(6)]
+      for th in threads:
+        th.start()
+      n_rot = 0
+      while time.perf_counter() < rot_stop - 0.3:
+        time.sleep(0.35)
+        rot_store.rotate(lambda: rot_table(rot_store.version + 1))
+        n_rot += 1
+      for th in threads:
+        th.join()
+    result['rotation_failed_requests'] = len(rot_errs)
+    if rot_errs:
+      raise RuntimeError(f'{len(rot_errs)} rotation clients failed: '
+                         f'{rot_errs[0]!r}')
+    pct = glt_metrics.histogram('serving.rotation_swap_ms').percentiles()
+    result['rotation_swap_ms_p99'] = round(pct['p99'], 3)
+    result['rotation_config'] = (
+        f'[{rot_n}, {rot_f}] f32 table, {rot_shards} shards (warm 1024 '
+        f'rows/shard, rest mmap), {n_rot} rotations under 6 clients x '
+        '16-id lookups for 2s, buckets (64, 256)')
+  except Exception as e:
+    result['rotation_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # the final device->host fetch, after every trace is captured
   # (PERF.md: the first fetch degrades later dispatches).

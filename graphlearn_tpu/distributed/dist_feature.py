@@ -236,7 +236,7 @@ class DistFeature:
     return s
 
   # ---------------------------------------------------------- program
-  def _shard_body(self, b: int):
+  def _shard_body(self, b: int, slab: bool = False):
     """Per-shard lookup body over UNWRAPPED per-shard views — the core
     of the one-dispatch program, exposed so outer shard_map programs
     (DistScanTrainer's scanned epoch) can inline the exact same
@@ -246,7 +246,19 @@ class DistFeature:
     Returns ``body(feat_ids [n], feats [n, F], pb, cache_ids,
     cache_feats, stats_row [4], ids [b], mask [b]) ->
     (rows [b, F], new_stats_row [4])``. Must be traced on this store's
-    mesh (the exchange collectives run over every mesh axis)."""
+    mesh (the exchange collectives run over every mesh axis).
+
+    ``slab=True`` is the SLAB-BACKED lookup path (device
+    oversubscription through the shard exchange — storage/dist_scan.py,
+    docs/storage.md): ``feats`` is then the pytree ``(hot [H, F],
+    slab_pos [cap], slab_rows [cap, F])`` instead of the full
+    ``[n, F]`` partition — a remote request resolves its position in
+    this shard's sorted id table exactly as before, but the ROW comes
+    from the HBM hot prefix (position < H) or the chunk's staged slab
+    (searchsorted over the staged position list, INT32_MAX pads never
+    match). Under an exact miss-exchange program every requested
+    position >= H is in the slab by construction, so the exchanged
+    bytes are identical to the all-HBM path."""
     import jax
     import jax.numpy as jnp
 
@@ -264,13 +276,32 @@ class DistFeature:
     sizes = tuple(self.mesh.shape[a] for a in ax)
     hier = len(ax) == 2
 
-    def lookup_local(feat_ids, feats, flat):
-      """Rows for a flat request vector over this shard's sorted owned
-      ids (zeros where absent/padded)."""
-      pos = jnp.clip(jnp.searchsorted(feat_ids, flat), 0,
-                     feat_ids.shape[0] - 1)
-      found = feat_ids[pos] == flat
-      return jnp.where(found[:, None], feats[pos], 0)
+    if slab:
+      def lookup_local(feat_ids, feats, flat):
+        """Slab-backed rows for a flat request vector: position from
+        the sorted owned-id table as usual, payload from the hot
+        prefix or the staged slab (zeros where absent/padded — an
+        impossible case for planned rows under an exact program)."""
+        hot, slab_pos, slab_rows = feats
+        pos = jnp.clip(jnp.searchsorted(feat_ids, flat), 0,
+                       feat_ids.shape[0] - 1)
+        found = feat_ids[pos] == flat
+        hp = hot.shape[0]
+        hot_rows = hot[jnp.clip(pos, 0, hp - 1)]
+        sp = jnp.clip(jnp.searchsorted(slab_pos, pos.astype(jnp.int32)),
+                      0, slab_pos.shape[0] - 1)
+        in_slab = slab_pos[sp] == pos.astype(jnp.int32)
+        rows = jnp.where((pos < hp)[:, None], hot_rows,
+                         jnp.where(in_slab[:, None], slab_rows[sp], 0))
+        return jnp.where(found[:, None], rows, 0)
+    else:
+      def lookup_local(feat_ids, feats, flat):
+        """Rows for a flat request vector over this shard's sorted owned
+        ids (zeros where absent/padded)."""
+        pos = jnp.clip(jnp.searchsorted(feat_ids, flat), 0,
+                       feat_ids.shape[0] - 1)
+        found = feat_ids[pos] == flat
+        return jnp.where(found[:, None], feats[pos], 0)
 
     def exchange_flat(feat_ids, feats, pb, req, rmask):
       """Fractional bucketed all_to_all with replicated full-width

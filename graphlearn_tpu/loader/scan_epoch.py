@@ -864,18 +864,16 @@ class DistScanTrainer(DistFusedEpochTrainer):
     start = start_step
     try:
       with strict_guards():
-        record_dispatch('dist_epoch_seeds')
-        seed_mat, mask_mat = self._seed_fn(self._seeds_dev, perm_key,
-                                           full_steps)
+        seed_mat, mask_mat = self._epoch_prologue(
+            perm_key, full_steps, steps, start_step, base_key, count0)
         while start < steps:
           k = min(self.chunk_size, steps - start)
           if self.stage_hook is not None:
             self.stage_hook(start // self.chunk_size, start, k)
-          record_dispatch('dist_scan_chunk')
           with spans.span('epoch.chunk', start=start, k=k):
             params, opt_state, stepc, ovf, stats, loss_k, acc_k = \
-                self._chunk_fn_for(k)(
-                    self._shard_tree, self._repl_tree, stats, params,
+                self._dispatch_chunk(
+                    start // self.chunk_size, k, stats, params,
                     opt_state, stepc, ovf, seed_mat, mask_mat, base_key,
                     count0, jax.device_put(np.int32(start), repl))
           stats_back(stats)
@@ -910,6 +908,37 @@ class DistScanTrainer(DistFusedEpochTrainer):
     self._epochs += 1
     return (self._train_state_cls(params, opt_state, stepc),
             losses, accs, ovf)
+
+  # ---------------------------------------------- exchange-aware seams
+  # The two points where the epoch program touches the feature-storage
+  # topology, split out so the OVERSUBSCRIBED distributed trainer
+  # (storage/dist_scan.py TieredDistScanTrainer) can fold the
+  # miss-exchange replay into the prologue and stage per-chunk slabs
+  # without re-owning the guard/publish/flight bracketing above. Both
+  # run INSIDE strict_guards: anything host-resident they feed the
+  # programs must be an explicit device_put.
+
+  def _epoch_prologue(self, perm_key, full_steps, steps, start_step,
+                      base_key, count0):
+    """ONE prologue dispatch -> (seed_mat, mask_mat) committed to the
+    chunk program's mesh sharding. The base program is the seed
+    permutation alone; the tiered override extends it with the id-only
+    sampler replay whose fetched row matrix becomes the per-chunk
+    miss-exchange program (same dispatch, same budget)."""
+    del steps, start_step  # the base prologue needs no plan extent
+    record_dispatch('dist_epoch_seeds')
+    return self._seed_fn(self._seeds_dev, perm_key, full_steps)
+
+  def _dispatch_chunk(self, c, k, stats, params, opt_state, stepc, ovf,
+                      seed_mat, mask_mat, base_key, count0, start_dev):
+    """Dispatch chunk ``c`` (k steps). The tiered override uploads the
+    chunk's staged exchange slabs (explicit device_puts) and routes
+    through its slab-aware program; the outputs contract is shared."""
+    del c  # the all-HBM chunk program has no per-chunk staging
+    record_dispatch('dist_scan_chunk')
+    return self._chunk_fn_for(k)(
+        self._shard_tree, self._repl_tree, stats, params, opt_state,
+        stepc, ovf, seed_mat, mask_mat, base_key, count0, start_dev)
 
   def _flight_config(self) -> dict:
     """Static epoch-program configuration for flight-record grouping

@@ -45,6 +45,8 @@ REGISTERED_METRICS = frozenset({
     'serving.requests',
     'serving.batches',
     'serving.refreshed',
+    'serving.rotations',
+    'serving.rotation_swap_ms',
     'serving.queue_wait_ms',
     'serving.batch_fill',
     'serving.compute_ms',
@@ -61,6 +63,7 @@ REGISTERED_METRICS = frozenset({
     # tier-occupancy gauges (docs/storage.md)
     'storage.staged_rows',
     'storage.staged_bytes',
+    'storage.dist_staged_rows',
     'storage.prefetch_miss',
     'storage.stage_ms',
     'storage.promote_ms',
@@ -128,6 +131,9 @@ REGISTERED_SPANS = frozenset({
     'serving.batch',
     'serving.compute',
     'serving.respond',
+    # sharded store rotation (serving/rotation.py): one span per
+    # version swap critical section (docs/serving.md)
+    'serving.rotate',
     # out-of-core staging pipeline (storage/staging.py): one span per
     # staged chunk on the worker thread
     'storage.stage',

@@ -20,10 +20,11 @@ drift.
 from .engine import DEFAULT_BUCKETS, ServingEngine
 from .materialize import (EmbeddingMaterializer, padded_neighbors,
                           warm_embedding_store)
+from .rotation import RotatingShardedStore
 from .store import DistEmbeddingStore, EmbeddingStore
 
 __all__ = [
     'DEFAULT_BUCKETS', 'DistEmbeddingStore', 'EmbeddingMaterializer',
-    'EmbeddingStore', 'ServingEngine', 'padded_neighbors',
-    'warm_embedding_store',
+    'EmbeddingStore', 'RotatingShardedStore', 'ServingEngine',
+    'padded_neighbors', 'warm_embedding_store',
 ]

@@ -386,13 +386,15 @@ class EmbeddingMaterializer:
 
   # ------------------------------------------------------------ hetero
 
-  def _hetero_layout(self, t, live_ets):
+  def _hetero_layout(self, t, live_ets, b: Optional[int] = None):
     """Static per-(target type, live etypes) block layout: the order
     and offsets of each result type's buffer segments, plus the
     constant per-out-etype edge arrays. Self rows of type ``t`` lead
     t's buffer; each etype's ``B*W`` neighbor rows append to its result
-    type's buffer in etype order."""
-    b = self.block_size
+    type's buffer in etype order. ``b`` defaults to the materializer
+    block size; the refresh buckets pass their padded capacity (the
+    SAME layout at refresh-bucket scale)."""
+    b = self.block_size if b is None else int(b)
     widths = {t: b}
     offsets = {}
     for et in live_ets:
@@ -676,32 +678,116 @@ class EmbeddingMaterializer:
     self._refresh_fns[cap] = fn
     return fn
 
-  def refresh_rows(self, ids) -> np.ndarray:
+  def _hetero_live_for(self, t):
+    """The etypes feeding target type ``t`` in the LAST conv layer —
+    the same liveness rule _materialize_hetero applies, computed
+    against the penultimate store set."""
+    return tuple(et for et in self._etypes
+                 if self._key_t[et] == t
+                 and self._res_t[et] in self._penultimate)
+
+  def _hetero_refresh_fn_for(self, t, cap: int):
+    """Typed final-layer refresh for a [cap] id bucket of type ``t``:
+    gather the stale nodes' penultimate rows + their per-etype full
+    neighbor rows (the SAME `_hetero_layout` the chunk programs use, at
+    refresh-bucket scale), run the LAST conv layer slice of the
+    training forward for ``t`` — plus the ``lin_out`` head when ``t``
+    is the model's output type, so refreshed rows land in the same
+    space the served table holds."""
+    key = ('het', t, cap)
+    if key in self._refresh_fns:
+      return self._refresh_fns[key]
+    import jax
+    import jax.numpy as jnp
+    live = self._hetero_live_for(t)
+    if not live:
+      raise ValueError(f'type {t!r} receives no messages in the last '
+                       'layer — nothing to refresh')
+    last = self.num_layers - 1
+    slice_fn = train_lib.make_layer_slice_fn(
+        self.model, last, last + 1, embed=False, head=False)
+    head_fn = None
+    if getattr(self.model, 'out_ntype', None) == t:
+      head_fn = train_lib.make_layer_slice_fn(
+          self.model, self.num_layers, self.num_layers, embed=False,
+          head=True)
+    _, ei_np = self._hetero_layout(t, live, b=cap)
+    ei_dev = {oet: jax.device_put(v) for oet, v in ei_np.items()}
+    res_order = [(et, self._res_t[et]) for et in live]
+
+    def refresh(params, stores, nbrs, ids, mask):
+      safe = jnp.maximum(ids, 0)
+      parts = {t: [stores[t][safe]]}
+      masks = {}
+      for et, r in res_order:
+        blk = jnp.where(mask[:, None], nbrs[et][safe], -1)
+        masks[self._out_et[et]] = (blk >= 0).reshape(-1)
+        parts.setdefault(r, []).append(
+            stores[r][jnp.maximum(blk.reshape(-1), 0)])
+      x = {r: (jnp.concatenate(v) if len(v) > 1 else v[0])
+           for r, v in parts.items()}
+      h = slice_fn(params, dict(x=x, edge_index=ei_dev,
+                                edge_mask=masks))[t]
+      if head_fn is not None:
+        h2 = head_fn(params, dict(x={t: h}, edge_index={},
+                                  edge_mask={}))
+        h = h2[t] if isinstance(h2, dict) else h2
+      return h[:cap]
+
+    from ..metrics import programs
+    fn = programs.instrument(jax.jit(refresh), 'serve_refresh')
+    self._refresh_fns[key] = fn
+    return fn
+
+  def _refresh_out_dim(self, ntype=None) -> int:
+    if not self.is_hetero:
+      return int(self.model.out_dim)
+    if getattr(self.model, 'out_ntype', None) == ntype:
+      return int(self.model.out_dim)
+    return int(self.stores[ntype].shape[1])
+
+  def refresh_rows(self, ids, ntype=None) -> np.ndarray:
     """Final-layer-only refresh: recompute the CURRENT last-layer
     embedding rows for ``ids`` from the penultimate store (one bucket
     program per padded capacity — the online engine's stale-node hook).
-    Returns [len(ids), F_out] host rows."""
-    if self.is_hetero:
-      raise NotImplementedError(
-          'final-layer refresh is homogeneous-only for now — '
-          'rematerialize hetero stores offline (docs/serving.md)')
+    Returns [len(ids), F_out] host rows.
+
+    Hetero (RGNN): pass ``ntype`` — rows refresh through the per-type
+    last-layer slice (plus the head when ``ntype`` is the output type),
+    against the SAME per-etype full-neighbor tables the offline pass
+    aggregated over; wire into an engine as
+    ``refresh_fn=lambda ids: mat.refresh_rows(ids, ntype='paper')``."""
     if self._penultimate is None:
       raise RuntimeError('call materialize() first')
     import jax.numpy as jnp
     from .store import pow2_cap
+    if self.is_hetero:
+      if ntype is None:
+        raise ValueError(
+            'hetero refresh needs the node type: '
+            "refresh_rows(ids, ntype='paper') (per-type stores, "
+            'docs/serving.md)')
+      if ntype not in getattr(self, 'stores', {}):
+        raise ValueError(f'{ntype!r} has no final-layer store '
+                         f'(have: {sorted(self.stores)})')
     ids = np.asarray(ids, np.int64).reshape(-1)
     if ids.size == 0:
       # never touch _embeddings here: the caller may have handed that
       # table to an EmbeddingStore whose refresh write-back DONATED it
-      return np.zeros((0, int(self.model.out_dim)), np.float32)
+      return np.zeros((0, self._refresh_out_dim(ntype)), np.float32)
     cap = pow2_cap(ids.size)
     padded = np.full((cap,), -1, np.int32)
     padded[:ids.size] = ids
     mask = padded >= 0
     record_dispatch('serve_refresh')
-    rows = self._refresh_fn_for(cap)(
-        self.params, self._penultimate, self._upload()['nbr'],
-        jnp.asarray(padded), jnp.asarray(mask))
+    if self.is_hetero:
+      rows = self._hetero_refresh_fn_for(ntype, cap)(
+          self.params, self._penultimate, self._upload()['nbr'],
+          jnp.asarray(padded), jnp.asarray(mask))
+    else:
+      rows = self._refresh_fn_for(cap)(
+          self.params, self._penultimate, self._upload()['nbr'],
+          jnp.asarray(padded), jnp.asarray(mask))
     return np.asarray(rows)[:ids.size]
 
 

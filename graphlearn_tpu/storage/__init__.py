@@ -17,16 +17,23 @@ disk reads to the scanned epoch's dispatch cadence.
   unchanged ceil(steps/K)+2 dispatch budget.
 * ``TieredDistFeature`` — per-shard disk-backed rows behind the PR 3
   hot-cache / miss-exchange machinery.
+* ``TieredDistScanTrainer`` — device oversubscription THROUGH the
+  shard exchange: per-shard HBM hot prefixes + chunk-staged exchange
+  slabs against the prologue's exact miss-exchange program, bit
+  -identical to the all-HBM ``DistScanTrainer`` at the same
+  ceil(steps/K)+2 budget.
 """
 from . import planner
 from .disk import DiskTier, spill_array
 from .dist import TieredDistFeature, spill_partitions
+from .dist_scan import DistChunkStager, TieredDistScanTrainer
 from .scan import TieredScanTrainer, tiered_gather
 from .staging import ChunkStager, pad_slab, pow2_slab_cap
 from .tiered import TieredFeature
 
 __all__ = [
     'DiskTier', 'spill_array', 'TieredDistFeature', 'spill_partitions',
+    'DistChunkStager', 'TieredDistScanTrainer',
     'TieredScanTrainer', 'tiered_gather', 'ChunkStager', 'pad_slab',
     'pow2_slab_cap', 'TieredFeature', 'planner',
 ]

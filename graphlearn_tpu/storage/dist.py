@@ -21,12 +21,15 @@ payload to per-partition memory-mapped disk tiers (storage/disk.py):
   the device-side lookup semantics are bit-identical to DistFeature
   built from the same rows (tests/test_storage.py pins it).
 
-Scope note (docs/storage.md): the HBM tier still holds each shard's
-full partition — the exchange program must answer arbitrary remote
-requests in-program. The three-tier *device* oversubscription (hot
-prefix + staged slabs) is the local scanned path
-(storage.TieredScanTrainer); extending it through the shard exchange
-rides the DistScanTrainer chunk hooks and is tracked in ROADMAP.
+Device oversubscription THROUGH the shard exchange (docs/storage.md):
+by default the HBM tier still holds each shard's full partition — the
+exchange program must answer arbitrary remote requests in-program. With
+``hot_prefix_rows=H`` set, :meth:`dist_scan_tables` uploads only the
+first H positions of each partition (plus the small routing
+structures), and ``storage.TieredDistScanTrainer`` answers the
+remaining positions from per-chunk staged slabs computed by the epoch
+prologue's exact miss-exchange program — the
+``DistFeature._shard_body(slab=True)`` lookup path.
 """
 import os
 from typing import Optional
@@ -49,10 +52,16 @@ class TieredDistFeature(DistFeature):
 
   def __init__(self, num_partitions: int, feat_parts, feature_pb,
                mesh=None, dtype=None, spill_dir: Optional[str] = None,
-               rows_per_chunk: int = 65536, fmt: str = 'npy', **kwargs):
+               rows_per_chunk: int = 65536, fmt: str = 'npy',
+               hot_prefix_rows: int = 0, **kwargs):
     self._spill_dir = spill_dir
     self._rows_per_chunk = int(rows_per_chunk)
     self._fmt = fmt
+    # per-partition HBM hot prefix for the oversubscribed scanned path
+    # (storage/dist_scan.py): positions [0, H) of each partition's
+    # sorted row table stay device-resident; the rest stage per chunk
+    self.hot_prefix_rows = int(hot_prefix_rows)
+    self._scan_dev = None
     super().__init__(num_partitions, feat_parts, feature_pb, mesh=mesh,
                      dtype=dtype, **kwargs)
 
@@ -164,6 +173,53 @@ class TieredDistFeature(DistFeature):
           cache_ids=global_device_put(cache_ids, repl),
           cache_feats=global_device_put(cache_feats, repl))
     return self._dev
+
+  def gather_positions(self, p: int, positions: np.ndarray) -> np.ndarray:
+    """Partition-``p`` rows by POSITION in its sorted row table (the
+    staging pipeline's read path — positions are what the miss-exchange
+    program stages and what ``_shard_body(slab=True)`` resolves)."""
+    return self._tiers[p].gather(np.asarray(positions, np.int64))
+
+  def dist_scan_tables(self):
+    """Device arrays for the OVERSUBSCRIBED scanned exchange
+    (storage.TieredDistScanTrainer): the [P, H, F] hot-prefix blocks —
+    positions [0, H) of each partition, bit-identical to the full
+    upload's leading rows — plus the small routing structures
+    (sorted id table, partition book, replicated hot cache). The full
+    [P, n_max, F] table is NEVER uploaded on this path; the remaining
+    positions arrive per chunk as staged slabs."""
+    if self._scan_dev is None:
+      import jax
+      from jax.sharding import NamedSharding, PartitionSpec as P
+
+      from ..utils import global_device_put
+      h = self.hot_prefix_rows
+      if h < 1:
+        raise ValueError(
+            'dist_scan_tables needs hot_prefix_rows >= 1 (the scanned '
+            'chunk program clamps pad positions into the hot prefix) — '
+            'pass hot_prefix_rows=... to TieredDistFeature')
+      shard = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
+      repl = NamedSharding(self.mesh, P())
+      c = self.cache_rows
+      cache_ids = (self.cache_ids if c else
+                   np.full((1,), INT32_MAX, np.int32))
+      cache_feats = (self.cache_feats if c else
+                     np.zeros((1, self.feature_dim), self.storage_dtype))
+      hot = np.zeros((self.num_partitions, h, self.feature_dim),
+                     self.storage_dtype)
+      for p in range(self.num_partitions):
+        n_p = min(h, self._part_rows(p))
+        if n_p:
+          hot[p, :n_p] = self._tiers[p].gather(np.arange(n_p))
+      self._scan_dev = dict(
+          feat_ids=global_device_put(self.feat_ids, shard),
+          hot=global_device_put(hot, shard),
+          feature_pb=global_device_put(self.feature_pb.astype(np.int32),
+                                       repl),
+          cache_ids=global_device_put(cache_ids, repl),
+          cache_feats=global_device_put(cache_feats, repl))
+    return self._scan_dev
 
   def tier_bytes(self) -> dict:
     """Resident vs on-disk byte accounting (sizing guidance,

@@ -1,0 +1,418 @@
+"""TieredDistScanTrainer: device oversubscription THROUGH the shard
+exchange.
+
+``DistScanTrainer`` runs a collocated-mesh epoch as ceil(steps/K)+2
+dispatches, but every shard's HBM still holds its FULL feature
+partition — the in-program all_to_all must be able to answer any
+remote request. This trainer erases that boundary (ROADMAP item 2;
+PyTorch-Direct, arxiv 2101.07956, and GPU-initiated direct storage,
+arxiv 2306.16384, are the GPU-world exemplars — this is the multi-host
+TPU instance):
+
+* **Hot prefix per shard.** Each shard's HBM holds only positions
+  ``[0, H)`` of its sorted partition table
+  (``TieredDistFeature.dist_scan_tables``) plus a double-buffered
+  pow2-padded exchange slab; the rest of the partition lives in the
+  store's host/disk tiers.
+* **Miss-exchange program.** The epoch prologue extends the seed
+  program with an id-only replay of the distributed sampler over every
+  step — the SAME ``split(fold_in(base_key, count), P)`` keys the chunk
+  programs derive, so the draws are bit-identical by the PR 4 replay
+  contract — still ONE ``dist_epoch_seeds`` dispatch. The fetched
+  [P, steps, node_cap] request matrix is the prologue's one explicit
+  ``jax.device_get``; ``planner.plan_exchange`` turns it into the exact
+  per-chunk program: which POSITIONS of each shard's table its peers
+  will request during each chunk, beyond the replicated hot cache and
+  the HBM hot prefix.
+* **Chunk-boundary slab staging.** While chunk ``c`` trains, a
+  ``DistChunkStager`` worker gathers chunk ``c+1``'s planned positions
+  from the per-partition tiers into a [P, cap] / [P, cap, F] host slab
+  (pow2 ``cap`` = the chunk's max per-shard count — one executable per
+  (chunk length, slab cap)); the dispatch thread device_puts it sharded
+  over the mesh and dispatches the chunk.
+* **In-program slab-backed exchange.** The chunk program's feature
+  lookup is ``DistFeature._shard_body(slab=True)``: a remote request
+  resolves its position exactly as before, then gathers ``hot[pos]``
+  for positions < H and ``slab[searchsorted(slab_pos, pos)]`` for the
+  rest — under the exact plan every staged bytes equals the all-HBM
+  row, so LOSSES AND PARAMS ARE BIT-IDENTICAL to ``DistScanTrainer``
+  at the unchanged ceil(steps/K)+2 dispatch budget.
+* **Degradation, never corruption.** A failed/slow staging worker
+  degrades to a synchronous gather of the same planned positions
+  (``storage.prefetch_miss``); the chaos suite completes the epoch
+  bit-identically with a ``storage.dist_stage`` fault armed
+  (docs/failure_model.md).
+
+Scope: homogeneous collocated meshes (flat or 2-axis hierarchical —
+the slab-backed lookup rides both exchange forms). Hetero dist stores
+keep the all-HBM ``DistScanTrainer``. Labels stay a full (small)
+DistFeature. Single-process meshes: the prologue fetch and the stager
+read the whole [P, ...] request matrix / tier set locally.
+"""
+from typing import Optional
+
+import numpy as np
+
+from .. import metrics
+from ..loader.scan_epoch import DistScanTrainer
+from ..utils.faults import fault_point
+from ..utils.trace import record_dispatch
+from . import planner
+from .dist import TieredDistFeature
+from .staging import INT32_MAX, ChunkStager, pow2_slab_cap
+
+
+class DistChunkStager(ChunkStager):
+  """ChunkStager whose plan rows are ENCODED ``p * n_max + position``
+  addresses (planner.ExchangePlan) and whose slabs come back in the
+  [P, cap] per-shard layout the shard_map chunk program consumes.
+  Pad slots carry INT32_MAX positions (never match a searchsorted);
+  per-shard position lists stay sorted because the encoded plan is."""
+
+  def _stage_fault(self):
+    # the dist pipeline's own registered chaos site — worker-only, so
+    # take()'s synchronous fallback still gathers cleanly
+    fault_point('storage.dist_stage')
+
+  def _gather(self, enc: np.ndarray):
+    store = self.store
+    nparts, n_max = store.num_partitions, store.n_max
+    enc = np.asarray(enc, np.int64)
+    owners = enc // n_max
+    pos = enc % n_max
+    counts = (np.bincount(owners, minlength=nparts) if enc.size
+              else np.zeros((nparts,), np.int64))
+    cap = pow2_slab_cap(int(counts.max()) if enc.size else 1)
+    ids = np.full((nparts, cap), INT32_MAX, np.int32)
+    rows = np.zeros((nparts, cap, store.feature_dim),
+                    store.storage_dtype)
+    for p in range(nparts):
+      kp = int(counts[p])
+      if kp:
+        m = owners == p
+        ids[p, :kp] = pos[m].astype(np.int32)
+        rows[p, :kp] = store.gather_positions(p, pos[m])
+    metrics.inc('storage.dist_staged_rows', int(enc.shape[0]))
+    return ids, rows
+
+
+class TieredDistScanTrainer(DistScanTrainer):
+  """DistScanTrainer over a ``TieredDistFeature`` whose HBM holds only
+  each shard's hot prefix + the in-flight exchange slabs (module
+  docstring).
+
+  Args (beyond DistScanTrainer's):
+    max_ahead: staged chunks in flight (2 = double buffer).
+    stage_timeout_s: how long a chunk boundary waits for its slab
+      before degrading to a synchronous gather.
+  """
+
+  _NAME = 'TieredDistScanTrainer'
+
+  def __init__(self, loader, model, tx, num_classes: int,
+               chunk_size: int = 32,
+               seed_labels_only: Optional[bool] = None,
+               perm_seed: Optional[int] = None, max_ahead: int = 2,
+               stage_timeout_s: float = 30.0):
+    sampler = getattr(loader, 'sampler', None)
+    if sampler is not None and getattr(sampler, 'is_hetero', False):
+      raise ValueError(
+          f'{self._NAME} is homogeneous-only — hetero dist stores keep '
+          'the all-HBM loader.DistScanTrainer (per-ntype slab staging '
+          'is tracked in ROADMAP)')
+    store = getattr(sampler, 'dist_feature', None)
+    if not isinstance(store, TieredDistFeature):
+      raise ValueError(
+          f'{self._NAME} drives a storage.TieredDistFeature store '
+          f'(got {type(store).__name__}); use loader.DistScanTrainer '
+          'for all-HBM DistFeature partitions')
+    if store.hot_prefix_rows < 1:
+      raise ValueError(
+          f'{self._NAME} needs TieredDistFeature(hot_prefix_rows >= 1) '
+          '— the chunk program clamps pad positions into the hot '
+          'prefix')
+    self._store = store
+    super().__init__(loader, model, tx, num_classes, chunk_size,
+                     seed_labels_only, perm_seed)
+    self._stager = DistChunkStager(store, max_ahead=max_ahead,
+                                   timeout_s=stage_timeout_s)
+    self.last_plan = None   # ExchangePlan of the most recent epoch
+
+  # ------------------------------------------------------------- programs
+
+  def _make_sample_collate(self):
+    """The base homo sample+collate body with the SLAB-BACKED feature
+    lookup: ``views['f']`` carries (feat_ids, hot) instead of the full
+    partition, and the body takes the chunk's per-shard slab views as
+    two extra arguments. The label store stays a full (small)
+    DistFeature."""
+    import jax.numpy as jnp
+    sampler = self._sampler
+    b = self._batch_size
+    label_cap = self._label_cap
+
+    from ..distributed.dist_neighbor_sampler import _homo_hop_loop
+    fanouts = tuple(sampler.num_neighbors)
+    caps = sampler._capacities(b)
+    node_cap = sampler._node_cap(caps)
+    dedup = sampler.dedup
+    weighted = sampler._weighted_for()
+    bucket_frac = sampler.bucket_frac
+    ax, sizes, nparts = self._axes, self._axis_sizes, self._nparts
+    feat_body = self._feat._shard_body(node_cap, slab=True)
+    lab_body = self._label_store._shard_body(
+        label_cap if label_cap is not None else node_cap)
+    d = sampler._dev
+    gsh = {k: d[k] for k in ('row_ids', 'indptr', 'indices', 'eids')}
+    if weighted:
+      gsh['wcum'] = d['wcum']
+    # hot-prefix tables only — the full [P, n_max, F] partition table is
+    # never uploaded on this path (device_arrays stays the per-step
+    # loaders' contract)
+    fdev = self._store.dist_scan_tables()
+    ldev = self._label_store.device_arrays()
+    shard_tree = dict(
+        g=gsh,
+        f={k: fdev[k] for k in ('feat_ids', 'hot')},
+        l={k: ldev[k] for k in ('feat_ids', 'feats')})
+    repl_tree = dict(
+        pb=d['node_pb'],
+        f={k: fdev[k] for k in ('feature_pb', 'cache_ids',
+                                'cache_feats')},
+        l={k: ldev[k] for k in ('feature_pb', 'cache_ids',
+                                'cache_feats')})
+
+    def body(views, repl, stats_rows, seeds, smask, key, slab_pos,
+             slab_rows):
+      res = _homo_hop_loop(views['g'], repl['pb'], seeds, smask, key,
+                           fanouts, caps, node_cap, nparts, False,
+                           weighted, dedup=dedup,
+                           bucket_frac=bucket_frac, axes=ax,
+                           axis_sizes=sizes)
+      ids = res['node']
+      fv, frep = views['f'], repl['f']
+      x, srow = feat_body(fv['feat_ids'],
+                          (fv['hot'], slab_pos, slab_rows),
+                          frep['feature_pb'], frep['cache_ids'],
+                          frep['cache_feats'], stats_rows, ids, ids >= 0)
+      lab_ids = ids[:label_cap] if label_cap is not None else ids
+      lv, lrep = views['l'], repl['l']
+      y, _ = lab_body(lv['feat_ids'], lv['feats'], lrep['feature_pb'],
+                      lrep['cache_ids'], lrep['cache_feats'],
+                      jnp.zeros((4,), jnp.int32), lab_ids, lab_ids >= 0)
+      batch = dict(x=x,
+                   edge_index=jnp.stack([res['row'], res['col']]),
+                   edge_mask=res['edge_mask'], y=y[:, 0],
+                   num_seed_nodes=res['num_sampled_nodes'][0])
+      return batch, res['overflow'], srow
+
+    return shard_tree, repl_tree, body
+
+  def _build_seed_fn(self):
+    """The prologue PLAN program: the base seed/permutation math PLUS
+    an id-only replay of the distributed sampler over every step inside
+    one shard_map — emitting the [P, steps, node_cap] request matrix
+    alongside the sharded seed matrices. One dispatch, fetched once;
+    the keys are exactly the chunk programs'
+    ``split(fold_in(base_key, count), P)[shard]`` stream, so the
+    replayed requests ARE the chunk requests, bit for bit."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed.dist_neighbor_sampler import _homo_hop_loop
+    from ..utils.compat import shard_map
+    sampler = self._sampler
+    batch = self._batch_size
+    nparts = self._nparts
+    shuffle = self.loader.shuffle
+    fanouts = tuple(sampler.num_neighbors)
+    caps = sampler._capacities(batch)
+    node_cap = sampler._node_cap(caps)
+    dedup = sampler.dedup
+    weighted = sampler._weighted_for()
+    bucket_frac = sampler.bucket_frac
+    ax, sizes = self._axes, self._axis_sizes
+    mesh = self.mesh
+    gspec = jax.tree.map(lambda _: P(ax), self._shard_tree['g'])
+
+    def plan(gsh, pb, seeds, key, base_key, count0, steps):
+      def body(gsh_s, pb_s, seeds_s, key_s, base_key_s, count0_s):
+        gviews = jax.tree.map(lambda a: a[0], gsh_s)
+        my = jnp.int32(0)
+        for a in ax:
+          my = my * mesh.shape[a] + lax.axis_index(a)
+        n = seeds_s.shape[0]
+        # the SAME permutation math as DistScanTrainer._build_seed_fn
+        # (replicated computation per shard): arange + cyclic ragged
+        # tail, or the on-device epoch permutation
+        order = (jax.random.permutation(key_s, n) if shuffle
+                 else jnp.arange(n, dtype=jnp.int32))
+        total = steps * nparts * batch
+        if total <= n:
+          ext = order[:total]
+          maskf = jnp.ones((total,), bool)
+        else:
+          pad = order[jnp.arange(total - n, dtype=jnp.int32) % n]
+          ext = jnp.concatenate([order, pad])
+          maskf = jnp.arange(total) < n
+        seed_all = seeds_s[ext].reshape(steps, nparts, batch)
+        mask_all = maskf.reshape(steps, nparts, batch)
+        seeds_my = jnp.take(seed_all, my, axis=1)    # [steps, B]
+        mask_my = jnp.take(mask_all, my, axis=1)
+        counts = count0_s + lax.iota(jnp.int32, steps)
+
+        def step(carry, xs):
+          s, m, cnt = xs
+          keys = jax.random.split(
+              jax.random.fold_in(base_key_s, cnt), nparts)
+          res = _homo_hop_loop(gviews, pb_s, s, m, keys[my], fanouts,
+                               caps, node_cap, nparts, False, weighted,
+                               dedup=dedup, bucket_frac=bucket_frac,
+                               axes=ax, axis_sizes=sizes)
+          return carry, res['node']
+
+        _, rows = lax.scan(step, 0, (seeds_my, mask_my, counts))
+        return seeds_my[None], mask_my[None], rows[None]
+
+      fn = shard_map(body, mesh=mesh,
+                     in_specs=(gspec, P(), P(), P(), P(), P()),
+                     out_specs=(P(ax), P(ax), P(ax)),
+                     check_replication=False)
+      return fn(gsh, pb, seeds, key, base_key, count0)
+
+    return jax.jit(plan, static_argnums=(6,))
+
+  def _chunk_fn_for(self, k: int, cap: Optional[int] = None):
+    """The slab-aware scanned K-step shard_map program, keyed by
+    (chunk length, slab cap) — pow2 caps keep the executable set
+    closed. Arg order extends the base program's with the two slab
+    arrays at the END, so the donation set (stats + train state +
+    overflow) is unchanged; slabs are fresh per chunk and never
+    donated."""
+    if cap is None:   # the base signature — unreachable via our seam
+      raise TypeError(f'{self._NAME}._chunk_fn_for needs the slab cap')
+    ck = (k, cap)
+    if ck in self._chunk_fns:
+      return self._chunk_fns[ck]
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..metrics import programs
+    from ..utils.compat import shard_map
+    ax = self._axes
+    mesh = self.mesh
+    nparts = self._nparts
+    sc_body = self._sc_body
+    dp = self._dp_step_body
+
+    def body(shard_tree, repl_tree, stats, params, opt_state, stepc,
+             ovf, seed_mat, mask_mat, base_key, count0, start, slab_pos,
+             slab_rows):
+      views = jax.tree.map(lambda a: a[0], shard_tree)
+      stats_rows = stats[0]
+      sp_v, sr_v = slab_pos[0], slab_rows[0]
+      seeds_k = lax.dynamic_slice_in_dim(seed_mat[0], start, k, 0)
+      masks_k = lax.dynamic_slice_in_dim(mask_mat[0], start, k, 0)
+      counts_k = count0 + start + lax.iota(jnp.int32, k)
+      my = jnp.int32(0)
+      for a in ax:
+        my = my * mesh.shape[a] + lax.axis_index(a)
+
+      def step(carry, xs):
+        params, opt_state, stepc, ovf, srows = carry
+        seeds, smask, count = xs
+        keys = jax.random.split(jax.random.fold_in(base_key, count),
+                                nparts)
+        batch, overflow, srows = sc_body(views, repl_tree, srows, seeds,
+                                         smask, keys[my], sp_v, sr_v)
+        state, loss, acc = dp(
+            self._train_state_cls(params, opt_state, stepc), batch)
+        return (state.params, state.opt_state, state.step,
+                ovf | overflow, srows), (loss, acc)
+
+      (params, opt_state, stepc, ovf, srows), (losses, accs) = lax.scan(
+          step, (params, opt_state, stepc, ovf, stats_rows),
+          (seeds_k, masks_k, counts_k))
+      return (params, opt_state, stepc, ovf, srows[None], losses, accs)
+
+    sh = jax.tree.map(lambda _: P(ax), self._shard_tree)
+    rp = jax.tree.map(lambda _: P(), self._repl_tree)
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(sh, rp, P(ax), P(), P(), P(), P(), P(ax), P(ax),
+                  P(), P(), P(), P(ax), P(ax)),
+        out_specs=(P(), P(), P(), P(), P(ax), P(), P()),
+        check_replication=False)
+    jfn = programs.instrument(
+        jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6)), 'dist_scan_chunk')
+    self._chunk_fns[ck] = jfn
+    return jfn
+
+  # ------------------------------------------------ exchange-aware seams
+
+  def _epoch_prologue(self, perm_key, full_steps, steps, start_step,
+                      base_key, count0):
+    """One plan dispatch + the prologue's ONE explicit fetch: the
+    replayed request matrix becomes the per-chunk miss-exchange
+    program, and staging starts at the resume chunk (consumed chunks
+    never stage again)."""
+    import jax
+    record_dispatch('dist_epoch_seeds')
+    seed_mat, mask_mat, rows_mat = self._seed_fn(
+        self._shard_tree['g'], self._repl_tree['pb'], self._seeds_dev,
+        perm_key, base_key, count0, full_steps)
+    # explicit device_get — strict_guards rejects implicit transfers only
+    rows_host = np.asarray(jax.device_get(rows_mat))[:, :steps]
+    plan = planner.plan_exchange(
+        rows_host, self.chunk_size, self._store.feature_pb,
+        self._store.feat_ids, self._store.hot_prefix_rows,
+        cache_ids=self._store.cache_ids)
+    self.last_plan = plan
+    self._stager.begin_epoch(plan.chunk_rows,
+                             start_chunk=start_step // self.chunk_size)
+    return seed_mat, mask_mat
+
+  def _dispatch_chunk(self, c, k, stats, params, opt_state, stepc, ovf,
+                      seed_mat, mask_mat, base_key, count0, start_dev):
+    """Take chunk ``c``'s staged slab (or degrade to a synchronous
+    gather of the same planned positions), upload it sharded over the
+    mesh (explicit device_puts — the strict region stays clean), and
+    dispatch the (k, cap) program. The ack frees the host ring slot;
+    the device copies belong to the in-flight program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils import global_device_put
+    slab_pos_np, slab_rows_np = self._stager.take(c)
+    sharded = NamedSharding(self.mesh, P(self._axes))
+    slab_pos = global_device_put(slab_pos_np, sharded)
+    slab_rows = global_device_put(slab_rows_np, sharded)
+    record_dispatch('dist_scan_chunk')
+    out = self._chunk_fn_for(k, int(slab_pos_np.shape[1]))(
+        self._shard_tree, self._repl_tree, stats, params, opt_state,
+        stepc, ovf, seed_mat, mask_mat, base_key, count0, start_dev,
+        slab_pos, slab_rows)
+    self._stager.ack(c)
+    return out
+
+  # ---------------------------------------------------------- lifecycle
+
+  def _flight_config(self) -> dict:
+    cfg = super()._flight_config()
+    cfg.update(hot_prefix_rows=self._store.hot_prefix_rows,
+               n_max=self._store.n_max)
+    return cfg
+
+  def _recovery_capture(self, carry):
+    """DistScanTrainer's capture plus the staging-ring watermarks
+    (diagnostic — a resume re-plans and re-stages)."""
+    meta, dev = super()._recovery_capture(carry)
+    meta['staging'] = self._stager.watermarks()
+    return meta, dev
+
+  def close(self):
+    """Stop the staging worker thread."""
+    self._stager.close()

@@ -186,7 +186,10 @@ class ChunkStager:
         with spans.span('storage.stage', chunk=int(c),
                         rows=int(rows_abs.shape[0])):
           t0 = time.perf_counter()
-          fault_point('storage.stage')
+          # worker-only fault seam: armed faults fire HERE, never in
+          # take()'s synchronous fallback — the degraded path must be
+          # able to gather the same planned rows cleanly
+          self._stage_fault()
           ids, rows = self._gather(rows_abs)
           metrics.observe('storage.stage_ms',
                           (time.perf_counter() - t0) * 1e3)
@@ -207,6 +210,12 @@ class ChunkStager:
         with self._lock:
           self.stage_done_t[c] = slab.t_done
         slab.ready.set()
+
+  def _stage_fault(self):
+    """The worker-thread fault site (chaos suite). Subclasses override
+    with their own registered literal name (the dist staging pipeline's
+    ``storage.dist_stage``, storage/dist_scan.py)."""
+    fault_point('storage.stage')
 
   def _gather(self, rows_abs: np.ndarray):
     rows = self.store.stage_gather(rows_abs)

@@ -62,6 +62,8 @@ REGISTERED_SITES = frozenset({
     'heartbeat.probe',
     'storage.stage',
     'storage.promote',
+    'storage.dist_stage',
+    'serving.rotate',
     'remote.block_stage',
     'remote.block_fetch',
     'recovery.save',

@@ -1,0 +1,159 @@
+"""Zero-downtime rotating sharded serving stores (serving/rotation.py).
+
+The acceptance contract (docs/serving.md): a rotation completes under
+LIVE threaded traffic with every request answered exactly once from a
+single consistent version (no torn reads across the swap — version
+tags in the table values would expose one), and an armed
+``serving.rotate`` fault mid-swap degrades to the PREVIOUS version
+with zero failed requests.
+"""
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu import metrics as glt_metrics
+from graphlearn_tpu.serving import RotatingShardedStore, ServingEngine
+from graphlearn_tpu.utils import faults
+
+N, F = 2000, 8
+V_TAG = 100000.0   # version tag added to every row: torn reads show up
+
+
+def table_for(v):
+  return ((np.arange(N, dtype=np.float32)[:, None] + V_TAG * v)
+          * np.ones((1, F), np.float32))
+
+
+def make_store(tmp, shards=4, warm_rows=64):
+  return RotatingShardedStore(tmp, shards, table_for(0),
+                              warm_rows=warm_rows)
+
+
+def versions_of(rows, ids):
+  """Per-row version tags decoded from a response block."""
+  return np.round((rows[:, 0] - ids) / V_TAG).astype(int)
+
+
+def test_store_surface_and_shard_routing(tmp_path):
+  """Direct store checks: shard-routed lookups equal the version
+  table exactly (warm prefix AND mmap tail, pad slots zero), rows are
+  immutable within a version, and version indices advance."""
+  store = make_store(str(tmp_path))
+  assert store.version == 0 and store.granularity == 1
+  assert store.num_nodes == N and store.feature_dim == F
+  ids = np.array([0, 1, 63, 64, 499, 500, 1999, -1], np.int64)
+  mask = ids >= 0
+  rows = store.fetch(store.lookup(ids, mask))
+  ref = table_for(0)
+  np.testing.assert_array_equal(rows[:-1], ref[ids[:-1]])
+  assert not rows[-1].any()   # pad slot zeroed
+  with pytest.raises(NotImplementedError, match='rotat'):
+    store.update_rows(np.array([0]), np.zeros((1, F), np.float32))
+  assert store.rotate(lambda: table_for(1)) == 1
+  rows2 = store.fetch(store.lookup(ids, mask))
+  np.testing.assert_array_equal(rows2[:-1], table_for(1)[ids[:-1]])
+  # num_nodes guards: a too-short next version is refused pre-swap
+  with pytest.raises(ValueError, match='version table'):
+    store.install_version(np.zeros((N - 1, F), np.float32))
+  assert store.version == 1
+
+
+def test_rotation_under_live_traffic_exactly_once(tmp_path):
+  """Rotate twice while threaded clients hammer the engine: every
+  request is answered exactly once, every response comes from ONE
+  version (no torn reads), and the rotation metrics fire."""
+  c0 = glt_metrics.default_registry().counters()
+  store = make_store(str(tmp_path))
+  engine = ServingEngine(store, buckets=(16, 64), max_wait_ms=0.5)
+  stop_t = time.perf_counter() + 1.6
+  errors, torn, counts = [], [], []
+
+  def client(seed):
+    rng = np.random.default_rng(seed)
+    n_ok = 0
+    try:
+      while time.perf_counter() < stop_t:
+        ids = rng.integers(0, N, 8)
+        rows = engine.lookup(ids)
+        vs = np.unique(versions_of(rows, ids))
+        if vs.size != 1:
+          torn.append(vs)
+        n_ok += 1
+      counts.append(n_ok)
+    except BaseException as e:  # noqa: BLE001
+      errors.append(e)
+
+  with engine:
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(4)]
+    for th in threads:
+      th.start()
+    for v in (1, 2):
+      time.sleep(0.4)
+      assert store.rotate(lambda _v=v: table_for(_v)) == v
+    for th in threads:
+      th.join()
+  assert not errors, errors[:1]
+  assert not torn, torn[:1]
+  assert store.version == 2 and sum(counts) > 0
+  # disk retention one rotation deep: after the flip to v2 only
+  # v1/v2 tiers survive — per-rotation table copies must not
+  # accumulate without bound
+  import os
+  held = sorted(d for d in os.listdir(str(tmp_path))
+                if d.startswith('v'))
+  assert held == ['v0001', 'v0002'], held
+  c1 = glt_metrics.default_registry().counters()
+  assert c1.get('serving.rotations', 0) - c0.get('serving.rotations',
+                                                 0) == 3  # init + 2
+  # exactly-once: the engine's request counter grew by the client count
+  assert c1.get('serving.requests', 0) - c0.get(
+      'serving.requests', 0) == sum(counts)
+
+
+def test_failed_shard_swap_serves_previous_version(tmp_path):
+  """Chaos (docs/failure_model.md): an armed ``serving.rotate`` fault
+  fails a mid-pass shard swap — the partial version is discarded, the
+  PREVIOUS version keeps serving every request (zero failures), and
+  a later clean rotation succeeds."""
+  store = make_store(str(tmp_path), shards=4)
+  engine = ServingEngine(store, buckets=(16, 64), max_wait_ms=0.5)
+  stop_t = time.perf_counter() + 1.0
+  errors, bad_version, served = [], [], []
+
+  def client():
+    rng = np.random.default_rng(11)
+    n_ok = 0
+    try:
+      while time.perf_counter() < stop_t:
+        ids = rng.integers(0, N, 8)
+        rows = engine.lookup(ids)
+        vs = np.unique(versions_of(rows, ids))
+        if vs.tolist() != [0]:
+          bad_version.append(vs)
+        n_ok += 1
+      served.append(n_ok)
+    except BaseException as e:  # noqa: BLE001
+      errors.append(e)
+
+  with engine:
+    th = threading.Thread(target=client)
+    th.start()
+    with faults.injected('serving.rotate', 'raise', after=2):
+      with pytest.raises(faults.FaultError):
+        store.rotate(lambda: table_for(7))
+      _, fired = faults.stats('serving.rotate')
+    th.join()
+  assert fired == 1
+  assert store.version == 0          # degraded: previous version serves
+  assert not errors and not bad_version, (errors[:1], bad_version[:1])
+  assert sum(served) > 0
+  # the store is not wedged: a clean rotation still lands (version
+  # indices keep moving forward past the failed attempt's spill)
+  assert store.rotate(lambda: table_for(2)) == 1
+  rows = store.fetch(store.lookup(np.arange(4), np.ones(4, bool)))
+  np.testing.assert_array_equal(versions_of(rows, np.arange(4)),
+                                np.full(4, 2))

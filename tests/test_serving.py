@@ -194,6 +194,50 @@ def test_materialized_hetero_gat_matches_direct():
                              atol=1e-4)
 
 
+def test_hetero_final_layer_refresh_parity():
+  """The ISSUE-7 gap closed (ISSUE 14): hetero (RGNN) stale nodes
+  refresh through the per-type LAST-layer slice (+ the lin_out head
+  for the output type) via the existing refresh-bucket machinery —
+  refreshed rows match the direct full forward, and the engine's
+  mark_stale path serves fresh rows over a poisoned store."""
+  import jax
+  ds, stored = make_hetero_dataset()
+  rev = glt.typing.reverse_edge_type
+  model = RGNN(etypes=(rev(stored[0]), rev(stored[1])), hidden_dim=8,
+               out_dim=4, num_layers=2, out_ntype='paper')
+  xd, eid, emd = hetero_full_batch(ds, stored)
+  params = model.init(jax.random.PRNGKey(0), xd, eid, emd)
+  direct = np.asarray(model.apply(params, xd, eid, emd))
+  mat = EmbeddingMaterializer(ds, model, params, block_size=8,
+                              chunk_size=8)
+  mat.materialize()
+
+  # direct parity: typed refresh == direct forward rows (head applied)
+  ids = np.array([0, 3, 17, 39])
+  rows = mat.refresh_rows(ids, ntype='paper')
+  np.testing.assert_allclose(rows, direct[ids], rtol=1e-4, atol=1e-5)
+  # per-type error contract + the empty-bucket path
+  with pytest.raises(ValueError, match='ntype'):
+    mat.refresh_rows(ids)
+  with pytest.raises(ValueError, match='final-layer store'):
+    mat.refresh_rows(ids, ntype='nope')
+  assert mat.refresh_rows(np.zeros((0,)), ntype='paper').shape == (0, 4)
+
+  # engine path: poison a row, mark stale, next lookup serves fresh —
+  # through the SAME refresh-bucket machinery as the homo path
+  store = EmbeddingStore(np.asarray(mat.embeddings), num_nodes=40)
+  engine = ServingEngine(
+      store, buckets=(16,), max_wait_ms=0.5,
+      refresh_fn=lambda i: mat.refresh_rows(i, ntype='paper'))
+  store.update_rows(np.array([17]), np.full((1, 4), 1e9, np.float32))
+  engine.mark_stale([17])
+  with engine:
+    out = engine.lookup(np.array([17, 3]))
+  np.testing.assert_allclose(out, direct[[17, 3]], rtol=1e-4,
+                             atol=1e-5)
+  assert engine.stale_count() == 0
+
+
 def _slice_roundtrip(model, x, ei, em):
   import jax
   params = model.init(jax.random.PRNGKey(0), x, ei, em)
