@@ -411,6 +411,22 @@ BENCH_KEY_REGISTRY = {
                            'programs (null without GLT_PROGRAM_COST)',
     'program_peak_hbm_mb': 'max per-program peak-HBM estimate, MB '
                            '(args+out+temps-aliased; null w/o cost)',
+    # one-call autotune + run-as-a-program (ISSUE 15, graphlearn_tpu/
+    # tune/ + loader/run_epoch.py, docs/tuning.md): the one-call cost
+    # of landing on the fast path, and the whole-run dispatch budget
+    # vs per-epoch scans on the same stream (bit-identical arms)
+    'tune_wall_s': 'tune() wall seconds on the bench fixture (probes + '
+                   'observatory-scored candidate A/Bs + artifact)',
+    'tune_chosen_config': 'the chosen knob assignment + winner + '
+                          'artifact fingerprint (evidence string)',
+    'run_epoch_dispatches': 'RunTrainer dispatches for the E-epoch run '
+                            '(pin: ceil(E*steps/K) + 2)',
+    'run_wall_s': 'RunTrainer steady-state E-epoch run wall seconds',
+    'run_vs_per_epoch_ratio': 'run wall / E sequential ScanTrainer '
+                              'epoch walls (< 1.0 = the folded run '
+                              'wins; arms bit-identical)',
+    'run_scan_config': 'E/steps/K/batch shape + both arms\' dispatch '
+                       'counts behind the run_scan figures',
     # scanned DISTRIBUTED epoch (PR 4)
     'dist_epoch_dispatches': 'per-step collocated dist epoch dispatches',
     'dist_epoch_wall_s': 'per-step collocated dist epoch wall seconds',
@@ -550,7 +566,7 @@ BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
     'run_softmax_impl', 'hetero_step', 'hetero_ref', 'feature_exchange',
     'serving', 'oversub', 'dist_oversub', 'rotation', 'recovery',
-    'remote_scan', 'gather2', 'fused_hop',
+    'remote_scan', 'gather2', 'fused_hop', 'tune', 'run_scan',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -566,6 +582,10 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'epoch_time_s', 'epoch_time_s_exact', 'epoch_time_s_tree',
     'epoch_time_s_scanned',
     'epoch_dispatches', 'scan_epoch_wall_s', 'scan_epoch_device_trace_s',
+    # the run-as-a-program gate pair: the whole-run dispatch budget and
+    # the run/per-epoch wall ratio (a ratio drifting up means the
+    # folded run lost its dispatch-tax win round over round)
+    'run_epoch_dispatches', 'run_vs_per_epoch_ratio',
     # retraces and compile seconds regress silently; the gate catches a
     # round-over-round jump (a new chunk length, a dtype drift)
     'retrace_count', 'compile_time_s_total',
@@ -1121,6 +1141,110 @@ def main():
     result['program_peak_hbm_mb'] = agg['program_peak_hbm_mb']
   except Exception as e:
     result['scan_epoch_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- one-call autotune (graphlearn_tpu/tune/, docs/tuning.md) -----
+  # tune() on the bench fixture: calibration probes + observatory-
+  # scored candidate A/Bs -> a validated config artifact. The wall is
+  # the whole one-call cost (the thing an operator pays ONCE instead of
+  # hand-picking ~10 knobs); the chosen-config string is the evidence
+  # trail for the trajectory table.
+  try:
+    t0 = time.perf_counter()
+    tune_art = glt.tune(
+        ds, dict(fanouts=FANOUT, input_nodes=train_idx[:2048],
+                 batch_size=256, num_classes=E2E_CLASSES))
+    tune_wall = time.perf_counter() - t0
+    result['tune_wall_s'] = round(tune_wall, 3)
+    _winner = [e for e in tune_art.evidence
+               if e.get('kind') == 'winner'][0]
+    ch = tune_art.choices
+    result['tune_chosen_config'] = (
+        f"mode={ch['mode']} caps={ch['frontier_caps']} "
+        f"K={ch['chunk_k']} split={ch['split_ratio']} "
+        f"bucket_frac={ch['bucket_frac']} wire={ch['wire_dtype']} "
+        f"slab={ch['slab_cap']} buckets={ch['serving_buckets']} "
+        f"winner={_winner['name']} by {_winner['tie_break']}, "
+        f"fingerprint {tune_art.fingerprint[:12]}")
+  except Exception as e:
+    result['tune_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- run-as-a-program (loader/run_epoch.py, docs/tuning.md) -------
+  # RunTrainer folds an E-epoch RUN into ceil(E*steps/K)+2 dispatches
+  # vs E*(ceil(steps/K)+2) for per-epoch ScanTrainer calls. Both arms
+  # run a compile pass then a measured steady-state pass from FRESH
+  # states (run_scan_ab's donation rule); losses must stay
+  # bit-identical between arms — the ratio is a pure dispatch-tax
+  # claim, not a semantics trade.
+  try:
+    from graphlearn_tpu.models import GraphSAGE
+    from graphlearn_tpu.models import train as train_lib
+    from graphlearn_tpu.utils import count_dispatches
+    rs_epochs, rs_steps, rs_k, rs_batch = 3, 8, 4, 1024
+    rs_seeds = train_idx[:rs_batch * rs_steps]
+
+    def rs_loader():
+      return glt.loader.NeighborLoader(
+          ds, FANOUT, rs_seeds, batch_size=rs_batch, shuffle=True,
+          drop_last=True, seed=0, dedup='map', frontier_caps=cal_caps,
+          seed_labels_only=True, overflow_policy='off')
+
+    rs_model = GraphSAGE(hidden_dim=64, out_dim=E2E_CLASSES,
+                         num_layers=len(FANOUT))
+    rs_first = train_lib.batch_to_dict(next(iter(rs_loader())))
+
+    def rs_state(tx=None):
+      if tx is None:
+        return train_lib.create_train_state(
+            rs_model, jax.random.PRNGKey(0), rs_first)
+      return train_lib.create_train_state(
+          rs_model, jax.random.PRNGKey(0), rs_first, optimizer=tx)[0]
+
+    # per-epoch arm: compile pass (E epochs), then the measured pass
+    pe_state, rs_tx = rs_state()
+    pe = glt.loader.ScanTrainer(rs_loader(), rs_model, rs_tx,
+                                E2E_CLASSES, chunk_size=rs_k)
+    for _ in range(rs_epochs):
+      pe_state, pe_losses, _ = pe.run_epoch(pe_state)
+    jax.block_until_ready(pe_losses)
+    pe_state = rs_state(rs_tx)
+    pe_all = []
+    with count_dispatches() as pe_dc:
+      t0 = time.perf_counter()
+      for _ in range(rs_epochs):
+        pe_state, pe_losses, _ = pe.run_epoch(pe_state)
+        pe_all.append(pe_losses)
+      jax.block_until_ready(pe_losses)
+      pe_wall = time.perf_counter() - t0
+    pe_all = np.concatenate([np.asarray(x) for x in pe_all])
+
+    # run arm: one RunTrainer over the same stream — compile run, then
+    # the measured steady-state run from a fresh state. track_eval
+    # OFF: the ratio is the pure dispatch-tax claim, so the run arm
+    # must not pay the in-carry eval forward the per-epoch arm lacks
+    run_state = rs_state(rs_tx)
+    rt = glt.RunTrainer(rs_loader(), rs_model, rs_tx, E2E_CLASSES,
+                        chunk_size=rs_k, epochs=rs_epochs,
+                        track_eval=False)
+    run_state, run_losses, _ = rt.run(run_state)
+    jax.block_until_ready(run_losses)
+    run_state = rs_state(rs_tx)
+    with count_dispatches() as run_dc:
+      t0 = time.perf_counter()
+      run_state, run_losses, _ = rt.run(run_state)
+      jax.block_until_ready(run_losses)
+      run_wall = time.perf_counter() - t0
+    bit_identical = bool(np.array_equal(np.asarray(run_losses), pe_all))
+    result['run_epoch_dispatches'] = run_dc.total
+    result['run_wall_s'] = round(run_wall, 3)
+    result['run_vs_per_epoch_ratio'] = round(run_wall / pe_wall, 3)
+    result['run_scan_config'] = (
+        f'E={rs_epochs} steps/epoch={rs_steps} K={rs_k} '
+        f'batch={rs_batch} run_dispatches={run_dc.total} '
+        f'per_epoch_dispatches={pe_dc.total} '
+        f'per_epoch_wall_s={round(pe_wall, 3)} '
+        f'bit_identical={bit_identical}')
+  except Exception as e:
+    result['run_scan_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- scanned DISTRIBUTED epoch: dist-epoch-as-a-program ----------
   # The collocated mesh loop's counterpart of the keys above: the

@@ -38,10 +38,13 @@ if _os.environ.get('JAX_PLATFORMS'):
     pass   # backend already initialized (config then already applied)
 
 from . import (channel, data, distributed, loader, metrics, models, ops,
-               partition, recovery, sampler, serving, storage, typing,
-               utils)
+               partition, recovery, sampler, serving, storage, tune,
+               typing, utils)
 # the epoch executors are the package's training entry points — exported
-# at the root alongside their loader-submodule homes
-from .loader import OverlappedTrainer, ScanTrainer
+# at the root alongside their loader-submodule homes. `tune` is the
+# one-call autotuner (a CALLABLE subpackage: graphlearn_tpu.tune(ds,
+# cfg) emits the fast-path config artifact — docs/tuning.md); RunTrainer
+# is the whole-run-as-a-program executor (loader/run_epoch.py).
+from .loader import OverlappedTrainer, RunTrainer, ScanTrainer
 
 __version__ = '0.1.0'

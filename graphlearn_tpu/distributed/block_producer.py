@@ -184,12 +184,14 @@ class BlockSampleProducer:
     """This epoch's seed order, memoized one epoch at a time (every
     block of an epoch shares it — recomputing a large share's
     permutation per block would be O(n * blocks)). shuffle=False is
-    the identity (the failover/bit-identity contract); shuffle=True
-    draws an EPOCH-ADDRESSED permutation (pure function of
-    (seed, epoch)) so a resume replays the same order — but the
-    per-batch path's stateful host rng draws a different stream, so
-    shuffle epochs trade the bit-identity-to-per-batch contract for
-    coverage-only equality."""
+    the identity (the bit-identity-to-the-per-batch-path contract);
+    shuffle=True draws an EPOCH-ADDRESSED permutation (pure function
+    of (seed, epoch)) so a resume — or a survivor's failover replay
+    producer (remote_scan.py, round 15) — reproduces the same order
+    exactly. The per-batch path's stateful host rng draws a different
+    stream, so shuffle epochs trade the bit-identity-to-per-batch
+    contract for coverage-only equality; block-path failover and
+    resume stay bit-exact either way."""
     cached = self._order_cache
     if cached is not None and cached[0] == epoch:
       return cached[1]

@@ -35,10 +35,14 @@ cluster"):
   unify here: a block is acked when its chunk is dispatched; a dead
   server's UNFETCHED blocks are re-replayed by survivors from the same
   counter stream, bit-identically (blocks are pure functions of the
-  share + config + epoch + batch range). Failover requires
-  ``shuffle=False`` — the deterministic epoch order is what survivors
-  replay. Frames already fetched client-side survive the death: a
-  killed server loses at most the in-flight block.
+  share + config + epoch + batch range). ``shuffle=True`` epochs fail
+  over just as exactly: the server-side epoch permutation is
+  EPOCH-ADDRESSED — a pure function of (stream seed, epoch), not a
+  stateful host rng — so a survivor's replay producer draws the
+  identical order (the constraint the per-batch loaders still carry;
+  lifted here in round 15, chaos-tested for exact coverage under a
+  mid-epoch kill). Frames already fetched client-side survive the
+  death: a killed server loses at most the in-flight block.
 * **Degrade-to-sync, never corruption.** A failed/slow stager worker
   falls back to a synchronous fetch of the SAME block on the dispatch
   thread (``remote.prefetch_miss``) — identical bytes, just slower,
@@ -268,8 +272,11 @@ class RemoteScanTrainer:
     batch_size: per optimizer step.
     chunk_size: K, batches per block/chunk (the tail block compiles
       once more at its own length).
-    shuffle: epoch-addressed server-side shuffle. ``False`` is the
-      bit-identity + failover contract (docs/remote_scan.md).
+    shuffle: epoch-addressed server-side shuffle — a pure function of
+      (stream seed, epoch), so shuffled epochs keep exact chunk
+      failover and resume. ``False`` additionally holds the
+      bit-identity-to-the-per-batch-path contract (the per-batch
+      loaders' host rng is stateful; docs/remote_scan.md).
     drop_last: drop the ragged tail batch.
     worker_options: RemoteDistSamplingWorkerOptions — server_rank,
       heartbeat/failover tunables, ``block_wire_dtype`` /
@@ -528,12 +535,13 @@ class RemoteScanTrainer:
   # ----------------------------------------------------------- failover
 
   def _require_failover(self):
-    if self._shuffle:
-      raise RuntimeError(
-          'chunk-staged failover requires shuffle=False: survivors '
-          're-replay a dead server\'s blocks from the deterministic '
-          'counter stream (docs/remote_scan.md); a shuffled epoch has '
-          'no such contract — restart the epoch')
+    # shuffle=True is failover-safe on THIS path (unlike the per-batch
+    # remote loaders): the server permutation is epoch-addressed — a
+    # pure function of (stream seed, epoch), block_producer._epoch_order
+    # — so a survivor's replay producer draws the identical order and
+    # re-replays the dead rank's blocks bit-identically
+    # (tests/test_remote_scan.py pins exact coverage after a mid-epoch
+    # kill with shuffle=True)
     if not self._failover_enabled:
       raise RuntimeError(
           'sampling server died and failover is disabled '

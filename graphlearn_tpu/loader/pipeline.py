@@ -46,9 +46,10 @@ _DIST_REMOTE_MSG = (
     'RemoteScanTrainer, the chunk-staged hybrid (docs/remote_scan.md): '
     'sampling servers replay the counter-addressed stream into K-batch '
     'blocks, the client double-buffers block c+1 over RPC while chunk '
-    'c trains, and acks/failover run at CHUNK granularity (failover '
-    'needs shuffle=False — survivors re-replay a dead server\'s blocks '
-    'from the same counter stream). Mp-worker loaders keep the '
+    'c trains, and acks/failover run at CHUNK granularity — exact '
+    'even under shuffle=True, whose epoch permutation is a pure '
+    'function of (seed, epoch) that survivors replay identically. '
+    'Mp-worker loaders keep the '
     'per-step host loop: their worker-restart replay acks batches one '
     'by one (docs/failure_model.md).')
 

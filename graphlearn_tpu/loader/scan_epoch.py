@@ -74,6 +74,21 @@ from .pipeline import (_RECOMPUTE_MSG, DistFusedEpochTrainer,
                        FusedEpochTrainer)
 
 
+def _resolve_tuned_config(trainer_name: str, dataset, chunk_size,
+                          config) -> int:
+  """Resolve the chunk size from an explicit value or a tune-artifact
+  ``config=`` (graphlearn_tpu/tune/, docs/tuning.md). An artifact is
+  validated against the loader's dataset BY FINGERPRINT — a tuned
+  config on a drifted graph refuses loudly, the recovery-snapshot
+  refusal contract. Duck-typed (validate_dataset + trainer_kwargs) so
+  the loader package never imports tune/."""
+  if config is not None:
+    config.validate_dataset(dataset, where=trainer_name)
+    if chunk_size is None:
+      chunk_size = config.trainer_kwargs()['chunk_size']
+  return 32 if chunk_size is None else int(chunk_size)
+
+
 def _recovery_config_for(trainer) -> dict:
   """The snapshot-fingerprint config (recovery/checkpoint.py): the
   flight grouping config PLUS every stream-determining knob it omits —
@@ -129,11 +144,16 @@ class ScanTrainer(FusedEpochTrainer):
   ack_hook = None
 
   def __init__(self, loader: NodeLoader, model, tx, num_classes: int,
-               chunk_size: int = 32,
+               chunk_size: Optional[int] = None,
                seed_labels_only: Optional[bool] = None,
-               perm_seed: Optional[int] = None):
+               perm_seed: Optional[int] = None, config=None):
     import jax
     super().__init__(loader, model, tx, num_classes, seed_labels_only)
+    # config= takes a tune artifact (graphlearn_tpu.tune(),
+    # docs/tuning.md): dataset-fingerprint-validated, supplies the
+    # tuned chunk K when chunk_size is not given explicitly
+    chunk_size = _resolve_tuned_config(self._NAME, loader.data,
+                                       chunk_size, config)
     if chunk_size < 1:
       raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
     self.chunk_size = int(chunk_size)
@@ -542,11 +562,16 @@ class DistScanTrainer(DistFusedEpochTrainer):
   ack_hook = None
 
   def __init__(self, loader, model, tx, num_classes: int,
-               chunk_size: int = 32,
+               chunk_size: Optional[int] = None,
                seed_labels_only: Optional[bool] = None,
-               perm_seed: Optional[int] = None):
+               perm_seed: Optional[int] = None, config=None):
     import jax
     super().__init__(loader, model, tx, num_classes, seed_labels_only)
+    # config= takes a tune artifact (docs/tuning.md); a DistDataset
+    # has no homogeneous fingerprint, so validation degrades to the
+    # artifact's warning path rather than a spurious refusal
+    chunk_size = _resolve_tuned_config(self._NAME, loader.data,
+                                       chunk_size, config)
     if chunk_size < 1:
       raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
     self.chunk_size = int(chunk_size)

@@ -47,6 +47,7 @@ REGISTERED_METRICS = frozenset({
     'serving.refreshed',
     'serving.rotations',
     'serving.rotation_swap_ms',
+    'serving.rotation_errors',
     'serving.queue_wait_ms',
     'serving.batch_fill',
     'serving.compute_ms',
@@ -103,6 +104,17 @@ REGISTERED_METRICS = frozenset({
     'recovery.resumes',
     'recovery.resume_chunks',
     'recovery.rollbacks',
+    # one-call autotuner (graphlearn_tpu/tune/, docs/tuning.md):
+    # observatory-scored candidate A/Bs behind the config artifact
+    'tune.candidates',
+    'tune.rejected',
+    'tune.probe_ms',
+    'tune.artifacts',
+    # run-as-a-program (loader/run_epoch.py): whole-run scans with
+    # in-carry eval + early stop — host-side schedule counters only
+    # (the stop point itself is device state, read from the report)
+    'run.runs',
+    'run.epochs_scheduled',
 })
 
 # The closed inventory of SPAN names (metrics/spans.py) — the same
@@ -147,4 +159,12 @@ REGISTERED_SPANS = frozenset({
     # rolled-back chunk index in its attrs (docs/recovery.md)
     'checkpoint.save',
     'recovery.resume',
+    # one-call autotuner (tune/tuner.py): one span per tune() run, one
+    # per candidate A/B (compile + steady epochs inside)
+    'tune.run',
+    'tune.candidate',
+    # run-as-a-program (loader/run_epoch.py): one span wrapping the
+    # whole multi-epoch run; the inherited epoch.run/epoch.chunk spans
+    # parent under it
+    'run.train',
 })

@@ -20,11 +20,11 @@ drift.
 from .engine import DEFAULT_BUCKETS, ServingEngine
 from .materialize import (EmbeddingMaterializer, padded_neighbors,
                           warm_embedding_store)
-from .rotation import RotatingShardedStore
+from .rotation import RotatingShardedStore, RotationScheduler
 from .store import DistEmbeddingStore, EmbeddingStore
 
 __all__ = [
     'DEFAULT_BUCKETS', 'DistEmbeddingStore', 'EmbeddingMaterializer',
-    'EmbeddingStore', 'RotatingShardedStore', 'ServingEngine',
-    'padded_neighbors', 'warm_embedding_store',
+    'EmbeddingStore', 'RotatingShardedStore', 'RotationScheduler',
+    'ServingEngine', 'padded_neighbors', 'warm_embedding_store',
 ]

@@ -242,3 +242,136 @@ class RotatingShardedStore:
         'RotatingShardedStore rows are immutable within a version — '
         'refresh by rotating in the next materialized version '
         '(rotate(), docs/serving.md)')
+
+
+class RotationScheduler:
+  """Drives ``RotatingShardedStore.rotate`` on a schedule — the
+  materializer loop that turns the zero-downtime swap primitive into a
+  PRODUCTION refresh cadence (ROADMAP 2d; docs/serving.md 'Scheduled
+  rotation').
+
+  A daemon thread polls every ``poll_s`` seconds and triggers one full
+  rotation (``build_fn`` -> ``install_version``) when EITHER fires:
+
+  * **interval**: ``interval_s`` seconds elapsed since the last
+    successful rotation (wall-clock freshness floor), or
+  * **staleness**: ``staleness_fn()`` returned truthy — the
+    workload-aware trigger (typical: a closure over the engine's
+    stale set or an ingestion watermark; the scheduler imposes no
+    schema on it).
+
+  Failure semantics match the store's: a failed BUILD or SWAP keeps
+  the previous version serving (``serving.rotation_errors`` counts it,
+  the next poll retries — chaos-tested with the ``serving.rotate``
+  fault armed in tests/test_rotation.py). A ``staleness_fn`` that
+  raises counts as not-stale: observability hooks must never take the
+  serving path down.
+
+  ``stop()`` is join-semantics: the thread exits its current poll (or
+  finishes an in-flight rotation — rotations are never interrupted
+  mid-swap) and joins within ``stop(timeout)``.
+  """
+
+  def __init__(self, store, build_fn: Callable[[], np.ndarray],
+               interval_s: Optional[float] = None,
+               staleness_fn: Optional[Callable[[], bool]] = None,
+               poll_s: float = 0.5):
+    if interval_s is None and staleness_fn is None:
+      raise ValueError('RotationScheduler needs a trigger: interval_s '
+                       'and/or staleness_fn')
+    if interval_s is not None and interval_s <= 0:
+      raise ValueError(f'interval_s must be > 0, got {interval_s}')
+    self.store = store
+    self.build_fn = build_fn
+    self.interval_s = None if interval_s is None else float(interval_s)
+    self.staleness_fn = staleness_fn
+    self.poll_s = float(poll_s)
+    self.rotations = 0         # successful rotations this scheduler ran
+    self.failures = 0          # failed attempts (previous version kept)
+    self.last_error: Optional[str] = None
+    self._last_rotate = time.monotonic()
+    self._stop = threading.Event()
+    self._wake = threading.Event()   # stop/rotate_now interrupt a poll
+    self._thread: Optional[threading.Thread] = None
+
+  # ------------------------------------------------------------ lifecycle
+
+  def start(self) -> 'RotationScheduler':
+    if self._thread is not None and self._thread.is_alive():
+      return self
+    self._stop.clear()
+    # the interval clock runs from START, not construction — a
+    # scheduler built during process setup and started after warmup
+    # must not fire a full build+swap on its first poll
+    self._last_rotate = time.monotonic()
+    self._thread = threading.Thread(target=self._loop, daemon=True,
+                                    name='glt-rotation-scheduler')
+    self._thread.start()
+    return self
+
+  def stop(self, timeout: float = 30.0):
+    """Signal the loop to exit and join it. An in-flight rotation
+    completes first — the swap critical section is never abandoned
+    half-installed (the store's atomicity contract)."""
+    self._stop.set()
+    self._wake.set()
+    t = self._thread
+    if t is not None:
+      t.join(timeout=timeout)
+      if t.is_alive():
+        raise TimeoutError(
+            f'rotation scheduler did not stop within {timeout}s (a '
+            'rotation build is still running; it will finish on the '
+            'daemon thread)')
+    self._thread = None
+
+  def rotate_now(self):
+    """Force the next poll to rotate regardless of triggers."""
+    self._force = True
+    self._wake.set()
+
+  _force = False
+
+  # ----------------------------------------------------------------- loop
+
+  def _due(self) -> bool:
+    if self._force:
+      return True
+    if self.interval_s is not None and \
+        time.monotonic() - self._last_rotate >= self.interval_s:
+      return True
+    if self.staleness_fn is not None:
+      try:
+        return bool(self.staleness_fn())
+      except Exception:  # noqa: BLE001 - a broken probe must not kill serving
+        metrics.inc('serving.rotation_errors')
+        import logging
+        logging.getLogger('graphlearn_tpu.serving').exception(
+            'rotation staleness_fn raised — treating as not-stale')
+    return False
+
+  def _loop(self):
+    while not self._stop.is_set():
+      if self._due():
+        try:
+          self.store.rotate(self.build_fn)
+          # a forced request is consumed only by a SUCCESSFUL rotation
+          # — a failed build keeps the force armed so the next poll
+          # retries it (the docstring's retry contract holds even for
+          # staleness-only schedulers whose probe reads False)
+          self._force = False
+          self.rotations += 1
+          self.last_error = None
+          # interval restarts from the SUCCESS; a failure below keeps
+          # the old deadline so the next poll retries immediately
+          self._last_rotate = time.monotonic()
+        except Exception as e:  # noqa: BLE001 - degrade, keep serving
+          self.failures += 1
+          self.last_error = f'{type(e).__name__}: {e}'
+          metrics.inc('serving.rotation_errors')
+          import logging
+          logging.getLogger('graphlearn_tpu.serving').warning(
+              'scheduled rotation failed (%s) — previous version '
+              'keeps serving; retrying next poll', self.last_error)
+      self._wake.wait(self.poll_s)
+      self._wake.clear()

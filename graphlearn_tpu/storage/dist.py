@@ -136,7 +136,29 @@ class TieredDistFeature(DistFeature):
     """Upload the shard table straight from the disk tiers: each
     addressable shard's [n_max, F] block is assembled transiently in
     the make_array_from_callback callback — whole-table host RAM is
-    never allocated."""
+    never allocated.
+
+    OVERSUBSCRIBED stores refuse this path: with ``hot_prefix_rows``
+    set, the operator declared that a shard's full partition does NOT
+    fit in HBM — uploading the full [P, n_max, F] table anyway (which
+    is what every per-step consumer of device_arrays does) would
+    silently defeat the oversubscription, or OOM on a real topology.
+    The scanned path (``storage.TieredDistScanTrainer`` over
+    ``dist_scan_tables()``) is the supported consumer; the loud error
+    here is ROADMAP 2b's per-step scope gap made explicit."""
+    if self.hot_prefix_rows > 0:
+      raise RuntimeError(
+          f'TieredDistFeature(hot_prefix_rows={self.hot_prefix_rows}) '
+          'is OVERSUBSCRIBED: device_arrays() would upload the full '
+          f'[{self.num_partitions}, {self.n_max}, {self.feature_dim}] '
+          'partition table to HBM, silently defeating the declared '
+          'oversubscription (or OOMing at real scale). The per-step '
+          'distributed loader path has no slab-staging story — drive '
+          'this store through storage.TieredDistScanTrainer (the '
+          'scanned exchange over dist_scan_tables(), docs/storage.md '
+          "'Device oversubscription through the shard exchange'), or "
+          'construct it with hot_prefix_rows=0 to accept the full '
+          'upload')
     if self._dev is None:
       import jax
       from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,36 @@
+"""One-call autotuned fast-path configuration (docs/tuning.md).
+
+``tune(dataset, loader_cfg)`` runs the calibration probes + short
+observatory-scored candidate A/Bs and emits a versioned,
+sha1-fingerprinted :class:`TuneArtifact` that the scan trainers and
+the serving engine accept directly via ``config=`` — every scenario
+lands on the fast path from one call, and a config that would retrace
+is rejected by construction.
+"""
+from .artifact import (ARTIFACT_VERSION, TuneArtifact,
+                       dataset_fingerprint)
+from .tuner import (Candidate, default_candidates,
+                    retrace_probe_candidate, score_candidate, tune)
+
+__all__ = [
+    'ARTIFACT_VERSION', 'TuneArtifact', 'dataset_fingerprint',
+    'Candidate', 'default_candidates', 'retrace_probe_candidate',
+    'score_candidate', 'tune',
+]
+
+# `graphlearn_tpu.tune(dataset, loader_cfg)` IS the advertised one
+# call (README quickstart) — make the subpackage itself callable so
+# the package attribute serves both as the namespace
+# (tune.TuneArtifact) and as the entry point. Module-class override is
+# the supported mechanism (the module object's type gains __call__);
+# nothing else about import semantics changes.
+import sys as _sys
+
+
+class _CallableTuneModule(type(_sys.modules[__name__])):
+
+  def __call__(self, dataset, loader_cfg, **kwargs):
+    return tune(dataset, loader_cfg, **kwargs)
+
+
+_sys.modules[__name__].__class__ = _CallableTuneModule

@@ -1,0 +1,211 @@
+"""The tuned-config artifact: a versioned, fingerprinted, evidence-
+carrying JSON record of every fast-path knob `tune()` chose.
+
+The fast path spans ~10 coupled knobs (dedup mode, frontier caps,
+padded window, cache split, wire dtype, scan chunk K, staging slab
+caps, serving buckets). An artifact pins one consistent assignment of
+ALL of them, together with:
+
+* a **dataset fingerprint** (node/edge counts, feature dim, a sha1 of
+  the degree sequence) — the constructors that accept a ``config=``
+  artifact (ScanTrainer / DistScanTrainer / TieredScanTrainer /
+  ServingEngine) refuse a drifted dataset by fingerprint, the same
+  loud-refusal contract the recovery snapshots use for drifted
+  configs (docs/recovery.md);
+* an **evidence log**: for every knob, the probe that chose it and the
+  measured values behind the choice — including the observatory
+  verdict on each candidate A/B (a candidate whose steady-state epoch
+  retraced is recorded as rejected WITH the signature diff naming the
+  drifted argument, metrics/programs.py);
+* a whole-artifact sha1 **fingerprint** over (version, dataset,
+  choices) so two artifacts are comparable at a glance and a
+  hand-edited one is self-evidently no longer the tuner's.
+
+The artifact is plain JSON (docs/tuning.md documents the schema):
+ship it with the model checkpoint, load it anywhere, and every
+constructor lands on the same program population.
+"""
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+#: bump when the schema changes shape (loaders refuse unknown versions)
+ARTIFACT_VERSION = 1
+
+#: the knob set every artifact carries (docs/tuning.md knob table) —
+#: a choices dict is validated against this closed set on load
+CHOICE_KEYS = frozenset({
+    'mode', 'frontier_caps', 'padded_window', 'wire_dtype', 'chunk_k',
+    'split_ratio', 'bucket_frac', 'slab_cap', 'serving_buckets',
+    'batch_size', 'fanouts', 'exact',
+})
+
+
+def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
+  """Identity of the graph a config was tuned FOR: shape counts plus a
+  sha1 of the degree sequence (the host-side Topology CSR — never a
+  device fetch, the calibrate.py convention). Returns None when the
+  dataset carries no homogeneous graph to fingerprint (hetero dicts,
+  partition-only dist datasets) — validation then degrades to a
+  warning, never a spurious refusal."""
+  graph = getattr(dataset, 'graph', dataset)
+  if graph is None or isinstance(graph, dict):
+    return None
+  src = getattr(graph, 'topo', graph)
+  indptr = getattr(src, 'indptr', None)
+  if indptr is None:
+    return None
+  indptr = np.asarray(indptr, np.int64)
+  deg = np.diff(indptr)
+  fp = dict(
+      num_nodes=int(indptr.shape[0] - 1),
+      num_edges=int(indptr[-1]),
+      degree_sha1=hashlib.sha1(
+          np.ascontiguousarray(deg).tobytes()).hexdigest()[:16])
+  indices = getattr(src, 'indices', None)
+  if indices is not None:
+    # degree sequences alone can collide (a regular graph rewires
+    # without changing any degree) — fold in a deterministic strided
+    # sample of the adjacency targets, bounded at ~1M entries so the
+    # fingerprint stays O(1M) work at any graph scale
+    idx = np.asarray(indices)
+    stride = max(1, idx.shape[0] // 1_000_000)
+    fp['edges_sha1'] = hashlib.sha1(
+        np.ascontiguousarray(idx[::stride].astype(np.int64))
+        .tobytes()).hexdigest()[:16]
+  feats = getattr(dataset, 'node_features', None)
+  if feats is not None and not isinstance(feats, dict):
+    shape = getattr(feats, 'shape', None)
+    if shape is not None and len(shape) > 1:
+      fp['feature_dim'] = int(shape[1])
+  return fp
+
+
+def _canonical(obj) -> str:
+  return json.dumps(obj, sort_keys=True, separators=(',', ':'),
+                    default=str)
+
+
+def compute_fingerprint(version: int, dataset_fp: Optional[dict],
+                        choices: dict) -> str:
+  payload = dict(version=version, dataset=dataset_fp, choices=choices)
+  return hashlib.sha1(_canonical(payload).encode()).hexdigest()
+
+
+class TuneArtifact:
+  """One tuned configuration + the evidence that chose it.
+
+  Attributes:
+    choices: the knob assignment (CHOICE_KEYS; docs/tuning.md table).
+    dataset: the dataset fingerprint the config was tuned for.
+    evidence: list of probe/candidate records — each names the knob(s)
+      it informed, the measured values, and (for candidate A/Bs) the
+      observatory verdict: compiles / retraces / the disqualifying
+      signature diff / cost attribution / steady-state wall.
+    fingerprint: sha1 over (version, dataset, choices).
+  """
+
+  def __init__(self, choices: Dict[str, Any],
+               dataset: Optional[Dict[str, Any]] = None,
+               evidence: Optional[List[dict]] = None):
+    unknown = set(choices) - CHOICE_KEYS
+    if unknown:
+      raise ValueError(f'unknown choice keys {sorted(unknown)} — the '
+                       f'artifact knob set is closed (docs/tuning.md)')
+    self.version = ARTIFACT_VERSION
+    self.choices = dict(choices)
+    self.dataset = dict(dataset) if dataset is not None else None
+    self.evidence = list(evidence or [])
+    self.fingerprint = compute_fingerprint(self.version, self.dataset,
+                                           self.choices)
+
+  # ------------------------------------------------------------- (de)ser
+
+  def to_json(self) -> dict:
+    return dict(version=self.version, fingerprint=self.fingerprint,
+                dataset=self.dataset, choices=self.choices,
+                evidence=self.evidence)
+
+  @classmethod
+  def from_json(cls, obj: dict) -> 'TuneArtifact':
+    v = obj.get('version')
+    if v != ARTIFACT_VERSION:
+      raise ValueError(f'unsupported tune-artifact version {v!r} '
+                       f'(this build reads version {ARTIFACT_VERSION})')
+    art = cls(obj['choices'], obj.get('dataset'),
+              obj.get('evidence'))
+    stored = obj.get('fingerprint')
+    if stored is not None and stored != art.fingerprint:
+      raise ValueError(
+          f'tune-artifact fingerprint mismatch: stored {stored}, '
+          f'recomputed {art.fingerprint} — the file was edited after '
+          'the tuner emitted it; re-run tune() instead of hand-patching '
+          'a signed artifact (docs/tuning.md)')
+    return art
+
+  def save(self, path: str) -> str:
+    with open(path, 'w') as f:
+      json.dump(self.to_json(), f, indent=2, sort_keys=True)
+      f.write('\n')
+    return path
+
+  @classmethod
+  def load(cls, path: str) -> 'TuneArtifact':
+    with open(path) as f:
+      return cls.from_json(json.load(f))
+
+  # ---------------------------------------------------------- validation
+
+  def validate_dataset(self, dataset, where: str = 'config'):
+    """Refuse a dataset that drifted from the one this config was
+    tuned for — a tuned cap/cache/chunk assignment on a different
+    graph silently loses the evidence behind every choice. Degrades to
+    a no-op when either side has no computable fingerprint (hetero /
+    partitioned datasets)."""
+    if self.dataset is None:
+      return
+    fp = dataset_fingerprint(dataset)
+    if fp is None:
+      import warnings
+      warnings.warn(
+          f'{where}: dataset has no computable fingerprint — tuned '
+          'config accepted unvalidated', RuntimeWarning, stacklevel=3)
+      return
+    drift = {k: (self.dataset.get(k), fp.get(k))
+             for k in set(self.dataset) | set(fp)
+             if self.dataset.get(k) != fp.get(k)}
+    if drift:
+      raise ValueError(
+          f'{where}: tuned-config dataset fingerprint mismatch '
+          f'{drift} — this artifact was tuned for a different graph '
+          '(artifact fingerprint '
+          f'{self.fingerprint}); re-run graphlearn_tpu.tune() on the '
+          'current dataset (docs/tuning.md)')
+
+  # --------------------------------------------------------- constructor
+  # accessors: the kwarg bundles the loader / trainer / serving
+  # constructors consume (docs/tuning.md quickstart)
+
+  def loader_kwargs(self) -> dict:
+    """NeighborLoader kwargs for the chosen sampling mode."""
+    mode = self.choices['mode']
+    kw = dict(batch_size=self.choices['batch_size'], dedup=mode)
+    if mode in ('map', 'sort', 'merge') and \
+        self.choices.get('frontier_caps') is not None:
+      # caps clamp the EXACT-dedup buffer plan; the relaxed tree mode
+      # sizes its own computation-tree layout
+      kw['frontier_caps'] = list(self.choices['frontier_caps'])
+    if self.choices.get('padded_window') is not None:
+      kw['padded_window'] = self.choices['padded_window']
+    return kw
+
+  def trainer_kwargs(self) -> dict:
+    """Scan-trainer kwargs (chunk K); the trainers also re-validate the
+    dataset fingerprint when handed the artifact via ``config=``."""
+    return dict(chunk_size=int(self.choices['chunk_k']))
+
+  def serving_kwargs(self) -> dict:
+    """ServingEngine kwargs (the calibrated padded-bucket ladder)."""
+    return dict(buckets=tuple(self.choices['serving_buckets']))

@@ -1,0 +1,358 @@
+"""`tune()`: one call from (dataset, loader_cfg) to a validated
+fast-path config artifact.
+
+Landing on the fast path today means hand-picking ~10 coupled knobs
+(dedup mode, frontier caps, cache split, wire dtype, scan chunk K,
+slab caps, serving buckets). This module automates the choice the way
+GNNSampler (arxiv 2108.11571) argues samplers should be configured —
+workload-aware and hardware-matched — using machinery the repo
+already trusts:
+
+1. **Host probes** (tune/probes.py): the calibration simulation for
+   frontier caps, in-degree hotness mass for the cache split, the
+   divisor ladder for chunk K, planned miss volume for slab caps.
+2. **Observatory-scored candidate A/Bs**: each candidate sampling
+   mode runs a short ScanTrainer epoch twice — a compile epoch, then
+   a steady-state epoch. The program observatory
+   (metrics/programs.py) watches every dispatch site: a candidate
+   whose STEADY epoch compiles anything is disqualified BY
+   CONSTRUCTION, and the rejection records the signature diff naming
+   the drifted argument. Qualified candidates rank by steady-state
+   wall; under ``GLT_PROGRAM_COST=1`` near-ties (within
+   ``COST_TIE_MARGIN``) break on XLA cost attribution (flops, then
+   peak HBM) — on CPU replicas, where device wall is a weak signal,
+   the cost tie-break is the sharper lens.
+3. **Semantics**: the accuracy matrix (benchmarks/accuracy_matrix.py)
+   certifies which relaxations are exact-equivalent. ``exact=True``
+   pins the exact set — calibrated exact dedup, f32 wire — and only
+   A/Bs within it; the default also fields the certified relaxations
+   (tree dedup, bf16 wire).
+
+The result is a :class:`~graphlearn_tpu.tune.artifact.TuneArtifact`
+(JSON on disk via ``out_path=``) that the trainer / serving
+constructors accept directly via ``config=`` (docs/tuning.md).
+"""
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import metrics
+from ..metrics import programs, spans
+from . import probes
+from .artifact import TuneArtifact, dataset_fingerprint
+
+#: wall ratio under which two qualified candidates count as tied and
+#: the GLT_PROGRAM_COST attribution (flops, then peak HBM) breaks the
+#: tie — device wall on a CPU replica is noisy at exactly this margin
+COST_TIE_MARGIN = 0.05
+
+#: the program sites a local scanned candidate dispatches through —
+#: the population the "one executable per site" acceptance counts
+CANDIDATE_SITES = ('epoch_seeds', 'scan_chunk', 'metrics_concat')
+
+
+class Candidate:
+  """One sampling-mode candidate for the observatory A/B.
+
+  Args:
+    name: evidence-log label.
+    loader_kwargs: NeighborLoader overrides (dedup, frontier_caps,
+      padded_window, ...) layered over the shared loader_cfg.
+    chunk_k: per-candidate chunk override (None = the probed K).
+    exact_semantics: True when the candidate is bit-equivalent to
+      exact dedup (the accuracy-matrix certification line).
+    perturb_chunk: SELF-TEST knob — perturb the chunk length between
+      the compile and steady epochs, forcing a steady-state retrace.
+      This is how tests (and operators validating a deployment) prove
+      the disqualification path is live: the candidate MUST be
+      rejected with the signature diff in the evidence log.
+  """
+
+  def __init__(self, name: str, loader_kwargs: Dict,
+               chunk_k: Optional[int] = None,
+               exact_semantics: bool = True,
+               perturb_chunk: bool = False):
+    self.name = name
+    self.loader_kwargs = dict(loader_kwargs)
+    self.chunk_k = chunk_k
+    self.exact_semantics = exact_semantics
+    self.perturb_chunk = perturb_chunk
+
+
+def retrace_probe_candidate(base: Candidate) -> Candidate:
+  """A deliberately retracing copy of ``base`` — the live-fire check
+  that the observatory scoring actually rejects a retracing config
+  (tests/test_tune.py; docs/tuning.md 'Scoring rule')."""
+  return Candidate(f'{base.name}+retrace_probe', base.loader_kwargs,
+                   chunk_k=base.chunk_k,
+                   exact_semantics=base.exact_semantics,
+                   perturb_chunk=True)
+
+
+def default_candidates(caps: List[int], exact: bool) -> List[Candidate]:
+  """The stock candidate field: calibrated exact dedup always; the
+  accuracy-matrix-certified tree relaxation unless ``exact=True``
+  pinned the exact set."""
+  cands = [Candidate('map_calibrated',
+                     dict(dedup='map', frontier_caps=list(caps)),
+                     exact_semantics=True)]
+  if not exact:
+    cands.append(Candidate('tree', dict(dedup='tree'),
+                           exact_semantics=False))
+  return cands
+
+
+def _norm_cfg(loader_cfg: Dict) -> Dict:
+  cfg = dict(loader_cfg)
+  if 'fanouts' not in cfg:
+    if 'num_neighbors' in cfg:
+      cfg['fanouts'] = cfg.pop('num_neighbors')
+    else:
+      raise ValueError("loader_cfg needs 'fanouts' (the sampler "
+                       'fanout list)')
+  if 'input_nodes' not in cfg:
+    raise ValueError("loader_cfg needs 'input_nodes' (the seed pool)")
+  cfg['fanouts'] = [int(k) for k in cfg['fanouts']]
+  cfg['input_nodes'] = np.asarray(cfg['input_nodes']).reshape(-1)
+  cfg.setdefault('batch_size', 64)
+  cfg.setdefault('shuffle', False)
+  cfg.setdefault('drop_last', False)
+  cfg.setdefault('seed', 0)
+  return cfg
+
+
+def _num_classes(dataset, cfg: Dict) -> int:
+  if cfg.get('num_classes'):
+    return int(cfg['num_classes'])
+  labels = getattr(dataset, 'node_labels', None)
+  if labels is None or isinstance(labels, dict):
+    raise ValueError("pass loader_cfg['num_classes'] — the dataset "
+                     'carries no homogeneous label array to infer it '
+                     'from')
+  return int(np.asarray(labels).max()) + 1
+
+
+def _default_model(cfg: Dict, num_classes: int):
+  from ..models import GraphSAGE
+  return GraphSAGE(hidden_dim=16, out_dim=num_classes,
+                   num_layers=len(cfg['fanouts']))
+
+
+def _site_compiles() -> Dict[str, int]:
+  return {s: programs.compile_count(s) for s in CANDIDATE_SITES}
+
+
+def _candidate_record(cand: Candidate, chunk_k: int) -> dict:
+  return dict(kind='candidate', name=cand.name,
+              loader_kwargs={k: v for k, v in cand.loader_kwargs.items()},
+              chunk_k=int(cand.chunk_k or chunk_k),
+              exact_semantics=cand.exact_semantics)
+
+
+def score_candidate(cand: Candidate, dataset, cfg: Dict, num_classes:
+                    int, chunk_k: int, probe_steps: Optional[int],
+                    model=None, tx=None) -> dict:
+  """Run one candidate's compile + steady epochs and return its
+  evidence record: qualified?, steady wall, per-site compile counts,
+  the disqualifying retrace diff (if any), and — under
+  GLT_PROGRAM_COST — the chunk program's cost attribution."""
+  import jax
+  import optax
+
+  from .. import loader as loader_mod
+  from ..models import train as train_lib
+  k = int(cand.chunk_k or chunk_k)
+  rec = _candidate_record(cand, chunk_k)
+  metrics.inc('tune.candidates')
+  t_start = time.perf_counter()
+  try:
+    with spans.span('tune.candidate', candidate=cand.name, chunk_k=k):
+      lkw = dict(batch_size=cfg['batch_size'], shuffle=cfg['shuffle'],
+                 drop_last=cfg['drop_last'], seed=cfg['seed'],
+                 overflow_policy='off')
+      lkw.update(cand.loader_kwargs)
+      make_loader = lambda: loader_mod.NeighborLoader(
+          dataset, cfg['fanouts'], cfg['input_nodes'], **lkw)
+      first = train_lib.batch_to_dict(next(iter(make_loader())))
+      mdl = model or _default_model(cfg, num_classes)
+      if tx is None:
+        tx = optax.adam(1e-3)
+      state, _ = train_lib.create_train_state(
+          mdl, jax.random.PRNGKey(0), first, optimizer=tx)
+      trainer = loader_mod.ScanTrainer(make_loader(), mdl, tx,
+                                       num_classes, chunk_size=k)
+      steps = trainer._epoch_steps()
+      if probe_steps is None:
+        probe_steps = min(steps, 2 * k)
+      probe_steps = min(steps, max(k, (probe_steps // k) * k))
+      base = _site_compiles()
+      # compile epoch: the executable population is built here
+      state, losses, _ = trainer.run_epoch(state, max_steps=probe_steps)
+      jax.block_until_ready(losses)
+      after_compile = _site_compiles()
+      if cand.perturb_chunk:
+        # the self-test probe: a mid-run chunk-length drift is exactly
+        # the silent production retrace the scoring must catch
+        trainer.chunk_size = max(1, k // 2)
+      # steady epoch: the measured one — ANY compile here disqualifies
+      t0 = time.perf_counter()
+      state, losses, _ = trainer.run_epoch(state, max_steps=probe_steps)
+      jax.block_until_ready(losses)
+      wall = time.perf_counter() - t0
+      after_steady = _site_compiles()
+      rec['probe_steps'] = int(probe_steps)
+      rec['compile_epoch_compiles'] = {
+          s: after_compile[s] - base[s] for s in CANDIDATE_SITES}
+      steady = {s: after_steady[s] - after_compile[s]
+                for s in CANDIDATE_SITES}
+      rec['steady_epoch_compiles'] = steady
+      rec['wall_s'] = round(wall, 6)
+      retraced = sum(steady.values()) > 0
+      rec['qualified'] = not retraced
+      if retraced:
+        site = max(steady, key=steady.get)
+        ev = programs.last_compile(site)
+        rec['rejected'] = (
+            f'steady-state epoch compiled {sum(steady.values())} '
+            f'program(s) — a tuned config must dispatch a CLOSED '
+            'executable set')
+        rec['retrace_diff'] = ev.diff if ev is not None else None
+        metrics.inc('tune.rejected')
+      if programs.cost_enabled():
+        ev = programs.last_compile('scan_chunk')
+        if ev is not None and ev.cost and 'error' not in ev.cost:
+          rec['cost'] = dict(
+              flops=ev.cost.get('flops'),
+              peak_hbm_bytes=ev.cost.get('peak_hbm_bytes'))
+  except Exception as e:  # a broken candidate is evidence, not a crash
+    rec['qualified'] = False
+    rec['rejected'] = f'{type(e).__name__}: {e}'[:300]
+    metrics.inc('tune.rejected')
+  metrics.observe('tune.probe_ms',
+                  (time.perf_counter() - t_start) * 1e3)
+  return rec
+
+
+def _per_step_wall(rec: dict) -> float:
+  # candidates with different chunk_k run different probe_steps (each
+  # epoch rounds to its own chunk boundary) — raw wall_s would compare
+  # apples to oranges, so ranking normalizes to wall per step
+  return rec['wall_s'] / max(1, rec.get('probe_steps', 1))
+
+
+def _pick_winner(records: List[dict]) -> dict:
+  ok = [r for r in records if r.get('qualified')]
+  if not ok:
+    raise RuntimeError(
+        'tune(): every candidate was disqualified — see the evidence '
+        'log on the raised artifact draft for per-candidate reasons '
+        f'({[r.get("rejected") for r in records]})')
+  ok.sort(key=_per_step_wall)
+  best = ok[0]
+  if len(ok) > 1 and programs.cost_enabled():
+    # near-tie on per-step wall: break on flops, then peak HBM (the
+    # CPU-replica rule — wall there is dispatch noise at this margin)
+    near = [r for r in ok
+            if _per_step_wall(r) <=
+            _per_step_wall(ok[0]) * (1 + COST_TIE_MARGIN)
+            and r.get('cost')]
+    if len(near) > 1:
+      near.sort(key=lambda r: (r['cost'].get('flops') or float('inf'),
+                               r['cost'].get('peak_hbm_bytes')
+                               or float('inf')))
+      best = near[0]
+      best['tie_break'] = 'cost (flops, peak_hbm)'
+  return best
+
+
+def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
+         candidates: Optional[Sequence[Candidate]] = None,
+         probe_steps: Optional[int] = None, model=None, tx=None,
+         num_probes: int = 8, seed: int = 0,
+         out_path: Optional[str] = None) -> TuneArtifact:
+  """One call from a dataset + loader shape to a validated config
+  artifact (module docstring; docs/tuning.md has the quickstart).
+
+  Args:
+    dataset: a homogeneous ``data.Dataset`` with features + labels.
+    loader_cfg: dict with ``fanouts``, ``input_nodes``, ``batch_size``
+      (+ optional shuffle / drop_last / seed / num_classes).
+    exact: pin the exact-semantics set (calibrated exact dedup, f32
+      wire); default also fields the accuracy-matrix-certified
+      relaxations (tree dedup, bf16 wire).
+    candidates: explicit candidate list (default:
+      :func:`default_candidates`; append
+      :func:`retrace_probe_candidate` to live-fire the rejection
+      path).
+    probe_steps: optimizer steps per A/B epoch (default ``2 x K``,
+      rounded to a chunk boundary — one executable per site).
+    model / tx: the model/optimizer to probe with (default: a small
+      GraphSAGE + adam — candidate RANKING is program-shape-driven,
+      so a proxy model suffices; pass the real one to rank on its
+      true wall).
+    num_probes / seed: calibration probe controls (calibrate.py).
+    out_path: also save the artifact JSON there.
+  """
+  cfg = _norm_cfg(loader_cfg)
+  num_classes = _num_classes(dataset, cfg)
+  evidence: List[dict] = []
+  with spans.span('tune.run', exact=exact):
+    caps, ev = probes.probe_frontier_caps(
+        dataset.graph, cfg['fanouts'], cfg['batch_size'],
+        input_nodes=cfg['input_nodes'], num_probes=num_probes,
+        seed=seed)
+    evidence.append(ev)
+    n = dataset.graph.topo.indptr.shape[0] - 1 \
+        if hasattr(dataset.graph, 'topo') else \
+        np.asarray(dataset.graph.indptr).shape[0] - 1
+    split, bucket_frac, ev = probes.probe_cache_split(dataset.graph, n)
+    evidence.append(ev)
+    steps = probes.epoch_steps(cfg['input_nodes'].shape[0],
+                               cfg['batch_size'], cfg['drop_last'])
+    chunk_k, ev = probes.probe_chunk_k(steps)
+    evidence.append(ev)
+    slab_cap, ev = probes.probe_slab_cap(chunk_k, caps,
+                                         cfg['batch_size'], split)
+    evidence.append(ev)
+    buckets, ev = probes.probe_serving_buckets(cfg['batch_size'])
+    evidence.append(ev)
+    wire, ev = probes.wire_dtype_choice(exact)
+    evidence.append(ev)
+
+    cands = list(candidates) if candidates is not None \
+        else default_candidates(caps, exact)
+    if exact:
+      dropped = [c.name for c in cands if not c.exact_semantics]
+      cands = [c for c in cands if c.exact_semantics]
+      if dropped:
+        evidence.append(dict(
+            kind='exact_pin', dropped_candidates=dropped,
+            note='exact=True pins the accuracy-matrix exact set'))
+    records = [score_candidate(c, dataset, cfg, num_classes, chunk_k,
+                               probe_steps, model=model, tx=tx)
+               for c in cands]
+    evidence.extend(records)
+    best = _pick_winner(records)
+    evidence.append(dict(kind='winner', name=best['name'],
+                         wall_s=best['wall_s'],
+                         tie_break=best.get('tie_break', 'wall')))
+
+    choices = dict(
+        mode=best['loader_kwargs'].get('dedup', 'map'),
+        frontier_caps=list(caps),
+        padded_window=best['loader_kwargs'].get('padded_window'),
+        wire_dtype=wire,
+        chunk_k=int(best['chunk_k']),
+        split_ratio=split,
+        bucket_frac=bucket_frac,
+        slab_cap=int(slab_cap),
+        serving_buckets=list(buckets),
+        batch_size=int(cfg['batch_size']),
+        fanouts=list(cfg['fanouts']),
+        exact=bool(exact))
+    art = TuneArtifact(choices, dataset_fingerprint(dataset), evidence)
+  metrics.inc('tune.artifacts')
+  if out_path is not None:
+    art.save(out_path)
+  return art

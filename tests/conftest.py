@@ -130,6 +130,10 @@ def rng():
 _STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
                    'test_serving', 'test_storage', 'test_recovery',
                    'test_remote_scan', 'test_dist_oversub',
+                   # round 15: the tuned-config A/Bs and the run
+                   # program must hold their zero-retrace / budget
+                   # contracts with the guard rails armed
+                   'test_tune', 'test_run_epoch',
                    # r13 kernel parity suites: the fused-hop stream and
                    # gather-v2 tests must hold with the strict guard
                    # rails armed (the kernels ride inside guarded scan

@@ -201,6 +201,34 @@ def test_tiered_dist_scan_validation_errors():
     TieredDistScanTrainer(FakeHetero(), model, tx, 3)
 
 
+def test_oversubscribed_device_arrays_raises_loudly():
+  """ROADMAP 2b made explicit (round 15): device_arrays() on an
+  OVERSUBSCRIBED TieredDistFeature — the per-step dist loader's upload
+  path — must raise naming TieredDistScanTrainer instead of silently
+  uploading the full partition table (defeating the declared
+  oversubscription, or OOMing at real scale). A prefixless store keeps
+  the full-upload path; cpu_get is unaffected either way."""
+  parts, feats, node_pb, _ = ring_fixture()
+  mesh = make_mesh()
+  over = TieredDistFeature(NUM_PARTS, feats, node_pb, mesh=mesh,
+                           spill_dir=tempfile.mkdtemp(),
+                           hot_prefix_rows=2)
+  with pytest.raises(RuntimeError) as ei:
+    over.device_arrays()
+  msg = str(ei.value)
+  assert 'TieredDistScanTrainer' in msg
+  assert 'hot_prefix_rows=2' in msg
+  # the host-side serving path is NOT the footgun — stays available
+  ids = np.asarray([0, 3, 5], np.int64)
+  expect = ids[:, None].astype(np.float32) * np.ones((1, 4), np.float32)
+  np.testing.assert_array_equal(over.cpu_get(ids), expect)
+  # a prefixless (non-oversubscribed) store keeps the full upload
+  full = TieredDistFeature(NUM_PARTS, feats, node_pb, mesh=mesh,
+                           spill_dir=tempfile.mkdtemp())
+  dev = full.device_arrays()
+  assert dev['feats'].shape[0] == NUM_PARTS
+
+
 @pytest.mark.slow  # tier-1 budget: shuffle=False is the equivalence rep
 def test_tiered_dist_scan_shuffle_bit_identical():
   """shuffle=True: the plan program's in-shard_map permutation draw is

@@ -462,10 +462,10 @@ def test_remote_scan_server_death_chunk_failover(monkeypatch, tmp_path):
     _teardown(pairs)
 
 
-def test_failover_disabled_or_shuffle_raises():
-  """Failover preconditions fail LOUDLY: shuffle epochs have no
-  deterministic order for survivors to replay, and failover=False is
-  an explicit operator choice."""
+def test_failover_disabled_raises():
+  """failover=False is an explicit operator choice: a dead rank with
+  pending blocks fails LOUDLY instead of silently re-pointing, and the
+  refusal leaves no sticky dead mark."""
   ds = make_dataset()
   seeds = np.arange(N)
   pairs = [_start_block_server(ds) for _ in range(2)]
@@ -473,14 +473,72 @@ def test_failover_disabled_or_shuffle_raises():
     _init_client(pairs)
     model, tx, state, _ = _model_and_state(ds, seeds)
     opts = glt.distributed.RemoteDistSamplingWorkerOptions(
-        server_rank=[0, 1], heartbeat_interval=0.2, heartbeat_miss=2)
-    trainer = _make_trainer(model, tx, seeds, shuffle=True,
-                            worker_options=opts)
+        server_rank=[0, 1], heartbeat_interval=0.2, heartbeat_miss=2,
+        failover=False)
+    trainer = _make_trainer(model, tx, seeds, worker_options=opts)
     trainer._schedule = trainer._block_schedule(len(trainer), 0)
-    with pytest.raises(RuntimeError, match='shuffle=False'):
+    with pytest.raises(RuntimeError, match='failover is disabled'):
       trainer._handle_dead_rank(1, 'test', 0)
     assert 1 not in trainer._dead_ranks   # no sticky mark on refusal
     trainer.shutdown()
+  finally:
+    _teardown(pairs)
+
+
+def test_remote_scan_shuffle_failover_exact_coverage():
+  """ROADMAP 1b, lifted in round 15: shuffle=True failover is EXACT —
+  the server epoch permutation is a pure function of (stream seed,
+  epoch) (block_producer._epoch_order), so a survivor's replay
+  producer re-draws the dead rank's order identically. A mid-epoch
+  server kill completes the shuffled epoch with exact seed coverage
+  AND losses bit-identical to the undisturbed 2-server shuffled run."""
+  import jax
+  ds = make_dataset(40)
+  seeds = np.arange(40)
+  pairs = [_start_block_server(ds) for _ in range(2)]
+  opts = lambda: glt.distributed.RemoteDistSamplingWorkerOptions(  # noqa: E731
+      server_rank=[0, 1], heartbeat_interval=0.2, heartbeat_miss=2,
+      block_ahead=1)   # the victim must still OWN pending blocks
+  try:
+    _init_client(pairs)
+    model, tx, state_a, template = _model_and_state(ds, seeds)
+
+    clean = _make_trainer(model, tx, seeds, shuffle=True,
+                          worker_options=opts())
+    state_a, losses_clean, _ = clean.run_epoch(state_a)
+    assert sorted(clean.last_epoch_seed_ids.tolist()) == list(range(40))
+    clean.shutdown()
+
+    state_b, _ = train_lib.create_train_state(
+        model, jax.random.PRNGKey(0), template, optimizer=tx)
+    victim = _make_trainer(model, tx, seeds, shuffle=True,
+                           worker_options=opts())
+    from graphlearn_tpu.distributed import dist_client
+    dead = set()
+    victim._dist_client = _DeadRankClient(dist_client, dead)
+
+    def killer(c, start, k):
+      if c == 0:       # kill rank 1 right after the first chunk trains
+        dead.add(1)
+
+    victim.ack_hook = killer
+    state_b, losses_b, _ = victim.run_epoch(state_b)
+    # exact seed coverage of the SHUFFLED epoch after the kill — the
+    # acceptance this satellite pins
+    assert sorted(victim.last_epoch_seed_ids.tolist()) == \
+        list(range(40))
+    assert 1 in victim._dead_ranks
+    # stronger than coverage: the survivor replayed the identical
+    # permuted blocks, so the losses match the undisturbed run bitwise
+    np.testing.assert_array_equal(np.asarray(losses_b),
+                                  np.asarray(losses_clean))
+    assert trace.counter_get('remote.failover_blocks') >= 1
+    # epoch 2 on the degraded cluster re-points the whole share at
+    # schedule build and still covers every seed of ITS permutation
+    state_b, losses_e2, _ = victim.run_epoch(state_b)
+    assert sorted(victim.last_epoch_seed_ids.tolist()) == \
+        list(range(40))
+    victim.shutdown()
   finally:
     _teardown(pairs)
 
@@ -601,14 +659,14 @@ def test_remote_scan_bf16_wire():
 
 def test_scope_validation_messages_name_chunk_staged_path():
   """DistFusedEpochTrainer's remote rejection now points at the
-  chunk-staged path (and its shuffle=False failover constraint)
-  instead of flatly rejecting; RemoteScanTrainer rejects what it
-  cannot train (typed seeds, collect_features=False)."""
+  chunk-staged path (whose failover is exact even under shuffle=True
+  — round 15) instead of flatly rejecting; RemoteScanTrainer rejects
+  what it cannot train (typed seeds, collect_features=False)."""
   with pytest.raises(ValueError) as ei:
     glt.loader.DistFusedEpochTrainer(object(), None, None, 3)
   msg = str(ei.value)
   assert 'RemoteScanTrainer' in msg
-  assert 'shuffle=False' in msg
+  assert 'shuffle=True' in msg
   assert 'remote_scan' in msg
 
   with pytest.raises(ValueError, match='homogeneous-only'):

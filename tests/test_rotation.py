@@ -157,3 +157,111 @@ def test_failed_shard_swap_serves_previous_version(tmp_path):
   rows = store.fetch(store.lookup(np.arange(4), np.ones(4, bool)))
   np.testing.assert_array_equal(versions_of(rows, np.arange(4)),
                                 np.full(4, 2))
+
+
+# --------------------------------------------- scheduled materializer
+
+
+def test_rotation_scheduler_interval_and_staleness(tmp_path):
+  """RotationScheduler (ROADMAP 2d): interval-triggered rotations land
+  on the daemon thread; a staleness trigger fires one immediately; the
+  serving.rotations metric counts them; stop() joins cleanly and no
+  rotation lands after it."""
+  from graphlearn_tpu.serving import RotationScheduler
+  c0 = glt_metrics.default_registry().counters()
+  store = make_store(str(tmp_path), shards=2)
+  built = []
+
+  def build():
+    v = len(built) + 1
+    built.append(v)
+    return table_for(v)
+
+  stale = {'flag': False}
+  sched = RotationScheduler(store, build, interval_s=0.25,
+                            staleness_fn=lambda: stale['flag'],
+                            poll_s=0.05).start()
+  deadline = time.perf_counter() + 5.0
+  while sched.rotations < 2 and time.perf_counter() < deadline:
+    time.sleep(0.05)
+  assert sched.rotations >= 2          # interval trigger fired
+  # staleness trigger: fires on the next poll, well inside the interval
+  n0 = sched.rotations
+  stale['flag'] = True
+  deadline = time.perf_counter() + 5.0
+  while sched.rotations == n0 and time.perf_counter() < deadline:
+    time.sleep(0.02)
+  stale['flag'] = False
+  assert sched.rotations > n0
+  sched.stop()
+  n_stopped = sched.rotations
+  time.sleep(0.4)
+  assert sched.rotations == n_stopped  # nothing lands after stop/join
+  assert store.version == n_stopped    # every success swapped in
+  c1 = glt_metrics.default_registry().counters()
+  assert c1.get('serving.rotations', 0) - \
+      c0.get('serving.rotations', 0) == n_stopped + 1  # + install v0
+  # triggers are required; bad intervals are refused
+  with pytest.raises(ValueError, match='trigger'):
+    RotationScheduler(store, build)
+  with pytest.raises(ValueError, match='interval_s'):
+    RotationScheduler(store, build, interval_s=0)
+
+
+def test_rotation_scheduler_failed_build_keeps_serving(tmp_path):
+  """Chaos: a scheduled rotation whose BUILD raises (and one whose
+  SWAP faults via serving.rotate) keeps the previous version serving —
+  zero failed requests under live traffic, serving.rotation_errors
+  counts the failures, and the next clean attempt recovers."""
+  from graphlearn_tpu.serving import RotationScheduler
+  store = make_store(str(tmp_path), shards=2)
+  engine = ServingEngine(store, buckets=(16, 64), max_wait_ms=0.5)
+  phase = {'mode': 'boom'}
+
+  def build():
+    if phase['mode'] == 'boom':
+      raise RuntimeError('materializer died (injected)')
+    return table_for(1)
+
+  c0 = glt_metrics.default_registry().counters()
+  errors, bad_version, served = [], [], []
+  stop_t = time.perf_counter() + 1.2
+
+  def client():
+    rng = np.random.default_rng(3)
+    n_ok = 0
+    try:
+      while time.perf_counter() < stop_t:
+        ids = rng.integers(0, N, 8)
+        rows = engine.lookup(ids)
+        vs = np.unique(versions_of(rows, ids))
+        if not (vs.tolist() == [0] or vs.tolist() == [1]):
+          bad_version.append(vs)
+        n_ok += 1
+      served.append(n_ok)
+    except BaseException as e:  # noqa: BLE001
+      errors.append(e)
+
+  sched = RotationScheduler(store, build, interval_s=0.15, poll_s=0.05)
+  with engine:
+    th = threading.Thread(target=client)
+    th.start()
+    sched.start()
+    deadline = time.perf_counter() + 5.0
+    while sched.failures < 2 and time.perf_counter() < deadline:
+      time.sleep(0.05)
+    assert sched.failures >= 2 and store.version == 0
+    assert 'injected' in sched.last_error
+    # recovery: the next poll's clean build rotates in v1
+    phase['mode'] = 'ok'
+    deadline = time.perf_counter() + 5.0
+    while sched.rotations < 1 and time.perf_counter() < deadline:
+      time.sleep(0.05)
+    sched.stop()
+    th.join()
+  assert sched.rotations >= 1 and store.version >= 1
+  assert not errors and not bad_version, (errors[:1], bad_version[:1])
+  assert sum(served) > 0               # zero failed requests throughout
+  c1 = glt_metrics.default_registry().counters()
+  assert c1.get('serving.rotation_errors', 0) - \
+      c0.get('serving.rotation_errors', 0) >= 2

@@ -1,0 +1,222 @@
+"""tune(): the one-call autotuned fast-path config (docs/tuning.md).
+
+The contracts under test, in order:
+
+* **Artifact roundtrip** — emit -> save -> load -> constructors: the
+  chosen config drives a ScanTrainer whose steady-state epoch compiles
+  NOTHING (zero retraces under GLT_STRICT — conftest arms it for this
+  module) and whose compile epoch built exactly one executable per
+  program site.
+* **Rejection by construction** — a deliberately retracing candidate
+  is disqualified, and the artifact's evidence log carries the
+  signature diff naming the drifted argument.
+* **Fingerprint refusal** — a drifted dataset (different graph) is
+  refused by the ``config=``-accepting constructors; a hand-edited
+  artifact file is refused at load.
+* **Exact pinning** — ``exact=True`` restricts candidates to the
+  accuracy-matrix exact set (exact dedup, f32 wire).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.metrics import programs
+from graphlearn_tpu.models import GraphSAGE, train as train_lib
+from graphlearn_tpu.tune import (TuneArtifact, default_candidates,
+                                 retrace_probe_candidate)
+
+N, F, CLASSES = 96, 6, 3
+FANOUTS = [3, 2]
+BS = 8
+
+
+def make_dataset(seed=0, n=N):
+  rng = np.random.default_rng(seed)
+  rows = np.repeat(np.arange(n), 4)
+  cols = (rows + rng.integers(1, n, rows.shape[0])) % n
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), graph_mode='CPU', num_nodes=n)
+  ds.init_node_features(rng.standard_normal((n, F)).astype(np.float32))
+  ds.init_node_labels(rng.integers(0, CLASSES, n))
+  return ds
+
+
+def seed_pool(num=44):
+  return np.random.default_rng(9).permutation(N)[:num].astype(np.int64)
+
+
+def loader_cfg(num=44, **kw):
+  cfg = dict(fanouts=FANOUTS, input_nodes=seed_pool(num), batch_size=BS)
+  cfg.update(kw)
+  return cfg
+
+
+def test_tune_artifact_roundtrip_and_zero_retrace(tmp_path):
+  """Acceptance: tune() emits a validated artifact; emit -> load ->
+  constructors -> the chosen config's steady-state epoch retraces
+  NOTHING (retrace_budget 0 under GLT_STRICT) and its compile epoch
+  built exactly one executable per program site."""
+  import jax
+  ds = make_dataset()
+  art = glt.tune(ds, loader_cfg(), out_path=str(tmp_path / 'art.json'))
+
+  # the knob set is complete and the file round-trips bit-for-bit
+  for key in ('mode', 'frontier_caps', 'chunk_k', 'split_ratio',
+              'bucket_frac', 'slab_cap', 'serving_buckets',
+              'wire_dtype'):
+    assert key in art.choices, key
+  art2 = TuneArtifact.load(str(tmp_path / 'art.json'))
+  assert art2.fingerprint == art.fingerprint
+  assert art2.choices == art.choices
+  # every knob has probe evidence; the winner is recorded
+  knobs = {e.get('knob') for e in art.evidence if 'knob' in e}
+  assert {'frontier_caps', 'chunk_k', 'slab_cap', 'split_ratio',
+          'serving_buckets', 'wire_dtype'} <= knobs
+  assert any(e.get('kind') == 'winner' for e in art.evidence)
+
+  # constructors accept the artifact directly: loader from its kwargs,
+  # trainer via config= (fingerprint-validated, tuned K applied)
+  loader = glt.loader.NeighborLoader(
+      ds, FANOUTS, seed_pool(), shuffle=False, seed=0,
+      overflow_policy='off', **art2.loader_kwargs())
+  model = GraphSAGE(hidden_dim=8, out_dim=CLASSES, num_layers=2)
+  first = train_lib.batch_to_dict(next(iter(glt.loader.NeighborLoader(
+      ds, FANOUTS, seed_pool(), shuffle=False, seed=0,
+      overflow_policy='off', **art2.loader_kwargs()))))
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                           first)
+  trainer = glt.loader.ScanTrainer(loader, model, tx, CLASSES,
+                                   config=art2)
+  assert trainer.chunk_size == art2.choices['chunk_k']
+
+  base = {s: programs.compile_count(s)
+          for s in ('epoch_seeds', 'scan_chunk', 'metrics_concat')}
+  k = trainer.chunk_size
+  steps = (k * 2) if trainer._epoch_steps() >= 2 * k else k
+  state, losses, _ = trainer.run_epoch(state, max_steps=steps)
+  jax.block_until_ready(losses)
+  # compile-count == site population: one executable per site (steps
+  # is a multiple of K, so exactly one chunk length exists)
+  for site in ('epoch_seeds', 'scan_chunk'):
+    assert programs.compile_count(site) - base[site] == 1, site
+  # steady state: zero retraces under GLT_STRICT (raises on overrun)
+  with programs.retrace_budget('scan_chunk', 0):
+    with programs.retrace_budget('epoch_seeds', 0):
+      state, losses, _ = trainer.run_epoch(state, max_steps=steps)
+      jax.block_until_ready(losses)
+
+  # the serving constructor takes the same artifact
+  store = glt.serving.EmbeddingStore(
+      np.zeros((N, 4), np.float32), num_nodes=N)
+  eng = glt.serving.ServingEngine(store, config=art2)
+  assert eng.buckets == tuple(sorted(art2.choices['serving_buckets']))
+
+
+def test_tune_rejects_retracing_candidate_with_diff():
+  """Acceptance: a deliberately retracing candidate is rejected BY
+  CONSTRUCTION, and the artifact's evidence log carries the signature
+  diff naming the drifted static chunk argument."""
+  ds = make_dataset()
+  caps = [128, 128]
+  cands = default_candidates(caps, exact=False)
+  cands.append(retrace_probe_candidate(cands[0]))
+  art = glt.tune(ds, loader_cfg(), candidates=cands)
+  rej = [e for e in art.evidence
+         if e.get('kind') == 'candidate' and not e.get('qualified')]
+  assert len(rej) == 1
+  assert rej[0]['name'].endswith('retrace_probe')
+  assert 'retraces' not in art.choices['mode']
+  assert 'static:' in rej[0]['retrace_diff']   # names the drifted arg
+  assert sum(rej[0]['steady_epoch_compiles'].values()) > 0
+  # the probe candidate never wins, even though its loader config is
+  # identical to a qualified one
+  winner = [e for e in art.evidence if e.get('kind') == 'winner'][0]
+  assert not winner['name'].endswith('retrace_probe')
+
+
+def test_tune_exact_pins_exact_set():
+  """exact=True pins the accuracy-matrix exact set: exact dedup mode,
+  f32 wire, and relaxed candidates dropped from the field."""
+  ds = make_dataset()
+  cands = default_candidates([128, 128], exact=False)  # includes tree
+  art = glt.tune(ds, loader_cfg(), exact=True, candidates=cands)
+  assert art.choices['exact'] is True
+  assert art.choices['mode'] == 'map'
+  assert art.choices['wire_dtype'] is None
+  pins = [e for e in art.evidence if e.get('kind') == 'exact_pin']
+  assert pins and 'tree' in pins[0]['dropped_candidates']
+  # relaxed default keeps bf16 wire on the table
+  art2 = glt.tune(ds, loader_cfg())
+  assert art2.choices['wire_dtype'] == 'bf16'
+  assert art2.choices['exact'] is False
+
+
+def test_config_fingerprint_refuses_drifted_dataset(tmp_path):
+  """Acceptance: the ``config=`` constructors refuse an artifact tuned
+  for a DIFFERENT graph by dataset fingerprint; a hand-edited artifact
+  file is refused at load by the whole-artifact fingerprint."""
+  import jax
+  ds = make_dataset(seed=0)
+  art = glt.tune(ds, loader_cfg())
+
+  drifted = make_dataset(seed=1)   # same shape, different edges
+  loader = glt.loader.NeighborLoader(
+      drifted, FANOUTS, seed_pool(), shuffle=False, seed=0,
+      overflow_policy='off', **art.loader_kwargs())
+  model = GraphSAGE(hidden_dim=8, out_dim=CLASSES, num_layers=2)
+  first = train_lib.batch_to_dict(next(iter(glt.loader.NeighborLoader(
+      drifted, FANOUTS, seed_pool(), shuffle=False, seed=0,
+      overflow_policy='off', **art.loader_kwargs()))))
+  _, tx = train_lib.create_train_state(model, jax.random.PRNGKey(0),
+                                       first)
+  with pytest.raises(ValueError, match='fingerprint mismatch'):
+    glt.loader.ScanTrainer(loader, model, tx, CLASSES, config=art)
+  # ... and RunTrainer inherits the same refusal
+  with pytest.raises(ValueError, match='fingerprint mismatch'):
+    glt.RunTrainer(loader, model, tx, CLASSES, epochs=2, config=art)
+  # the serving engine refuses a store of drifted height
+  store = glt.serving.EmbeddingStore(np.zeros((N + 8, 4), np.float32))
+  with pytest.raises(ValueError, match='tuned for'):
+    glt.serving.ServingEngine(store, config=art)
+
+  # a tampered file fails the whole-artifact fingerprint at load
+  import json
+  path = str(tmp_path / 'tampered.json')
+  art.save(path)
+  with open(path) as f:
+    obj = json.load(f)
+  obj['choices']['chunk_k'] = 999
+  with open(path, 'w') as f:
+    json.dump(obj, f)
+  with pytest.raises(ValueError, match='edited'):
+    TuneArtifact.load(path)
+
+
+def test_tune_cost_tiebreak_env(monkeypatch):
+  """Under GLT_PROGRAM_COST=1 the candidate records carry XLA cost
+  attribution (flops / peak HBM) — the CPU-replica tie-break signal —
+  without changing the qualification verdicts."""
+  monkeypatch.setenv('GLT_PROGRAM_COST', '1')
+  ds = make_dataset()
+  art = glt.tune(ds, loader_cfg())
+  cands = [e for e in art.evidence if e.get('kind') == 'candidate']
+  assert cands and all(c.get('qualified') for c in cands)
+  with_cost = [c for c in cands if c.get('cost')]
+  assert with_cost, 'no candidate captured cost under GLT_PROGRAM_COST'
+  assert with_cost[0]['cost']['flops'] is not None
+
+
+def test_artifact_validation_guards():
+  """Schema guards: unknown choice keys, unsupported versions, and
+  missing loader_cfg keys all fail with the documented messages."""
+  with pytest.raises(ValueError, match='unknown choice keys'):
+    TuneArtifact({'bogus_knob': 1})
+  with pytest.raises(ValueError, match='version'):
+    TuneArtifact.from_json({'version': 99, 'choices': {}})
+  ds = make_dataset()
+  with pytest.raises(ValueError, match='fanouts'):
+    glt.tune(ds, dict(input_nodes=seed_pool(), batch_size=8))
+  with pytest.raises(ValueError, match='input_nodes'):
+    glt.tune(ds, dict(fanouts=FANOUTS, batch_size=8))
