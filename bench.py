@@ -478,6 +478,20 @@ BENCH_KEY_REGISTRY = {
     'fused_hop_vs_xla_ratio': 'fused_hop_ms / XLA uniform_sample hop ms '
                               '(< 1.0 = kernel wins)',
     'fused_hop_config': 'probe config behind the fused_hop keys',
+    # kernel campaign r16 (ops/sample_fused.py sample_level_fused +
+    # tune/): the fused MULTI-HOP frontier level (sample+gather+dedup
+    # in one kernel pass) vs the same level through the XLA merge
+    # engine, and the kernel routing the tuner actually chose
+    'fused_multihop_ms': 'fused multi-hop frontier kernel device ms '
+                         'per fanout level (sample+gather+dedup fused)',
+    'fused_multihop_vs_xla_ratio': 'fused_multihop_ms / XLA sample + '
+                                   'merge-dedup level ms (< 1.0 = '
+                                   'kernel wins)',
+    'fused_multihop_config': 'probe config behind the fused_multihop '
+                             'keys',
+    'kernel_route_config': "tune()'s chosen kernel routing — the "
+                           'artifact kernel choices every config= '
+                           'acceptor applies (docs/tuning.md)',
     # out-of-core tiered storage (storage/, ROADMAP item 2): a scanned
     # epoch whose feature table is >= 4x the HBM(hot)+RAM(warm) budget,
     # vs the identical all-HBM epoch — the oversubscription gate
@@ -502,6 +516,19 @@ BENCH_KEY_REGISTRY = {
                                   'losses (exact miss-exchange program)',
     'dist_oversub_config': 'graph/mesh/prefix/oversubscription shape '
                            'of the dist_oversub figures',
+    # demand-paged PER-STEP oversubscribed gather (storage/dist.py,
+    # ISSUE 16): per-step TieredDistFeature.get over hot prefix +
+    # per-step demand-paged slabs vs the identical all-HBM per-step
+    # loop — bit-identical rows; the ratio prices the per-step host
+    # round trip the scanned path amortizes at chunk boundaries
+    'oversub_per_step_wall_s': 'demand-paged per-step get loop wall s',
+    'oversub_per_step_hbm_wall_s': 'all-HBM per-step get loop wall s',
+    'oversub_per_step_ratio': 'demand-paged / all-HBM per-step wall '
+                              '(the per-step demand-paging tax)',
+    'oversub_per_step_bit_identical': 'demand-paged rows == all-HBM '
+                                      'rows over every step',
+    'oversub_per_step_config': 'store/mesh/prefix/step shape of the '
+                               'oversub_per_step figures',
     # zero-downtime sharded store rotation (serving/rotation.py): next
     # version materializes onto per-shard disk tiers while the current
     # serves, then swaps atomically under live threaded traffic
@@ -566,7 +593,8 @@ BENCH_ERROR_SECTIONS = (
     'train_step', 'scan_epoch', 'dist_scan_epoch', 'run_mean_impl',
     'run_softmax_impl', 'hetero_step', 'hetero_ref', 'feature_exchange',
     'serving', 'oversub', 'dist_oversub', 'rotation', 'recovery',
-    'remote_scan', 'gather2', 'fused_hop', 'tune', 'run_scan',
+    'remote_scan', 'gather2', 'fused_hop', 'fused_multihop',
+    'oversub_per_step', 'tune', 'run_scan',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -598,12 +626,14 @@ BENCH_LOWER_IS_BETTER = frozenset({
     # kernels lost ground vs XLA round over round (compiler regressions
     # included) — gate it like any latency key
     'gather2_vs_take_ratio', 'fused_hop_vs_xla_ratio',
+    'fused_multihop_vs_xla_ratio',
     'embed_epoch_wall_s', 'embed_epoch_dispatches',
     'oversub_epoch_wall_s', 'staged_mb_per_chunk',
     # the dist-oversubscription gate ratio (~1.5x) and the rotation
     # pair: the swap critical section's p99 and the zero-downtime
     # contract itself (any failed request is a regression from 0)
-    'dist_oversub_ratio', 'rotation_swap_ms_p99',
+    'dist_oversub_ratio', 'oversub_per_step_ratio',
+    'rotation_swap_ms_p99',
     'rotation_failed_requests',
     # a checkpoint that gets expensive (bytes) or taxing (overhead)
     # regresses silently otherwise — the issue's gate pair
@@ -1165,6 +1195,12 @@ def main():
         f"slab={ch['slab_cap']} buckets={ch['serving_buckets']} "
         f"winner={_winner['name']} by {_winner['tie_break']}, "
         f"fingerprint {tune_art.fingerprint[:12]}")
+    result['kernel_route_config'] = (
+        f"use_pallas_v2={ch['use_pallas_v2']} "
+        f"block_rows={ch['gather2_block_rows']} "
+        f"run_span={ch['gather2_run_span']} "
+        f"use_fused_hop={ch['use_fused_hop']} "
+        f"window={ch['fused_hop_window']}")
   except Exception as e:
     result['tune_error'] = f'{type(e).__name__}: {e}'[:200]
 
@@ -1459,6 +1495,50 @@ def main():
   except Exception as e:
     result['fused_hop_error'] = f'{type(e).__name__}: {e}'[:200]
 
+  # fused MULTI-HOP frontier (r16, ops/sample_fused.py): one whole
+  # fanout level — sample+gather+dedup in a single kernel pass — vs the
+  # identical level through the XLA merge engine (uniform draw +
+  # induce_next_merge). Both arms are the SAME jitted entry; the kernel
+  # arm routes through the level kernel via the blocks128 table.
+  try:
+    import jax.numpy as jnp
+    if backend != 'tpu':
+      raise RuntimeError(
+          f'backend {backend}: kernel-path device-trace claims are '
+          'TPU-only (CPU interpret parity lives in tests/test_ops.py)')
+    fl_ga = s_cal._graph_arrays()
+    fl_meta = s_cal._csr_meta()
+    fl_blocks = glt.ops.build_indices128(fl_ga['indices'], min_rows=5)
+    fl_seeds = jnp.asarray(np.random.default_rng(8).integers(
+        0, NUM_NODES, BATCH).astype(np.int32))
+    fl_k = FANOUT[0]
+    fl_cap = BATCH + BATCH * fl_k
+    fl_key = jax.random.fold_in(jax.random.PRNGKey(0), 2)
+    fl_state, fl_uniq, fl_umask, _ = glt.ops.init_node_merge(
+        fl_seeds, jnp.ones((BATCH,), bool), fl_cap)
+
+    def _fl_call(blocks):
+      return glt.ops.sample_level_fused(
+          fl_ga['indptr'], fl_ga['indices'], blocks, fl_uniq, fl_umask,
+          fl_k, fl_key, fl_state, jnp.arange(BATCH, dtype=jnp.int32),
+          meta=fl_meta, prefix_cap=BATCH, max_new=BATCH * fl_k,
+          final=True)
+    fl_ms = _traced_call_ms(jax, lambda: _fl_call(fl_blocks),
+                            '/tmp/glt_bench_fusedlevel',
+                            'jit_sample_level_fused')
+    flx_ms = _traced_call_ms(jax, lambda: _fl_call(None),
+                             '/tmp/glt_bench_xlalevel',
+                             'jit_sample_level_fused')
+    result['fused_multihop_ms'] = round(fl_ms, 3) if fl_ms else None
+    result['fused_multihop_vs_xla_ratio'] = (
+        round(fl_ms / flx_ms, 3) if fl_ms and flx_ms else None)
+    result['fused_multihop_config'] = (
+        f'one level, {BATCH} seeds x k={fl_k}, prefix_cap={BATCH}, '
+        'window=512, block_seeds=128, bench CSR vs uniform draw + '
+        'induce_next_merge (same jitted entry, blocks128=None)')
+  except Exception as e:
+    result['fused_multihop_error'] = f'{type(e).__name__}: {e}'[:200]
+
   # ---- hetero (IGBH-shaped RGNN/RGAT) train step --------------------
   try:
     for conv, key in (('sage', 'hetero_rgnn'), ('gat', 'hetero_rgat')):
@@ -1714,6 +1794,67 @@ def main():
   except Exception as e:
     result['dist_oversub_epoch_wall_s'] = None
     result['dist_oversub_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- demand-paged PER-STEP oversubscribed gather (storage/dist.py,
+  # ISSUE 16): TieredDistFeature.get on an oversubscribed store (hot
+  # prefix + per-step demand-paged slabs) vs the identical all-HBM
+  # per-step loop. Rows must be bit-identical (the exact per-step
+  # plan); the ratio prices the per-step host round trip the scanned
+  # path amortizes at chunk boundaries. Fetch-bearing BY DESIGN.
+  try:
+    import tempfile
+    import time as _time
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from graphlearn_tpu.storage import TieredDistFeature
+    ps_p, ps_f, ps_n = 4, 32, 20_000
+    ps_batch, ps_steps = 256, 16
+    ps_rng = np.random.default_rng(37)
+    ps_pb = (np.arange(ps_n) % ps_p).astype(np.int32)
+    ps_feats = [(np.nonzero(ps_pb == q)[0].astype(np.int64),
+                 ps_rng.standard_normal(
+                     (int((ps_pb == q).sum()), ps_f)).astype(np.float32))
+                for q in range(ps_p)]
+    ps_mesh = Mesh(np.array(jax.devices()[:ps_p]), ('g',))
+    ps_npart = max(ids.shape[0] for ids, _ in ps_feats)
+    ps_hot = max(1, ps_npart // 8)               # 8x oversubscription
+    ps_stores = [
+        TieredDistFeature(ps_p, ps_feats, ps_pb, mesh=ps_mesh,
+                          spill_dir=tempfile.mkdtemp(prefix='glt_ps_'),
+                          hot_prefix_rows=h, split_ratio=0.1)
+        for h in (0, ps_hot)]
+    ps_ids = ps_rng.integers(
+        0, ps_n, (ps_steps, ps_p, ps_batch)).astype(np.int32)
+
+    def ps_loop(store):
+      # compile pass over every step (the demand-paged path keys its
+      # programs by pow2 slab cap — all caps must be warm), then the
+      # measured pass over the identical stream
+      for s in range(ps_steps):
+        jax.block_until_ready(store.get(ps_ids[s]))
+      t0 = _time.perf_counter()
+      outs = [store.get(ps_ids[s]) for s in range(ps_steps)]
+      jax.block_until_ready(outs)
+      wall = _time.perf_counter() - t0
+      return wall, np.stack([np.asarray(jax.device_get(o))
+                             for o in outs])
+    hbm_wall, hbm_rows = ps_loop(ps_stores[0])
+    ps_wall, ps_rows = ps_loop(ps_stores[1])
+    result['oversub_per_step_wall_s'] = round(ps_wall, 3)
+    result['oversub_per_step_hbm_wall_s'] = round(hbm_wall, 3)
+    result['oversub_per_step_ratio'] = round(ps_wall / hbm_wall, 3)
+    result['oversub_per_step_bit_identical'] = bool(
+        np.array_equal(hbm_rows, ps_rows))
+    result['oversub_per_step_config'] = (
+        f'N={ps_n}, F={ps_f}, P={ps_p} mesh, hot prefix '
+        f'{ps_hot}/{ps_npart} rows/shard '
+        f'({ps_npart / ps_hot:.1f}x oversub), batch {ps_batch}/shard '
+        f'x {ps_steps} per-step get() dispatches, split_ratio=0.1')
+  except Exception as e:
+    result['oversub_per_step_ratio'] = None
+    result['oversub_per_step_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- chunk-granular recovery (recovery/, docs/recovery.md) ----
   # Three measurements on one scanned fixture: (1) plain epoch wall,

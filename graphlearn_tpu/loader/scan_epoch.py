@@ -86,6 +86,11 @@ def _resolve_tuned_config(trainer_name: str, dataset, chunk_size,
     config.validate_dataset(dataset, where=trainer_name)
     if chunk_size is None:
       chunk_size = config.trainer_kwargs()['chunk_size']
+    if hasattr(config, 'apply_kernel_routing'):
+      # kernel selection is an artifact choice, not an env var: stamp
+      # the tuned gather-kernel routing onto the dataset's feature
+      # store (tune/artifact.py; v1 artifacts carry kernels-off)
+      config.apply_kernel_routing(dataset)
   return 32 if chunk_size is None else int(chunk_size)
 
 

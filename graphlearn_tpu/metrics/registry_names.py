@@ -66,6 +66,12 @@ REGISTERED_METRICS = frozenset({
     'storage.staged_bytes',
     'storage.dist_staged_rows',
     'storage.prefetch_miss',
+    # demand-paged PER-STEP gather on oversubscribed dist stores
+    # (storage/dist.py): one demand_pages tick per get() step, staged
+    # row count, and the host routing+gather latency
+    'storage.demand_pages',
+    'storage.demand_paged_rows',
+    'storage.demand_page_ms',
     'storage.stage_ms',
     'storage.promote_ms',
     'storage.ring_rows',
@@ -92,6 +98,7 @@ REGISTERED_METRICS = frozenset({
     'ops.gather_runs',
     'ops.gather_fallbacks',
     'ops.fused_hop_calls',
+    'ops.fused_level_calls',
     'ops.gather_ms',
     'checkpoint.saves',
     'checkpoint.bytes',
@@ -149,6 +156,9 @@ REGISTERED_SPANS = frozenset({
     # out-of-core staging pipeline (storage/staging.py): one span per
     # staged chunk on the worker thread
     'storage.stage',
+    # demand-paged per-step gather (storage/dist.py): one span per
+    # oversubscribed get() step's host routing + tier gather
+    'storage.demand_page',
     # chunk-staged remote scan (docs/remote_scan.md): one span per
     # server-side block build and one per client-side block fetch
     'remote.block_stage',

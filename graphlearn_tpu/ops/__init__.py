@@ -19,7 +19,8 @@ from .neighbor import (BLOCK, build_padded_adjacency,
                        weighted_sample_local)
 from .route import (exchange_capacity, gather_from_buckets, round8,
                     route_slots, scatter_to_buckets)
-from .sample_fused import build_indices128, sample_hop_fused
+from .sample_fused import (LEVEL_MAX_CANDIDATES, build_indices128,
+                           sample_hop_fused, sample_level_fused)
 from .stitch import stitch_rows
 from .subgraph import (node_subgraph, node_subgraph_bucketed,
                        node_subgraph_local)

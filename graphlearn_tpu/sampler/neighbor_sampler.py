@@ -363,40 +363,55 @@ def _fused_homo_fn(fanouts, caps, node_cap, with_edge, weighted, mode,
       # smaller sorts under calibrated plans, and keeps the contiguous
       # node append statically safe
       node_offs, _ = merge_layout_from_caps(caps, fanouts)
+    # fused LEVEL routing: under the merge engine the whole level —
+    # sample + gather + exact dedup — runs as ONE kernel pass
+    # (ops.sample_level_fused, the dedup map resolved in-kernel); tree
+    # mode keeps the hop kernel + its positional inducer (the tree
+    # layout needs no cross-hop dedup, so there is no map to fuse)
+    fused_level = fused_hop and mode == 'merge'
     for i, k in enumerate(fanouts):
-      if padded:
-        nbrs, epos, m = ops.uniform_sample_padded(
-            tab, deg, frontier, fmask, k, keys[i], epos_table=eptab)
-      elif block_num_edges:
-        # deg is the metadata row gather; tab = (csr_meta, indices_blocks)
-        nbrs, epos, m = ops.uniform_sample_block(
-            deg, tab, block_num_edges, frontier, fmask, k, keys[i])
-      elif weighted:
-        nbrs, epos, m = ops.weighted_sample(indptr, indices, cum, frontier,
-                                            fmask, k, keys[i])
-      elif fused_hop:
-        # fused sample+gather Pallas hop (ops/sample_fused.py): same
-        # fold_in stream as uniform_sample bit for bit — tab carries the
-        # [E/128, 128] aligned indices view, deg the csr_meta row table.
-        # Off-TPU the op routes its own XLA fallback, so the flag is
-        # safe to leave on in CPU tests ('interpret' forces the kernel
-        # through the Pallas interpreter for parity coverage).
-        nbrs, epos, m = ops.sample_hop_fused(
-            indptr, indices, tab, frontier, fmask, k, keys[i], meta=deg,
-            window=fused_hop_window,
+      if fused_level:
+        state, out, epos, m = ops.sample_level_fused(
+            indptr, indices, tab, frontier, fmask, k, keys[i], state,
+            fidx, meta=deg, prefix_cap=node_offs[i], max_new=caps[i + 1],
+            final=(i + 1 == len(fanouts)), window=fused_hop_window,
             interpret=(fused_hop == 'interpret'))
       else:
-        # deg slot carries the [N, 2] csr_meta row table for plain
-        # uniform sampling (see _fused_args / ops.uniform_sample)
-        nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
-                                           fmask, k, keys[i], meta=deg)
-      # the frontier feeds the next hop at caps[i+1] width; when nothing
-      # truncates it (no node_budget clamp) the map inducer can emit it
-      # positionally and skip two S-element compaction scatters
-      compact = (i + 1 < len(caps)) and caps[i + 1] < caps[i] * k
-      state, out = induce_fn(state, fidx, nbrs, m, node_offs[i],
-                             compact, final=(i + 1 == len(fanouts)),
-                             max_new=caps[i + 1])
+        if padded:
+          nbrs, epos, m = ops.uniform_sample_padded(
+              tab, deg, frontier, fmask, k, keys[i], epos_table=eptab)
+        elif block_num_edges:
+          # deg is the metadata row gather; tab = (csr_meta,
+          # indices_blocks)
+          nbrs, epos, m = ops.uniform_sample_block(
+              deg, tab, block_num_edges, frontier, fmask, k, keys[i])
+        elif weighted:
+          nbrs, epos, m = ops.weighted_sample(indptr, indices, cum,
+                                              frontier, fmask, k, keys[i])
+        elif fused_hop:
+          # fused sample+gather Pallas hop (ops/sample_fused.py): same
+          # fold_in stream as uniform_sample bit for bit — tab carries
+          # the [E/128, 128] aligned indices view, deg the csr_meta row
+          # table. Off-TPU the op routes its own XLA fallback, so the
+          # flag is safe to leave on in CPU tests ('interpret' forces
+          # the kernel through the Pallas interpreter for parity
+          # coverage).
+          nbrs, epos, m = ops.sample_hop_fused(
+              indptr, indices, tab, frontier, fmask, k, keys[i], meta=deg,
+              window=fused_hop_window,
+              interpret=(fused_hop == 'interpret'))
+        else:
+          # deg slot carries the [N, 2] csr_meta row table for plain
+          # uniform sampling (see _fused_args / ops.uniform_sample)
+          nbrs, epos, m = ops.uniform_sample(indptr, indices, frontier,
+                                             fmask, k, keys[i], meta=deg)
+        # the frontier feeds the next hop at caps[i+1] width; when
+        # nothing truncates it (no node_budget clamp) the map inducer can
+        # emit it positionally and skip two S-element compaction scatters
+        compact = (i + 1 < len(caps)) and caps[i + 1] < caps[i] * k
+        state, out = induce_fn(state, fidx, nbrs, m, node_offs[i],
+                               compact, final=(i + 1 == len(fanouts)),
+                               max_new=caps[i + 1])
       # message direction: neighbor -> seed
       rows.append(out['cols'])
       cols.append(out['rows'])
@@ -984,9 +999,15 @@ class NeighborSampler(BaseSampler):
       fn = self._homo_fn(cap, fanouts)
       if self.use_fused_hop:
         # kernel-path observability: batches whose hop program routed
-        # through the fused Pallas kernel (len(fanouts) hops per call)
+        # through the fused Pallas kernel (len(fanouts) hops per call).
+        # Under the merge engine the whole LEVEL fuses (sample + gather
+        # + in-kernel dedup, ops.sample_level_fused); other engines fuse
+        # the sample+gather hop only.
         from .. import metrics
-        metrics.inc('ops.fused_hop_calls')
+        if self._dedup_mode() == 'merge':
+          metrics.inc('ops.fused_level_calls')
+        else:
+          metrics.inc('ops.fused_hop_calls')
       record_dispatch('sample')
       res = fn(*self._fused_args(), jnp.asarray(padded), jnp.asarray(mask),
                key)

@@ -97,6 +97,11 @@ class ServingEngine:
             'docs/tuning.md)')
       if buckets is None:
         buckets = config.serving_kwargs()['buckets']
+      if hasattr(config, 'apply_kernel_routing'):
+        # the tuned gather-kernel choice reaches the engine's store
+        # (EmbeddingStore.set_kernel_routing); stores without the
+        # surface (dist/tiered) simply don't accept it
+        config.apply_kernel_routing(store)
     if buckets is None:
       buckets = DEFAULT_BUCKETS
     buckets = tuple(sorted(int(b) for b in set(buckets)))

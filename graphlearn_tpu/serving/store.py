@@ -55,6 +55,14 @@ class EmbeddingStore:
 
   granularity = 1
 
+  #: tuned kernel routing (tune/artifact.py apply_kernel_routing):
+  #: route the bucket gather through the run-segmented DMA kernel
+  #: (ops.gather_rows_hbm2) at the tuned grid point — the same gate as
+  #: UnifiedTensor: inert off-TPU or on non-128-lane-aligned widths
+  use_pallas_v2 = False
+  pallas_v2_block_rows = 256
+  pallas_v2_run_span = 8
+
   def __init__(self, embeddings, num_nodes: Optional[int] = None):
     import jax
     self._emb = jax.device_put(np.asarray(embeddings)) \
@@ -66,6 +74,18 @@ class EmbeddingStore:
     # one-executable-per-bucket without per-cap bookkeeping here
     self._gather = None
     self._scatter = None
+    self._kernel_routed = False
+
+  def set_kernel_routing(self, use_pallas_v2: bool = False,
+                         block_rows: int = 256, run_span: int = 8):
+    """Apply a tuned-artifact kernel choice to the lookup gather.
+    Rebuilds the gather program on the next lookup; the bucket set and
+    semantics are unchanged (the kernel is bit-identical to the XLA
+    gather — ops/gather_pallas.py)."""
+    self.use_pallas_v2 = bool(use_pallas_v2)
+    self.pallas_v2_block_rows = int(block_rows)
+    self.pallas_v2_run_span = int(run_span)
+    self._gather = None
 
   @property
   def feature_dim(self) -> int:
@@ -75,10 +95,23 @@ class EmbeddingStore:
     if self._gather is None:
       import jax
       import jax.numpy as jnp
+      self._kernel_routed = (
+          self.use_pallas_v2 and jax.default_backend() == 'tpu' and
+          self._emb.shape[1] % 128 == 0)
+      if self._kernel_routed:
+        from ..ops.gather_pallas import _gather_rows_hbm2_impl
+        br, rs = self.pallas_v2_block_rows, self.pallas_v2_run_span
 
-      def gather(emb, ids, mask):
-        rows = emb[jnp.maximum(ids, 0)]
-        return jnp.where(mask[:, None], rows, 0)
+        def gather(emb, ids, mask):
+          rows = _gather_rows_hbm2_impl(
+              emb, jnp.maximum(ids, 0).astype(jnp.int32), br, rs,
+              False, False)
+          return jnp.where(mask[:, None], rows, 0)
+      else:
+
+        def gather(emb, ids, mask):
+          rows = emb[jnp.maximum(ids, 0)]
+          return jnp.where(mask[:, None], rows, 0)
 
       from ..metrics import programs
       self._gather = programs.instrument(jax.jit(gather),
@@ -90,8 +123,12 @@ class EmbeddingStore:
     One dispatch; the capacity's program persists across requests."""
     import jax.numpy as jnp
     ids = jnp.asarray(ids)
+    fn = self._gather_fn()
+    if self._kernel_routed:
+      from .. import metrics
+      metrics.inc('ops.gather_runs')
     record_dispatch('serve_lookup')
-    return self._gather_fn()(self._emb, ids, jnp.asarray(mask))
+    return fn(self._emb, ids, jnp.asarray(mask))
 
   def fetch(self, rows) -> np.ndarray:
     """Device rows -> host (the engine's single fetch per batch)."""

@@ -30,6 +30,25 @@ structures), and ``storage.TieredDistScanTrainer`` answers the
 remaining positions from per-chunk staged slabs computed by the epoch
 prologue's exact miss-exchange program — the
 ``DistFeature._shard_body(slab=True)`` lookup path.
+
+PER-STEP demand paging (PR 16): the per-step loader path
+(``DistFeature.get`` on arbitrary [P, b] request blocks) rides the
+SAME slab-backed lookup. An oversubscribed store overrides
+``_build_fn`` so each ``get`` step routes its own miss set on the host
+— ``planner.plan_exchange`` over the step's ids as a one-chunk plan,
+the exact searchsorted-position routing the scanned prologue uses —
+gathers those positions from the disk tiers into a pow2-padded
+[P, cap] slab (``DistChunkStager._gather``'s layout), and dispatches
+the ``_shard_body(slab=True)`` program over the hot prefix + slab.
+Under the exact per-step plan every requested position >= H is in the
+slab, so the returned rows are bit-identical to the all-HBM program
+(tests/test_dist_oversub.py pins it). Per-step staging is inherently
+synchronous — the request set only exists at step time — i.e. the
+demand-paged path IS the ChunkStager degrade-to-sync contract applied
+every step: each page counts into ``storage.prefetch_miss`` alongside
+the new ``storage.demand_pages`` / ``storage.demand_paged_rows`` /
+``storage.demand_page_ms`` series under a ``storage.demand_page`` span
+(docs/observability.md).
 """
 import os
 from typing import Optional
@@ -62,6 +81,8 @@ class TieredDistFeature(DistFeature):
     # sorted row table stay device-resident; the rest stage per chunk
     self.hot_prefix_rows = int(hot_prefix_rows)
     self._scan_dev = None
+    # demand-paged per-step programs, keyed b -> {slab cap -> jitted fn}
+    self._slab_fns = {}
     super().__init__(num_partitions, feat_parts, feature_pb, mesh=mesh,
                      dtype=dtype, **kwargs)
 
@@ -140,25 +161,25 @@ class TieredDistFeature(DistFeature):
 
     OVERSUBSCRIBED stores refuse this path: with ``hot_prefix_rows``
     set, the operator declared that a shard's full partition does NOT
-    fit in HBM — uploading the full [P, n_max, F] table anyway (which
-    is what every per-step consumer of device_arrays does) would
+    fit in HBM — uploading the full [P, n_max, F] table anyway would
     silently defeat the oversubscription, or OOM on a real topology.
-    The scanned path (``storage.TieredDistScanTrainer`` over
-    ``dist_scan_tables()``) is the supported consumer; the loud error
-    here is ROADMAP 2b's per-step scope gap made explicit."""
+    The store's OWN per-step ``get`` never comes here any more (its
+    ``_build_fn`` override demand-pages through ``dist_scan_tables``,
+    module docstring); this error now guards only DIRECT external
+    consumers of the full table."""
     if self.hot_prefix_rows > 0:
       raise RuntimeError(
           f'TieredDistFeature(hot_prefix_rows={self.hot_prefix_rows}) '
           'is OVERSUBSCRIBED: device_arrays() would upload the full '
           f'[{self.num_partitions}, {self.n_max}, {self.feature_dim}] '
           'partition table to HBM, silently defeating the declared '
-          'oversubscription (or OOMing at real scale). The per-step '
-          'distributed loader path has no slab-staging story — drive '
-          'this store through storage.TieredDistScanTrainer (the '
-          'scanned exchange over dist_scan_tables(), docs/storage.md '
-          "'Device oversubscription through the shard exchange'), or "
-          'construct it with hot_prefix_rows=0 to accept the full '
-          'upload')
+          'oversubscription (or OOMing at real scale). Per-step get() '
+          'demand-pages automatically (hot prefix + per-step slab, '
+          "docs/storage.md 'Demand-paged per-step gather'), and the "
+          'scanned path stages per chunk via '
+          'storage.TieredDistScanTrainer; a consumer that really needs '
+          'the full table must construct the store with '
+          'hot_prefix_rows=0 to accept the full upload')
     if self._dev is None:
       import jax
       from jax.sharding import NamedSharding, PartitionSpec as P
@@ -242,6 +263,126 @@ class TieredDistFeature(DistFeature):
           cache_ids=global_device_put(cache_ids, repl),
           cache_feats=global_device_put(cache_feats, repl))
     return self._scan_dev
+
+  # ---------------------------------------------- per-step demand paging
+
+  def _demand_slab(self, ids_host: np.ndarray, mask_host: np.ndarray):
+    """Host miss routing + tier gather for ONE step's [P, b] request
+    block: ``planner.plan_exchange`` over the masked ids as a
+    single-chunk plan (the scanned prologue's exact position routing —
+    replicated-cache hits drop before routing, owners come from the
+    partition book, positions from searchsorted over the sorted id
+    table, positions < H are HBM-resident and drop out), then the
+    staged positions gather from the disk tiers into the
+    ``DistChunkStager._gather`` slab layout. Returns ``(slab_pos
+    [P, cap] int32 sorted + INT32_MAX pads, slab_rows [P, cap, F],
+    staged_row_count)``."""
+    from . import planner
+    nparts, n_max = self.num_partitions, self.n_max
+    masked = np.where(mask_host, ids_host, -1)
+    plan = planner.plan_exchange(
+        masked, masked.shape[1], self.feature_pb, self.feat_ids,
+        self.hot_prefix_rows, cache_ids=self.cache_ids)
+    enc = plan.chunk_rows[0]
+    cap = plan.slab_caps()[0]
+    owners = enc // n_max
+    pos = enc % n_max
+    counts = (np.bincount(owners, minlength=nparts) if enc.size
+              else np.zeros((nparts,), np.int64))
+    slab_pos = np.full((nparts, cap), INT32_MAX, np.int32)
+    slab_rows = np.zeros((nparts, cap, self.feature_dim),
+                         self.storage_dtype)
+    for p in range(nparts):
+      kp = int(counts[p])
+      if kp:
+        m = owners == p
+        slab_pos[p, :kp] = pos[m].astype(np.int32)
+        slab_rows[p, :kp] = self.gather_positions(p, pos[m])
+    return slab_pos, slab_rows, int(enc.shape[0])
+
+  def _build_slab_fn(self, b: int, cap: int):
+    """The slab-backed per-step lookup program, keyed (b, cap): the
+    base ``_build_fn`` shard_map shape with ``_shard_body(slab=True)``
+    as the core — feats is the (hot, slab_pos, slab_rows) pytree
+    instead of the full [n, F] partition view."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+    ax = tuple(self.mesh.axis_names)
+    core = self._shard_body(b, slab=True)
+
+    def body(feat_ids, hot, slab_pos, slab_rows, pb, cache_ids,
+             cache_feats, stats, ids, mask):
+      out, new_stats = core(
+          feat_ids[0], (hot[0], slab_pos[0], slab_rows[0]), pb,
+          cache_ids, cache_feats, stats[0], ids[0], mask[0])
+      return out[None], new_stats[None]
+
+    fn = shard_map(
+        body, mesh=self.mesh,
+        in_specs=(P(ax), P(ax), P(ax), P(ax), P(), P(), P(), P(ax),
+                  P(ax), P(ax)),
+        out_specs=(P(ax), P(ax)))
+    return jax.jit(fn)
+
+  def _build_fn(self, b: int):
+    """Per-step lookup program. All-HBM stores (hot_prefix_rows == 0)
+    keep DistFeature's one-dispatch program over the full partition
+    table; OVERSUBSCRIBED stores get the demand-paged path (module
+    docstring): per-step host miss routing + tier gather into a pow2
+    slab, then the ``_shard_body(slab=True)`` program over the hot
+    prefix — bit-identical rows, one extra host round trip per step."""
+    if self.hot_prefix_rows <= 0:
+      return super()._build_fn(b)
+    import functools
+    return functools.partial(self._demand_run, b)
+
+  def _demand_run(self, b: int, ids, mask):
+    """One demand-paged per-step dispatch: host miss routing + tier
+    gather into the step's slab, sharded upload, and the (b, cap)
+    slab-backed program. Host-side by design — the per-step request
+    set only exists at step time, so the page is the explicit host
+    round trip the ChunkStager's degrade-to-sync path makes at a chunk
+    boundary, taken every step."""
+    import time as _time
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import metrics
+    from ..metrics import spans
+    from ..utils import global_device_put
+    scan = self.dist_scan_tables()
+    # explicit fetch — the strict guards reject implicit transfers only
+    ids_host = np.asarray(jax.device_get(ids))
+    mask_host = np.asarray(jax.device_get(mask))
+    with spans.span('storage.demand_page', b=int(ids_host.shape[1])):
+      t0 = _time.perf_counter()
+      slab_pos_np, slab_rows_np, staged = self._demand_slab(
+          ids_host, mask_host)
+      metrics.observe('storage.demand_page_ms',
+                      (_time.perf_counter() - t0) * 1e3)
+      metrics.inc('storage.demand_pages')
+      if staged:
+        metrics.inc('storage.demand_paged_rows', staged)
+        # every demand page is, definitionally, a prefetch miss: the
+        # sync-stage counter keeps the degrade-to-sync accounting
+        # comparable across the scanned and per-step paths
+        metrics.inc('storage.prefetch_miss', staged)
+    sharded = NamedSharding(self.mesh, P(tuple(self.mesh.axis_names)))
+    slab_pos = global_device_put(slab_pos_np, sharded)
+    slab_rows = global_device_put(slab_rows_np, sharded)
+    cap = int(slab_pos_np.shape[1])
+    fns = self._slab_fns.setdefault(b, {})
+    jfn = fns.get(cap)
+    if jfn is None:
+      jfn = fns[cap] = self._build_slab_fn(b, cap)
+    out, self._stats = jfn(
+        scan['feat_ids'], scan['hot'], slab_pos, slab_rows,
+        scan['feature_pb'], scan['cache_ids'], scan['cache_feats'],
+        self._stats_dev(), ids, mask)
+    return out
 
   def tier_bytes(self) -> dict:
     """Resident vs on-disk byte accounting (sizing guidance,

@@ -7,15 +7,17 @@ the serving engine accept directly via ``config=`` — every scenario
 lands on the fast path from one call, and a config that would retrace
 is rejected by construction.
 """
-from .artifact import (ARTIFACT_VERSION, TuneArtifact,
-                       dataset_fingerprint)
-from .tuner import (Candidate, default_candidates,
+from .artifact import (ARTIFACT_VERSION, KERNEL_CHOICE_DEFAULTS,
+                       KERNEL_CHOICE_KEYS, TuneArtifact,
+                       apply_kernel_routing, dataset_fingerprint)
+from .tuner import (Candidate, default_candidates, kernel_candidates,
                     retrace_probe_candidate, score_candidate, tune)
 
 __all__ = [
-    'ARTIFACT_VERSION', 'TuneArtifact', 'dataset_fingerprint',
-    'Candidate', 'default_candidates', 'retrace_probe_candidate',
-    'score_candidate', 'tune',
+    'ARTIFACT_VERSION', 'KERNEL_CHOICE_DEFAULTS', 'KERNEL_CHOICE_KEYS',
+    'TuneArtifact', 'apply_kernel_routing', 'dataset_fingerprint',
+    'Candidate', 'default_candidates', 'kernel_candidates',
+    'retrace_probe_candidate', 'score_candidate', 'tune',
 ]
 
 # `graphlearn_tpu.tune(dataset, loader_cfg)` IS the advertised one

@@ -31,16 +31,40 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-#: bump when the schema changes shape (loaders refuse unknown versions)
-ARTIFACT_VERSION = 1
+#: bump when the schema changes shape (loaders refuse unknown versions;
+#: version 1 — pre-kernel-routing — loads with the documented defaults)
+ARTIFACT_VERSION = 2
 
-#: the knob set every artifact carries (docs/tuning.md knob table) —
-#: a choices dict is validated against this closed set on load
-CHOICE_KEYS = frozenset({
+#: version 1's closed knob set — a v1 file is validated against THIS
+#: set (and its own version-1 fingerprint) before the upgrade path
+#: fills in the kernel-routing keys it predates
+_V1_CHOICE_KEYS = frozenset({
     'mode', 'frontier_caps', 'padded_window', 'wire_dtype', 'chunk_k',
     'split_ratio', 'bucket_frac', 'slab_cap', 'serving_buckets',
     'batch_size', 'fanouts', 'exact',
 })
+
+#: the kernel-routing knobs added in schema version 2 (docs/tuning.md
+#: 'Kernel candidates'): which Pallas fast paths the observatory A/Bs
+#: selected, and their grid points (benchmarks/prof_gather2.py space)
+KERNEL_CHOICE_KEYS = frozenset({
+    'use_pallas_v2', 'gather2_block_rows', 'gather2_run_span',
+    'use_fused_hop', 'fused_hop_window',
+})
+
+#: the defaults a choices dict missing kernel keys (hand-built, or a
+#: version-1 artifact on the upgrade path) is completed with: KERNELS
+#: OFF — routing a kernel in is an evidence-backed choice, never an
+#: implicit one
+KERNEL_CHOICE_DEFAULTS = {
+    'use_pallas_v2': False, 'gather2_block_rows': 256,
+    'gather2_run_span': 8, 'use_fused_hop': False,
+    'fused_hop_window': 512,
+}
+
+#: the knob set every artifact carries (docs/tuning.md knob table) —
+#: a choices dict is validated against this closed set on load
+CHOICE_KEYS = _V1_CHOICE_KEYS | KERNEL_CHOICE_KEYS
 
 
 def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
@@ -116,6 +140,11 @@ class TuneArtifact:
                        f'artifact knob set is closed (docs/tuning.md)')
     self.version = ARTIFACT_VERSION
     self.choices = dict(choices)
+    # kernel-routing keys are part of the closed v2 set: complete a
+    # partial dict with the documented kernels-off defaults so the
+    # fingerprint is a function of the FULL assignment
+    for key, default in KERNEL_CHOICE_DEFAULTS.items():
+      self.choices.setdefault(key, default)
     self.dataset = dict(dataset) if dataset is not None else None
     self.evidence = list(evidence or [])
     self.fingerprint = compute_fingerprint(self.version, self.dataset,
@@ -131,12 +160,40 @@ class TuneArtifact:
   @classmethod
   def from_json(cls, obj: dict) -> 'TuneArtifact':
     v = obj.get('version')
-    if v != ARTIFACT_VERSION:
+    if v not in (1, ARTIFACT_VERSION):
       raise ValueError(f'unsupported tune-artifact version {v!r} '
-                       f'(this build reads version {ARTIFACT_VERSION})')
+                       f'(this build reads versions 1 and '
+                       f'{ARTIFACT_VERSION})')
+    stored = obj.get('fingerprint')
+    if v == 1:
+      # pre-kernel-routing artifact: validate against ITS OWN closed
+      # knob set and version-1 fingerprint (the file must still be the
+      # tuner's, untouched), then upgrade — the kernel-routing keys it
+      # predates load as the documented defaults (kernels off,
+      # docs/tuning.md 'Artifact schema'), never as a refusal
+      choices = dict(obj['choices'])
+      unknown = set(choices) - _V1_CHOICE_KEYS
+      if unknown:
+        raise ValueError(f'unknown choice keys {sorted(unknown)} — the '
+                         'version-1 artifact knob set is closed '
+                         '(docs/tuning.md)')
+      if stored is not None:
+        expect = compute_fingerprint(1, obj.get('dataset'), choices)
+        if stored != expect:
+          raise ValueError(
+              f'tune-artifact fingerprint mismatch: stored {stored}, '
+              f'recomputed {expect} — the file was edited after the '
+              'tuner emitted it; re-run tune() instead of hand-patching '
+              'a signed artifact (docs/tuning.md)')
+      art = cls(choices, obj.get('dataset'), obj.get('evidence'))
+      art.evidence.append(dict(
+          kind='schema_upgrade', from_version=1,
+          to_version=ARTIFACT_VERSION,
+          note='pre-kernel-routing artifact: kernel choices defaulted '
+               'to off (docs/tuning.md)'))
+      return art
     art = cls(obj['choices'], obj.get('dataset'),
               obj.get('evidence'))
-    stored = obj.get('fingerprint')
     if stored is not None and stored != art.fingerprint:
       raise ValueError(
           f'tune-artifact fingerprint mismatch: stored {stored}, '
@@ -199,7 +256,29 @@ class TuneArtifact:
       kw['frontier_caps'] = list(self.choices['frontier_caps'])
     if self.choices.get('padded_window') is not None:
       kw['padded_window'] = self.choices['padded_window']
+    if self.choices.get('use_fused_hop'):
+      # the tuned fused-hop kernel routing rides the loader flags
+      # (sampler/neighbor_sampler.py use_fused_hop) — off stays absent
+      # so pre-kernel loaders see an unchanged kwarg surface
+      kw['use_fused_hop'] = self.choices['use_fused_hop']
+      kw['fused_hop_window'] = int(
+          self.choices.get('fused_hop_window',
+                           KERNEL_CHOICE_DEFAULTS['fused_hop_window']))
     return kw
+
+  def kernel_kwargs(self) -> dict:
+    """The tuned kernel-routing bundle (KERNEL_CHOICE_KEYS): which
+    Pallas fast paths the observatory A/Bs selected. Kernels default
+    off — a key absent from an older choices dict reads as off."""
+    return {k: self.choices.get(k, KERNEL_CHOICE_DEFAULTS[k])
+            for k in KERNEL_CHOICE_KEYS}
+
+  def apply_kernel_routing(self, target) -> bool:
+    """Stamp the tuned gather-kernel routing onto ``target``'s feature
+    / embedding store (the ``config=`` acceptors call this so kernel
+    selection is an artifact choice, not an env var). Returns True
+    when at least one store accepted the flags."""
+    return apply_kernel_routing(target, self.kernel_kwargs())
 
   def trainer_kwargs(self) -> dict:
     """Scan-trainer kwargs (chunk K); the trainers also re-validate the
@@ -209,3 +288,29 @@ class TuneArtifact:
   def serving_kwargs(self) -> dict:
     """ServingEngine kwargs (the calibrated padded-bucket ladder)."""
     return dict(buckets=tuple(self.choices['serving_buckets']))
+
+
+def apply_kernel_routing(target, kernel: Optional[dict] = None) -> bool:
+  """Route the chosen gather kernel into every store hanging off
+  ``target`` that understands ``set_kernel_routing`` (data.Feature /
+  storage.TieredFeature via their UnifiedTensor, serving's
+  EmbeddingStore). ``target`` may be a Dataset (its ``node_features``
+  are walked, hetero dicts included), a feature store, or an embedding
+  store. Keys absent from ``kernel`` fall back to the kernels-off
+  defaults, so applying is idempotent AND resets flags a previous
+  candidate probe set (tune/tuner.py scores candidates in sequence
+  over one dataset)."""
+  kw = dict(KERNEL_CHOICE_DEFAULTS)
+  kw.update({k: v for k, v in (kernel or {}).items() if v is not None})
+  stores = getattr(target, 'node_features', target)
+  if not isinstance(stores, dict):
+    stores = {None: stores}
+  applied = False
+  for store in stores.values():
+    if hasattr(store, 'set_kernel_routing'):
+      store.set_kernel_routing(
+          use_pallas_v2=bool(kw['use_pallas_v2']),
+          block_rows=int(kw['gather2_block_rows']),
+          run_span=int(kw['gather2_run_span']))
+      applied = True
+  return applied

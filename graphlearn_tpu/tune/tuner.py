@@ -40,7 +40,8 @@ import numpy as np
 from .. import metrics
 from ..metrics import programs, spans
 from . import probes
-from .artifact import TuneArtifact, dataset_fingerprint
+from .artifact import (KERNEL_CHOICE_DEFAULTS, TuneArtifact,
+                       apply_kernel_routing, dataset_fingerprint)
 
 #: wall ratio under which two qualified candidates count as tied and
 #: the GLT_PROGRAM_COST attribution (flops, then peak HBM) breaks the
@@ -50,6 +51,21 @@ COST_TIE_MARGIN = 0.05
 #: the program sites a local scanned candidate dispatches through —
 #: the population the "one executable per site" acceptance counts
 CANDIDATE_SITES = ('epoch_seeds', 'scan_chunk', 'metrics_concat')
+
+#: the gather-v2 autotune space (benchmarks/prof_gather2.py's full
+#: grid) the kernel candidate field draws its grid points from —
+#: a point outside the profiled space would be an unmeasured claim
+GATHER2_GRID_BLOCKS = (64, 128, 256, 512)
+GATHER2_GRID_SPANS = (1, 4, 8, 16, 32)
+
+#: default kernel-routing grid points fielded per base candidate
+#: (docs/tuning.md 'Kernel candidates'): the prof_gather2 default
+#: (256, 8) plus the small-block point that wins on short runs
+DEFAULT_GATHER2_POINTS = ((256, 8), (128, 4))
+
+#: default fused-hop window variants fielded (off is the base
+#: candidate itself; windows must be 128-lane multiples)
+DEFAULT_FUSED_HOP_WINDOWS = (512,)
 
 
 class Candidate:
@@ -67,17 +83,25 @@ class Candidate:
       This is how tests (and operators validating a deployment) prove
       the disqualification path is live: the candidate MUST be
       rejected with the signature diff in the evidence log.
+    kernel: kernel-routing overrides (KERNEL_CHOICE_KEYS subset —
+      use_pallas_v2 / gather2 grid point / use_fused_hop / window)
+      applied to the dataset's feature store and loader flags for
+      this candidate's epochs. Keys absent read as the kernels-off
+      defaults, so scoring one candidate RESETS the previous
+      candidate's routing.
   """
 
   def __init__(self, name: str, loader_kwargs: Dict,
                chunk_k: Optional[int] = None,
                exact_semantics: bool = True,
-               perturb_chunk: bool = False):
+               perturb_chunk: bool = False,
+               kernel: Optional[Dict] = None):
     self.name = name
     self.loader_kwargs = dict(loader_kwargs)
     self.chunk_k = chunk_k
     self.exact_semantics = exact_semantics
     self.perturb_chunk = perturb_chunk
+    self.kernel = dict(kernel or {})
 
 
 def retrace_probe_candidate(base: Candidate) -> Candidate:
@@ -87,19 +111,64 @@ def retrace_probe_candidate(base: Candidate) -> Candidate:
   return Candidate(f'{base.name}+retrace_probe', base.loader_kwargs,
                    chunk_k=base.chunk_k,
                    exact_semantics=base.exact_semantics,
-                   perturb_chunk=True)
+                   perturb_chunk=True, kernel=base.kernel)
 
 
-def default_candidates(caps: List[int], exact: bool) -> List[Candidate]:
-  """The stock candidate field: calibrated exact dedup always; the
-  accuracy-matrix-certified tree relaxation unless ``exact=True``
-  pinned the exact set."""
-  cands = [Candidate('map_calibrated',
-                     dict(dedup='map', frontier_caps=list(caps)),
-                     exact_semantics=True)]
+def kernel_candidates(base: Candidate,
+                      gather2_points=DEFAULT_GATHER2_POINTS,
+                      fused_hop_windows=DEFAULT_FUSED_HOP_WINDOWS
+                      ) -> List[Candidate]:
+  """Kernel-routing variants of ``base`` (docs/tuning.md 'Kernel
+  candidates'): the fused sample+gather hop kernel at each window, and
+  the run-segmented DMA gather v2 at each (block_rows, run_span) grid
+  point from the prof_gather2 autotune space. Every variant is
+  bit-identical to ``base`` (the kernels' parity contract), so
+  ``exact_semantics`` carries over — only the program route differs,
+  which is exactly what the observatory A/B measures. Off-TPU the
+  kernels fall back to their XLA twins in-program, so a CPU-replica
+  tune() scores them honestly (ties break toward ``base``: the
+  stable sort prefers the earlier, kernels-off field entry)."""
+  out = []
+  for w in fused_hop_windows:
+    if w % 128:
+      raise ValueError(f'fused_hop window {w} must be a multiple of '
+                       '128 (the lane width — ops/sample_fused.py)')
+    out.append(Candidate(
+        f'{base.name}+fused_hop_w{w}',
+        dict(base.loader_kwargs, use_fused_hop=True,
+             fused_hop_window=int(w)),
+        chunk_k=base.chunk_k, exact_semantics=base.exact_semantics,
+        kernel=dict(use_fused_hop=True, fused_hop_window=int(w))))
+  for br, rs in gather2_points:
+    if br not in GATHER2_GRID_BLOCKS or rs not in GATHER2_GRID_SPANS:
+      raise ValueError(
+          f'gather2 grid point ({br}, {rs}) is outside the profiled '
+          f'autotune space {GATHER2_GRID_BLOCKS} x {GATHER2_GRID_SPANS} '
+          '(benchmarks/prof_gather2.py)')
+    out.append(Candidate(
+        f'{base.name}+gather2_b{br}r{rs}', base.loader_kwargs,
+        chunk_k=base.chunk_k, exact_semantics=base.exact_semantics,
+        kernel=dict(use_pallas_v2=True, gather2_block_rows=int(br),
+                    gather2_run_span=int(rs))))
+  return out
+
+
+def default_candidates(caps: List[int], exact: bool,
+                       kernels: bool = True) -> List[Candidate]:
+  """The stock candidate field: calibrated exact dedup always (first —
+  the stable-sort tie-break baseline), the accuracy-matrix-certified
+  tree relaxation unless ``exact=True`` pinned the exact set, then the
+  kernel-routing variants of the calibrated base (``kernels=False``
+  drops them for a probes-only field)."""
+  base = Candidate('map_calibrated',
+                   dict(dedup='map', frontier_caps=list(caps)),
+                   exact_semantics=True)
+  cands = [base]
   if not exact:
     cands.append(Candidate('tree', dict(dedup='tree'),
                            exact_semantics=False))
+  if kernels:
+    cands.extend(kernel_candidates(base))
   return cands
 
 
@@ -147,7 +216,8 @@ def _candidate_record(cand: Candidate, chunk_k: int) -> dict:
   return dict(kind='candidate', name=cand.name,
               loader_kwargs={k: v for k, v in cand.loader_kwargs.items()},
               chunk_k=int(cand.chunk_k or chunk_k),
-              exact_semantics=cand.exact_semantics)
+              exact_semantics=cand.exact_semantics,
+              kernel=dict(cand.kernel))
 
 
 def score_candidate(cand: Candidate, dataset, cfg: Dict, num_classes:
@@ -168,6 +238,10 @@ def score_candidate(cand: Candidate, dataset, cfg: Dict, num_classes:
   t_start = time.perf_counter()
   try:
     with spans.span('tune.candidate', candidate=cand.name, chunk_k=k):
+      # stamp THIS candidate's kernel routing on the dataset's feature
+      # store (keys absent -> kernels-off defaults, which also resets
+      # whatever the previous candidate routed in)
+      apply_kernel_routing(dataset, cand.kernel)
       lkw = dict(batch_size=cfg['batch_size'], shuffle=cfg['shuffle'],
                  drop_last=cfg['drop_last'], seed=cfg['seed'],
                  overflow_policy='off')
@@ -334,9 +408,15 @@ def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
                for c in cands]
     evidence.extend(records)
     best = _pick_winner(records)
+    kern = dict(KERNEL_CHOICE_DEFAULTS)
+    kern.update(best.get('kernel') or {})
     evidence.append(dict(kind='winner', name=best['name'],
                          wall_s=best['wall_s'],
-                         tie_break=best.get('tie_break', 'wall')))
+                         tie_break=best.get('tie_break', 'wall'),
+                         kernel=dict(kern)))
+    # leave the dataset routed the way the winner ran (score_candidate
+    # stamped the LAST candidate's routing, not necessarily the best's)
+    apply_kernel_routing(dataset, kern)
 
     choices = dict(
         mode=best['loader_kwargs'].get('dedup', 'map'),
@@ -351,6 +431,7 @@ def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
         batch_size=int(cfg['batch_size']),
         fanouts=list(cfg['fanouts']),
         exact=bool(exact))
+    choices.update(kern)
     art = TuneArtifact(choices, dataset_fingerprint(dataset), evidence)
   metrics.inc('tune.artifacts')
   if out_path is not None:

@@ -148,6 +148,8 @@ def test_tiered_dist_scan_bit_identical_ragged_tail_and_epoch2():
   trainer.close()
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): chaos degrade variant of the
+# ragged-tail bit-identity test above — same trainer, same equivalence
 def test_tiered_dist_scan_chaos_degrades_to_sync_bit_identical():
   """Armed ``storage.dist_stage`` fault: every staged slab fails on the
   worker, take() degrades to a synchronous gather of the SAME planned
@@ -227,6 +229,64 @@ def test_oversubscribed_device_arrays_raises_loudly():
                            spill_dir=tempfile.mkdtemp())
   dev = full.device_arrays()
   assert dev['feats'].shape[0] == NUM_PARTS
+
+
+def test_per_step_demand_paged_get_bit_identical():
+  """ISSUE 16 tentpole (c): per-step ``get()`` on an OVERSUBSCRIBED
+  TieredDistFeature demand-pages automatically — hot-prefix hits
+  resolve in HBM, misses stage through a per-step slab planned by the
+  same ``planner.plan_exchange`` routing the scanned path uses — and
+  every step's rows are BIT-IDENTICAL to a prefixless (all-HBM) store,
+  FILL pads included. The new counters fire (one demand_pages tick per
+  step; every staged row also lands in storage.prefetch_miss), the
+  slab-program cache stays closed over pow2 caps, and device_arrays()
+  keeps its loud refusal for direct full-table consumers."""
+  parts, feats, node_pb, _ = ring_fixture()
+  mesh = make_mesh()
+  over = TieredDistFeature(NUM_PARTS, feats, node_pb, mesh=mesh,
+                           spill_dir=tempfile.mkdtemp(),
+                           hot_prefix_rows=HOT_PREFIX)
+  full = TieredDistFeature(NUM_PARTS, feats, node_pb, mesh=mesh,
+                           spill_dir=tempfile.mkdtemp())
+
+  rng = np.random.default_rng(5)
+  b, steps = 6, 4
+  c0 = glt_metrics.default_registry().counters()
+  for step in range(steps):
+    ids = rng.integers(0, N, (NUM_PARTS, b)).astype(np.int64)
+    ids[0, -1] = -1                      # a FILL pad every step
+    if step == steps - 1:
+      # all-hot step: every id sits inside its owner's hot prefix
+      # (ids 0..2*HOT_PREFIX-1 are positions 0..HOT_PREFIX-1 on the
+      # round-robin partitions), so the demand slab stages ZERO rows
+      ids = np.tile(np.arange(b) % (2 * HOT_PREFIX),
+                    (NUM_PARTS, 1)).astype(np.int64)
+    got = np.asarray(over.get(ids))
+    ref = np.asarray(full.get(ids))
+    np.testing.assert_array_equal(got, ref)
+    valid = ids >= 0
+    np.testing.assert_array_equal(
+        got[valid],
+        ids[valid, None].astype(np.float32) * np.ones((1, 4),
+                                                      np.float32))
+
+  c1 = glt_metrics.default_registry().counters()
+  pages = c1.get('storage.demand_pages', 0) - c0.get(
+      'storage.demand_pages', 0)
+  paged = c1.get('storage.demand_paged_rows', 0) - c0.get(
+      'storage.demand_paged_rows', 0)
+  missed = c1.get('storage.prefetch_miss', 0) - c0.get(
+      'storage.prefetch_miss', 0)
+  assert pages == steps
+  assert paged > 0 and missed == paged
+  # one batch width -> one program-cache entry; its slab caps are the
+  # closed pow2 set the per-step path pages through
+  assert set(over._slab_fns) == {b}
+  caps = set(over._slab_fns[b])
+  assert caps and all(c & (c - 1) == 0 for c in caps)
+  # the demand-paged path does NOT reopen the full-upload footgun
+  with pytest.raises(RuntimeError, match='TieredDistScanTrainer'):
+    over.device_arrays()
 
 
 @pytest.mark.slow  # tier-1 budget: shuffle=False is the equivalence rep

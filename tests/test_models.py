@@ -571,6 +571,8 @@ def test_hierarchical_hgt_matches_full(dedup):
                              rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): zero-degree variant of
+# test_merge_dense_matches_segment, which stays tier-1
 def test_merge_dense_zero_degree_leading_seed():
   """Dense block writes must stay aligned when the FIRST run of a hop
   block has every edge masked (a zero-out-degree seed): its target
@@ -717,6 +719,8 @@ def test_tree_dense_hetero_matches_segment():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): tree-dense coverage rides on
+# test_tree_dense_gat_matches_segment; HGT rides on the merge-dense rep
 def test_hgt_tree_dense_matches_segment():
   """HGTConv's dense k-run typed attention (tree_records) == the
   segment-softmax path on hetero tree batches — SAME params (the dense

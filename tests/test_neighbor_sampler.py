@@ -412,7 +412,10 @@ def test_padded_window_auto_and_stats():
     ('random', None, 'map_table'),
     ('random', None, 'tree'),
     ('block', None, 'tree'),
-    ('random', 8, 'map'), ('random', 8, 'tree'),
+    ('random', 8, 'tree'),
+    # tier-1 wall budget (PR 16): padded x map duplicates coverage of
+    # random x map (engine) + random-8 x tree (padding) — slow keeps it
+    pytest.param('random', 8, 'map', marks=pytest.mark.slow),
     # tier-1 wall budget (PR 8): sort_legacy is the LEGACY dedup path
     # and block x map duplicates coverage carried by block x tree +
     # random x map — both keep running under -m slow

@@ -605,6 +605,8 @@ def test_dist_crash_resume_bit_identical(dist_env, tmp_path):
   assert glt.utils.trace.counters('dist_feature') == env['stats']
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): epoch-advance variant of the
+# dist crash-resume bit-identity test, which stays tier-1
 def test_dist_completed_epoch_advance(dist_env, tmp_path):
   """A crash AFTER the final boundary (the always-written
   completed-epoch snapshot) resumes as 'advance past the epoch': the
@@ -708,6 +710,8 @@ def test_dist_failover_exact_counts_and_span_tree(tmp_path,
   assert any(tree['spans'][k]['name'] == 'epoch.run' for k in kids)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): dead-at-start variant of
+# test_dist_failover_exact_counts_and_span_tree, which stays tier-1
 def test_dist_failover_heartbeat_dead_at_start():
   """The REAL Heartbeat drives the failover: a rank whose probes all
   fail is declared dead in ~interval x miss seconds; the runner fails

@@ -232,6 +232,8 @@ def test_remote_scan_dispatch_budget_strict():
     _teardown(pairs)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): contract sweep overlaps the
+# bit-identity-vs-per-batch test, which stays tier-1
 def test_remote_scan_vs_collocated_contract():
   """The three-trainer matrix at one scale (40 seeds, global batch 4):
   per-batch remote, chunk-staged remote and collocated DistScanTrainer

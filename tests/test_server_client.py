@@ -346,6 +346,8 @@ def test_two_servers_two_clients_matrix():
         proc.join(timeout=10)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): hetero variant of the mp dist
+# loader test above; the homo mp loader + e2e stay tier-1
 def test_mp_dist_hetero_loader():
   """HETERO sampling through the mp producer path (round 5; reference
   parity: examples/hetero/train_hgt_mag_mp.py rides the generic mp

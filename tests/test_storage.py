@@ -289,7 +289,8 @@ def test_tiered_scan_shuffle_parity(tmp_path):
   tr_b.close()
 
 
-@pytest.mark.parametrize('shuffle', [False, True])
+@pytest.mark.parametrize('shuffle', [
+    False, pytest.param(True, marks=pytest.mark.slow)])  # tier-1 budget
 def test_plan_matches_host_replay(tmp_path, shuffle):
   """Prologue plan correctness: the fused device plan (sampler replay
   inside the epoch_seeds program) == an independent eager host replay

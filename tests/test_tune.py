@@ -62,19 +62,28 @@ def test_tune_artifact_roundtrip_and_zero_retrace(tmp_path):
   ds = make_dataset()
   art = glt.tune(ds, loader_cfg(), out_path=str(tmp_path / 'art.json'))
 
-  # the knob set is complete and the file round-trips bit-for-bit
+  # the knob set is complete and the file round-trips bit-for-bit —
+  # including the v2 kernel-routing keys (docs/tuning.md 'Kernel
+  # candidates'), which the fingerprint covers like any other knob
   for key in ('mode', 'frontier_caps', 'chunk_k', 'split_ratio',
               'bucket_frac', 'slab_cap', 'serving_buckets',
-              'wire_dtype'):
+              'wire_dtype', 'use_pallas_v2', 'gather2_block_rows',
+              'gather2_run_span', 'use_fused_hop', 'fused_hop_window'):
     assert key in art.choices, key
   art2 = TuneArtifact.load(str(tmp_path / 'art.json'))
   assert art2.fingerprint == art.fingerprint
   assert art2.choices == art.choices
-  # every knob has probe evidence; the winner is recorded
+  # every knob has probe evidence; the winner is recorded and names
+  # the kernel routing it ran with (the full KERNEL_CHOICE_KEYS dict)
   knobs = {e.get('knob') for e in art.evidence if 'knob' in e}
   assert {'frontier_caps', 'chunk_k', 'slab_cap', 'split_ratio',
           'serving_buckets', 'wire_dtype'} <= knobs
-  assert any(e.get('kind') == 'winner' for e in art.evidence)
+  winners = [e for e in art.evidence if e.get('kind') == 'winner']
+  assert winners
+  from graphlearn_tpu.tune.artifact import KERNEL_CHOICE_KEYS
+  assert set(winners[0]['kernel']) == KERNEL_CHOICE_KEYS
+  assert art2.kernel_kwargs() == {
+      k: art.choices[k] for k in KERNEL_CHOICE_KEYS}
 
   # constructors accept the artifact directly: loader from its kwargs,
   # trainer via config= (fingerprint-validated, tuned K applied)
@@ -220,3 +229,45 @@ def test_artifact_validation_guards():
     glt.tune(ds, dict(input_nodes=seed_pool(), batch_size=8))
   with pytest.raises(ValueError, match='input_nodes'):
     glt.tune(ds, dict(fanouts=FANOUTS, batch_size=8))
+
+
+def test_artifact_v1_loads_with_kernels_off(tmp_path):
+  """Backward compat (ISSUE 16 satellite): a pre-kernel-routing
+  version-1 artifact loads with the kernel choices defaulted to OFF,
+  carries a schema_upgrade evidence entry, and still validates its own
+  version-1 fingerprint — a tampered v1 file stays refused."""
+  import json
+  from graphlearn_tpu.tune.artifact import (
+      ARTIFACT_VERSION, KERNEL_CHOICE_DEFAULTS, compute_fingerprint)
+  choices = dict(mode='merge', frontier_caps=[64, 128],
+                 padded_window=None, wire_dtype='bf16', chunk_k=4,
+                 split_ratio=0.1, bucket_frac=0.5, slab_cap=256,
+                 serving_buckets=[16, 64], batch_size=BS,
+                 fanouts=FANOUTS, exact=False)
+  obj = dict(version=1, dataset=None, choices=choices,
+             evidence=[dict(kind='winner', name='v1_winner')],
+             fingerprint=compute_fingerprint(1, None, choices))
+  path = str(tmp_path / 'v1.json')
+  with open(path, 'w') as f:
+    json.dump(obj, f)
+  art = TuneArtifact.load(path)
+  assert art.version == ARTIFACT_VERSION
+  for key, default in KERNEL_CHOICE_DEFAULTS.items():
+    assert art.choices[key] == default, key
+  assert art.kernel_kwargs() == KERNEL_CHOICE_DEFAULTS
+  # the v1 knobs survive the upgrade untouched
+  for key, val in choices.items():
+    assert art.choices[key] == val, key
+  ups = [e for e in art.evidence if e.get('kind') == 'schema_upgrade']
+  assert len(ups) == 1 and ups[0]['from_version'] == 1
+  # the kwarg accessors stay usable: kernels-off loaders carry no
+  # fused-hop kwargs (pre-kernel surface unchanged)
+  assert 'use_fused_hop' not in art.loader_kwargs()
+  # a v2-only key smuggled into a v1 file is refused (closed v1 set)
+  bad = dict(obj, choices=dict(choices, use_fused_hop=True))
+  with pytest.raises(ValueError, match='unknown choice keys'):
+    TuneArtifact.from_json(bad)
+  # a hand-edited v1 file fails ITS OWN version-1 fingerprint
+  tampered = dict(obj, choices=dict(choices, chunk_k=999))
+  with pytest.raises(ValueError, match='edited'):
+    TuneArtifact.from_json(tampered)
