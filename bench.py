@@ -563,6 +563,18 @@ BENCH_KEY_REGISTRY = {
     'remote_vs_collocated_ratio': 'remote / collocated scanned epoch '
                                   'wall (gate: ~1.3x)',
     'remote_scan_config': 'graph/block/server shape of the figures',
+    # multi-tenant service fabric (distributed/tenancy.py,
+    # docs/multi_tenancy.md): weighted-fair shares and interactive
+    # latency under a contended sampling cluster, plus the visible-
+    # backpressure throttle plumbing against a tight in-flight quota
+    'tenant_fairness_spread': 'max per-tenant |throughput share - '
+                              'weight share| / weight share under '
+                              'contention (acceptance: within 0.25)',
+    'tenant_p99_degradation_ms': 'interactive probe p99 under '
+                                 'contention minus its solo p99 (ms)',
+    'tenant_throttle_rate': 'throttle rejections per produce-ahead op '
+                            'against a one-frame in-flight quota',
+    'tenant_config': 'tenant/weight/load shape of the fairness figures',
     # serving tier (PR 7): offline materialization + online endpoint
     'embed_epoch_wall_s': 'full-graph layer-wise materialization wall s',
     'embed_epoch_dispatches': 'materialization dispatches, all layers',
@@ -594,7 +606,7 @@ BENCH_ERROR_SECTIONS = (
     'run_softmax_impl', 'hetero_step', 'hetero_ref', 'feature_exchange',
     'serving', 'oversub', 'dist_oversub', 'rotation', 'recovery',
     'remote_scan', 'gather2', 'fused_hop', 'fused_multihop',
-    'oversub_per_step', 'tune', 'run_scan',
+    'oversub_per_step', 'tune', 'run_scan', 'tenancy',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -641,6 +653,10 @@ BENCH_LOWER_IS_BETTER = frozenset({
     # the chunk-staged remote gate pair: the remote/collocated wall
     # ratio and the block staging latency ahead of the scan
     'remote_vs_collocated_ratio', 'remote_block_stage_ms_p99',
+    # the multi-tenant gate pair: weight-share fidelity of the fair
+    # scheduler and the interactive tenant's latency cost under a
+    # saturating training load (both drift silently otherwise)
+    'tenant_fairness_spread', 'tenant_p99_degradation_ms',
     'serving_p50_ms', 'serving_p99_ms',
     'hetero_rgnn_step_ms_bf16', 'hetero_rgnn_train_program_ms',
     'hetero_rgat_step_ms_bf16', 'hetero_rgat_train_program_ms',
@@ -2086,6 +2102,204 @@ def main():
   except Exception as e:
     result['remote_scan_epoch_wall_s'] = None
     result['remote_scan_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- multi-tenant fairness (distributed/tenancy.py) ----
+  # The service-fabric gate (docs/multi_tenancy.md): one in-process
+  # server with admission control + the weighted-fair block lane,
+  # tenants trainA (w=2) and trainB (w=1) saturating it while an
+  # interactive probe rides on top. Measures (a) DWRR fidelity — each
+  # training tenant's block-throughput share vs its weight share,
+  # (b) strict priority — the probe's p99 under contention vs solo,
+  # and (c) visible backpressure — throttle rejections per produce-
+  # ahead op against a one-frame in-flight quota with a lagging drain.
+  # Raw block RPCs only (no trainers, no device work): the server lane
+  # is the contended resource being characterized.
+  try:
+    import queue as _tn_queue
+    import threading as _tn_threading
+
+    from graphlearn_tpu.distributed import dist_client
+    from graphlearn_tpu.distributed.dist_loader import _norm_num_neighbors
+    from graphlearn_tpu.distributed.dist_server import DistServer
+    from graphlearn_tpu.distributed.rpc import RpcServer
+    from graphlearn_tpu.distributed.tenancy import (
+        TenancyConfig, TenantSpec, with_backpressure)
+    from graphlearn_tpu.sampler import SamplingConfig, SamplingType
+    from graphlearn_tpu.utils import trace as _tn_trace
+
+    tn_n, tn_deg, tn_f = 20_000, 10, 16
+    tn_batch, tn_k, tn_steps = 64, 2, 40
+    tn_fanouts = [5, 5]
+    tn_rng = np.random.default_rng(31)
+    tn_ds = glt.data.Dataset()
+    tn_ds.init_graph(
+        np.stack([tn_rng.integers(0, tn_n, tn_n * tn_deg),
+                  tn_rng.integers(0, tn_n, tn_n * tn_deg)]),
+        graph_mode='CPU', num_nodes=tn_n)
+    tn_ds.init_node_features(
+        tn_rng.standard_normal((tn_n, tn_f)).astype(np.float32))
+    tn_ds.init_node_labels(tn_rng.integers(0, 8, tn_n))
+
+    tn_weights = {'trainA': 2.0, 'trainB': 1.0}
+    tn_srv = DistServer(tn_ds, tenancy=TenancyConfig(specs=[
+        TenantSpec(tenant='trainA', priority='training', weight=2.0),
+        TenantSpec(tenant='trainB', priority='training', weight=1.0),
+        TenantSpec(tenant='ui', priority='interactive'),
+        TenantSpec(tenant='bulkq', priority='bulk',
+                   max_inflight_bytes=1)]))
+    tn_rpc = RpcServer(handlers={
+        'create_block_producer': tn_srv.create_block_producer,
+        'block_produce': tn_srv.block_produce,
+        'block_fetch': tn_srv.block_fetch,
+        'destroy_block_producer': tn_srv.destroy_block_producer,
+        'heartbeat': tn_srv.heartbeat,
+        'exit': tn_srv.exit})
+    dist_client.init_client(1, 1, 0, [(tn_rpc.host, tn_rpc.port)])
+    tn_pids = {}
+    try:
+      tn_cfg = SamplingConfig(
+          SamplingType.NODE, _norm_num_neighbors(tn_fanouts), tn_batch,
+          False, False, False, True, False, False, 'out', 0)
+      tn_seeds = tn_rng.integers(0, tn_n, tn_batch * tn_steps)
+      for tenant, prio in (('trainA', 'training'),
+                           ('trainB', 'training'),
+                           ('ui', 'interactive'), ('bulkq', 'bulk')):
+        tn_pids[tenant] = dist_client.request_server(
+            0, 'create_block_producer', tn_seeds, tn_cfg, None,
+            worker_key=f'bench/tn/{tenant}', tenant=tenant,
+            priority=prio)
+      tn_blocks = tn_steps // tn_k
+      tn_errors = []
+
+      def _tn_cycle(tenant, cursor):
+        # one counter-addressed produce+fetch; the epoch wraps so a
+        # worker can cycle the stream for as long as the phase runs
+        ep, blk = divmod(cursor, tn_blocks)
+        pid = tn_pids[tenant]
+        with_backpressure(
+            lambda: dist_client.request_server(
+                0, 'block_produce', pid, ep, blk * tn_k, tn_k),
+            describe=f'bench produce {tenant}', tenant=tenant)
+        dist_client.request_server(
+            0, 'block_fetch', pid, ep, blk * tn_k, tn_k)
+
+      def _tn_pound(tenant, counts, offset, stride, stop):
+        cursor = offset
+        try:
+          while not stop.is_set():
+            _tn_cycle(tenant, cursor)
+            counts[(tenant, offset)] += tn_k   # thread-private cell
+            cursor += stride
+        except Exception as e:
+          tn_errors.append(e)
+
+      def _tn_probe(lats, stop):
+        cursor = 0
+        try:
+          while not stop.is_set():
+            t0 = time.perf_counter()
+            _tn_cycle('ui', cursor)
+            lats.append((time.perf_counter() - t0) * 1e3)
+            cursor += 1
+            time.sleep(0.02)
+        except Exception as e:
+          tn_errors.append(e)
+
+      def _tn_run(specs, seconds):
+        stop = _tn_threading.Event()
+        ts = [_tn_threading.Thread(target=fn, args=args + (stop,),
+                                   daemon=True) for fn, args in specs]
+        for t in ts:
+          t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+          t.join(timeout=60)
+        if tn_errors:
+          raise tn_errors[0]
+
+      # solo: the interactive probe with the lane to itself
+      tn_solo = []
+      _tn_run([(_tn_probe, (tn_solo,))], 1.0)
+      # contended: four saturating threads per training tenant (equal
+      # offered load, deep enough that each tenant keeps a persistent
+      # backlog — DRR shapes queued work, not arrivals) with the probe
+      # riding on top
+      tn_threads = 4
+      tn_counts = {(t, i): 0 for t in tn_weights
+                   for i in range(tn_threads)}
+      tn_cont = []
+      _tn_run([(_tn_pound, (t, tn_counts, i, tn_threads))
+               for t in tn_weights for i in range(tn_threads)]
+              + [(_tn_probe, (tn_cont,))], 4.0)
+      if not tn_solo or not tn_cont:
+        raise RuntimeError('interactive probe completed no cycles')
+      tn_served = {t: sum(v for (tt, _), v in tn_counts.items()
+                          if tt == t) for t in tn_weights}
+      tn_total = sum(tn_served.values())
+      tn_wsum = sum(tn_weights.values())
+      tn_spread = max(
+          abs(tn_served[t] / tn_total - tn_weights[t] / tn_wsum)
+          / (tn_weights[t] / tn_wsum) for t in tn_weights)
+      tn_solo99 = float(np.percentile(tn_solo, 99))
+      tn_cont99 = float(np.percentile(tn_cont, 99))
+
+      # visible backpressure: produce-ahead into bulkq's one-frame
+      # quota; the drain thread fetches each staged block 30ms late,
+      # so every produce after the first meets the quota, throttles,
+      # and retries inside with_backpressure (never a timeout)
+      tn_base = _tn_trace.counter_get('tenant.throttled')
+      tn_attempts = min(16, tn_blocks)
+      tn_q = _tn_queue.Queue()
+
+      def _tn_drain():
+        try:
+          while True:
+            i = tn_q.get(timeout=60)
+            if i is None:
+              return
+            time.sleep(0.03)
+            dist_client.request_server(
+                0, 'block_fetch', tn_pids['bulkq'], 0, i * tn_k, tn_k)
+        except Exception as e:
+          tn_errors.append(e)
+
+      tn_dr = _tn_threading.Thread(target=_tn_drain, daemon=True)
+      tn_dr.start()
+      for i in range(tn_attempts):
+        with_backpressure(
+            lambda i=i: dist_client.request_server(
+                0, 'block_produce', tn_pids['bulkq'], 0, i * tn_k,
+                tn_k),
+            describe='bench produce bulkq', tenant='bulkq')
+        tn_q.put(i)
+      tn_q.put(None)
+      tn_dr.join(timeout=60)
+      if tn_errors:
+        raise tn_errors[0]
+      tn_throttled = _tn_trace.counter_get('tenant.throttled') - tn_base
+    finally:
+      for pid in tn_pids.values():
+        try:
+          dist_client.request_server(0, 'destroy_block_producer', pid)
+        except Exception:
+          pass
+      dist_client._client.close()
+      dist_client._client = None
+      tn_srv.exit()
+      tn_rpc.shutdown()
+    result['tenant_fairness_spread'] = round(tn_spread, 3)
+    result['tenant_p99_degradation_ms'] = round(
+        max(0.0, tn_cont99 - tn_solo99), 3)
+    result['tenant_throttle_rate'] = round(tn_throttled / tn_attempts, 3)
+    result['tenant_config'] = (
+        f'N={tn_n}, deg={tn_deg}, F={tn_f}, fanouts {tn_fanouts}, '
+        f'batch {tn_batch}, K={tn_k}; trainA w=2 + trainB w=1 '
+        f'({tn_threads} threads each) + interactive probe, 4s '
+        f'contention; 1-frame quota x {tn_attempts} produce-ahead ops')
+  except Exception as e:
+    result['tenant_fairness_spread'] = None
+    result['tenancy_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- serving tier (PR 7): offline materialization + online QPS ----
   # The serving sections run LAST by design: the serving path fetches

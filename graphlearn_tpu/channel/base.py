@@ -76,7 +76,31 @@ def deserialize_message(buf) -> SampleMessage:
 
 
 class QueueTimeoutError(RuntimeError):
-  """Reference: include/shm_queue.h QueueTimeoutError."""
+  """Reference: include/shm_queue.h QueueTimeoutError.
+
+  When the stalled stream belongs to a known tenant, :meth:`with_context`
+  stamps the tenant id and its last-seen quota snapshot onto the error
+  so a starved tenant's timeout names WHO hit WHAT limit instead of
+  reading as an anonymous stall (docs/multi_tenancy.md).
+  """
+
+  tenant: str = None
+  quota: dict = None
+
+  def with_context(self, tenant=None, quota=None) -> 'QueueTimeoutError':
+    """Attach tenant/quota context and fold it into the message."""
+    self.tenant = tenant
+    self.quota = dict(quota) if quota else None
+    parts = []
+    if tenant is not None:
+      parts.append(f'tenant={tenant!r}')
+    if self.quota:
+      parts.append(f'quota={self.quota}')
+    if parts and self.args:
+      self.args = (f'{self.args[0]} [{", ".join(parts)}]',) + self.args[1:]
+    elif parts:
+      self.args = (f'[{", ".join(parts)}]',)
+    return self
 
 
 class ChannelBase:

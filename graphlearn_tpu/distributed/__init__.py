@@ -29,3 +29,7 @@ from .resilience import (DEFAULT_RETRY_POLICY, NO_RETRY, DeadlineExceeded,
                          Heartbeat, RetryPolicy, ServerDeadError)
 from .rpc import (Barrier, RpcCalleeBase, RpcClient,
                   RpcDataPartitionRouter, RpcServer, get_free_port)
+from .tenancy import (PRIORITY_CLASSES, AdmissionController, TenancyConfig,
+                      TenantQuotaExceeded, TenantRejection, TenantSpec,
+                      TenantStarvedError, TenantThrottled,
+                      WeightedFairScheduler, with_backpressure)

@@ -50,6 +50,12 @@ from .message import output_to_message
 _BF16_NAMES = ('bf16', 'bfloat16')
 
 
+def _frame_nbytes(frame: Dict[str, np.ndarray]) -> int:
+  """Byte size of one staged block frame (the unit the per-tenant
+  in-flight quota is accounted in — docs/multi_tenancy.md)."""
+  return sum(int(np.asarray(v).nbytes) for v in frame.values())
+
+
 def _pad_pow2_axis0(arrs: List[np.ndarray]) -> List[np.ndarray]:
   """Pad ragged leading axes to one pow2 cap — the staging-slab
   convention (storage/staging.py): integer id slots pad with INT32_MAX
@@ -164,6 +170,11 @@ class BlockSampleProducer:
         seed=worker_seed)
     self._order_cache: Optional[tuple] = None   # (epoch, order)
     self._frames: Dict[Tuple[int, int, int], dict] = {}
+    # tenancy accounting seams (dist_server.create_block_producer):
+    # on_stage(nbytes) as a frame lands in the cache, on_fetch(nbytes)
+    # as a cached frame is popped — the in-flight byte quota's sensors
+    self.on_stage: Optional[callable] = None
+    self.on_fetch: Optional[callable] = None
     # two locks so the produce-ahead overlap is real: _cache_lock
     # guards the frame dict only (a fetch that HITS the cache returns
     # while a produce builds the next frame), _build_lock serializes
@@ -272,6 +283,8 @@ class BlockSampleProducer:
       frame = self.build_frame(epoch, start, k)
       with self._cache_lock:
         self._frames[key] = frame
+    if self.on_stage is not None:
+      self.on_stage(_frame_nbytes(frame))
     return True
 
   def fetch(self, epoch: int, start: int, k: int) -> dict:
@@ -282,14 +295,27 @@ class BlockSampleProducer:
     key = (int(epoch), int(start), int(k))
     with self._cache_lock:
       frame = self._frames.pop(key, None)
-    if frame is None:
-      with self._build_lock:
-        with self._cache_lock:    # the produce we waited on may have it
-          frame = self._frames.pop(key, None)
-        if frame is None:
-          frame = self.build_frame(epoch, start, k)
-    return frame
+    if frame is not None:
+      if self.on_fetch is not None:
+        self.on_fetch(_frame_nbytes(frame))
+      return frame
+    with self._build_lock:
+      with self._cache_lock:    # the produce we waited on may have it
+        frame = self._frames.pop(key, None)
+      if frame is not None:
+        if self.on_fetch is not None:
+          self.on_fetch(_frame_nbytes(frame))
+        return frame
+      # on-demand build: never cached, so it was never charged against
+      # the in-flight quota — no release either
+      return self.build_frame(epoch, start, k)
 
   def cached_blocks(self) -> int:
     with self._cache_lock:
       return len(self._frames)
+
+  def cached_bytes(self) -> int:
+    """Total bytes of staged-but-unfetched frames — what destroy/reap
+    must release from the tenant's in-flight quota."""
+    with self._cache_lock:
+      return sum(_frame_nbytes(f) for f in self._frames.values())

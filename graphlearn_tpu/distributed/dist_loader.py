@@ -20,6 +20,7 @@ from ..loader.node_loader import OverflowGuardMixin
 from ..sampler import NodeSamplerInput
 from .dist_dataset import DistDataset
 from .dist_neighbor_sampler import DistNeighborSampler
+from .tenancy import with_backpressure
 
 
 def _split_input_type(input_nodes):
@@ -404,6 +405,20 @@ class _RemoteLoaderBase:
   #: the link loader degrades to a hard error on server death.
   supports_failover = True
 
+  def _tenant_kwargs(self) -> dict:
+    """create_sampling_producer kwargs registering this loader's
+    producers under its tenant — empty (wire-compatible with
+    pre-tenancy servers) when no tenant is configured."""
+    if getattr(self, '_tenant', None) is None:
+      return {}
+    return dict(tenant=self._tenant, priority=self._tenant_priority,
+                weight=self._tenant_weight)
+
+  def _note_throttle(self, rej):
+    # remembered so an eventual idle-budget QueueTimeoutError names the
+    # quota this tenant was last bouncing off (docs/multi_tenancy.md)
+    self._last_throttle = rej
+
   def _setup_remote(self, config, per_server_inputs, worker_options):
     import dataclasses
 
@@ -415,6 +430,14 @@ class _RemoteLoaderBase:
     opts = worker_options
     self._opts = opts
     self._config = config
+    self._tenant = getattr(opts, 'tenant', None) if opts else None
+    self._tenant_priority = getattr(opts, 'tenant_priority', None) \
+        if opts else None
+    self._tenant_weight = getattr(opts, 'tenant_weight', None) \
+        if opts else None
+    self._bp_budget = getattr(opts, 'backpressure_budget', 120.0) \
+        if opts else 120.0
+    self._last_throttle = None   # last TenantRejection, for timeout context
     self.producer_ids = []
     self._expected = 0
     for i, (rank, part) in enumerate(zip(self.server_ranks,
@@ -425,10 +448,16 @@ class _RemoteLoaderBase:
       # (negatives depend only on the graph + key)
       cfg_i = dataclasses.replace(
           config, seed=(config.seed or 0) * 7919 + i)
-      pid = dist_client.request_server(
-          rank, 'create_sampling_producer', part, cfg_i,
-          opts.num_workers if opts else 1,
-          worker_key=(opts.worker_key if opts else None))
+      pid = with_backpressure(
+          lambda rank=rank, part=part, cfg_i=cfg_i:
+          dist_client.request_server(
+              rank, 'create_sampling_producer', part, cfg_i,
+              opts.num_workers if opts else 1,
+              worker_key=(opts.worker_key if opts else None),
+              **self._tenant_kwargs()),
+          describe=f'create_sampling_producer rank {rank}',
+          budget_s=self._bp_budget, tenant=self._tenant,
+          on_reject=self._note_throttle)
       self.producer_ids.append(pid)
       # the producer's own count: its mp workers split the seed share and
       # each rounds up, so ceil(n/batch_size) would undercount here
@@ -603,10 +632,15 @@ class _RemoteLoaderBase:
       # failover meant to save the epoch. start_new_epoch_sampling has
       # no such dedup (a retried start double-produces), so it stays
       # single-attempt.
-      pid2 = self._dist_client.request_server(
-          r2, 'create_sampling_producer', part2, cfg2,
-          self._opts.num_workers if self._opts else 1, worker_key=key,
-          idempotent=True)
+      pid2 = with_backpressure(
+          lambda r2=r2, part2=part2, cfg2=cfg2, key=key:
+          self._dist_client.request_server(
+              r2, 'create_sampling_producer', part2, cfg2,
+              self._opts.num_workers if self._opts else 1, worker_key=key,
+              idempotent=True, **self._tenant_kwargs()),
+          describe=f'failover producer rank {r2}',
+          budget_s=self._bp_budget, tenant=self._tenant,
+          on_reject=self._note_throttle)
       repl_expected = self._dist_client.request_server(
           r2, 'producer_num_expected', pid2, idempotent=True)
       self._dist_client.request_server(r2, 'start_new_epoch_sampling',
@@ -730,7 +764,7 @@ class _RemoteLoaderBase:
                                                 e.cause):
           yield self._message_to_data(m)
         continue
-      except QueueTimeoutError:
+      except QueueTimeoutError as qte:
         # quiet window: consult liveness before waiting further — a
         # partitioned/hung server never RSTs, the heartbeat is the only
         # signal (detection in seconds vs the 180 s socket timeout)
@@ -746,6 +780,11 @@ class _RemoteLoaderBase:
           idle_since = _time.monotonic()
           continue
         if _time.monotonic() - idle_since > self._idle_budget:
+          # a starved tenant's stall must name WHO hit WHAT limit, not
+          # read as an anonymous timeout (docs/multi_tenancy.md)
+          last = getattr(self, '_last_throttle', None)
+          qte.with_context(tenant=getattr(self, '_tenant', None),
+                           quota=getattr(last, 'quota', None))
           raise
         continue
       idle_since = _time.monotonic()

@@ -64,6 +64,15 @@ class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
   block. With ``failover`` on, a dead server's unfetched BLOCKS are
   re-replayed by survivors from the same counter stream
   (shuffle=False only).
+
+  Tenancy tunables (docs/multi_tenancy.md): when the servers run with a
+  ``TenancyConfig``, ``tenant`` names the quota/fair-share bucket this
+  client's producers are admitted under; ``tenant_priority`` is one of
+  ``interactive``/``training``/``bulk`` (strict priority between
+  classes); ``tenant_weight`` is the deficit-round-robin share within
+  the class. ``backpressure_budget`` bounds the total seconds a loader
+  will spend in throttle-retry backoff (``tenant.backpressure_ms``)
+  before failing loudly with the tenant's quota snapshot.
   """
   server_rank: Optional[Union[int, List[int]]] = None
   buffer_size: Optional[Union[int, str]] = None
@@ -76,6 +85,10 @@ class RemoteDistSamplingWorkerOptions(_BasicDistSamplingWorkerOptions):
   block_wire_dtype: Optional[str] = None
   block_ahead: int = 2
   block_timeout: float = 30.0
+  tenant: Optional[str] = None
+  tenant_priority: Optional[str] = None
+  tenant_weight: Optional[float] = None
+  backpressure_budget: float = 120.0
 
 
 AllDistSamplingWorkerOptions = Union[

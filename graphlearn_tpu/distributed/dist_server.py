@@ -8,6 +8,7 @@ demand over the TCP RPC (replacing torch-RPC). `fetch_one_sampled_message`
 keeps the reference's poll contract: (message|None, end_of_epoch_flag) with
 a bounded wait (dist_server.py:149-166).
 """
+import logging
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -31,9 +32,19 @@ class DistServer:
   disconnect mid-stream without calling destroy_sampling_producer — a
   leaked producer would otherwise hold its shm ring (and worker
   subprocesses) until server exit. None disables reaping.
+
+  ``tenancy`` (tenancy.TenancyConfig, docs/multi_tenancy.md) turns on
+  the multi-tenant service plane: per-tenant admission quotas at
+  producer creation and the block handlers (typed retryable
+  rejections), the weighted-fair block scheduling lane, per-tenant
+  ``producer_ttl`` overrides (one vanished client reaps only its own
+  streams), and per-tenant quota state in get_metrics. None (the
+  default) keeps the single-tenant behavior bit-for-bit.
   """
 
-  def __init__(self, dataset, producer_ttl: Optional[float] = None):
+  def __init__(self, dataset, producer_ttl: Optional[float] = None,
+               tenancy=None):
+    from .tenancy import AdmissionController, WeightedFairScheduler
     self.dataset = dataset
     self._producers: Dict[int, DistMpSamplingProducer] = {}
     # chunk-staged block streams (distributed/block_producer.py,
@@ -54,33 +65,75 @@ class DistServer:
     self._lock = threading.RLock()
     self._exit = threading.Event()
     self.producer_ttl = producer_ttl
+    self._admission = AdmissionController(tenancy) \
+        if tenancy is not None else None
+    self._scheduler = WeightedFairScheduler(
+        self._admission, quantum=tenancy.quantum,
+        timeout=tenancy.sched_timeout) if tenancy is not None else None
     self._reaper: Optional[threading.Thread] = None
-    if producer_ttl is not None:
+    if self._min_ttl() is not None:
       self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
       self._reaper.start()
+
+  def _min_ttl(self) -> Optional[float]:
+    """Smallest armed reap threshold (server-wide or any tenant's) —
+    the reaper runs when any ttl is armed and polls at the tightest
+    one's cadence."""
+    if self._admission is not None:
+      return self._admission.min_ttl(self.producer_ttl)
+    return self.producer_ttl
+
+  def _pid_ttl(self, pid: int) -> Optional[float]:
+    if self._admission is not None:
+      return self._admission.ttl_for_pid(pid, self.producer_ttl)
+    return self.producer_ttl
+
+  def _pid_context(self, pid: int) -> str:
+    """Tenant + quota context for stale-handle errors ('' without
+    tenancy) — the operator-actionable half of a reaped-pid failure."""
+    if self._admission is None:
+      return ''
+    return self._admission.describe_pid(pid)
 
   def _touch(self, producer_id: int):
     self._last_active[producer_id] = time.monotonic()
 
   def _reap_loop(self):
-    interval = min(max(self.producer_ttl / 4.0, 0.05), 30.0)
+    interval = min(max((self._min_ttl() or 1.0) / 4.0, 0.05), 30.0)
     while not self._exit.wait(interval):
-      self.reap_idle_producers()
+      try:
+        self.reap_idle_producers()
+      except Exception:   # noqa: BLE001 - an armed tenant.reap chaos
+        pass              # raise must not kill the reaper thread
 
   def reap_idle_producers(self) -> int:
-    """Destroy producers idle for longer than producer_ttl; returns the
-    number reaped (also callable directly, e.g. from tests)."""
-    if self.producer_ttl is None:
-      return 0
+    """Destroy producers idle for longer than their reap threshold
+    (the tenant's ``producer_ttl`` when tenancy is on, else the
+    server-wide one — one vanished client reaps only its own streams);
+    returns the number reaped (also callable directly from tests).
+    Each reap counts under ``tenant.reaped.<tenant>``."""
     now = time.monotonic()
     with self._lock:
-      stale = [pid for pid, ts in self._last_active.items()
-               if now - ts > self.producer_ttl]
+      stale = []
+      for pid, ts in self._last_active.items():
+        ttl = self._pid_ttl(pid)
+        if ttl is not None and now - ts > ttl:
+          stale.append(pid)
       stale_blocks = {pid for pid in stale
                       if pid in self._block_producers}
+    from .. import metrics
+    from ..utils import trace
     for pid in stale:
-      from ..utils import trace
+      fault_point('tenant.reap')
+      tenant = (self._admission.tenant_of(pid)
+                if self._admission is not None else 'default')
       trace.counter_inc('resilience.producer_reaped')
+      metrics.inc(f'tenant.reaped.{tenant}')
+      logging.getLogger('graphlearn_tpu.server').info(
+          'reaping idle producer %d of tenant %r (ttl=%s)', pid,
+          tenant, self._pid_ttl(pid))
+      if self._admission is not None:
+        self._admission.release_producer(pid, reaped=True)
       if pid in stale_blocks:
         self.destroy_block_producer(pid)
       else:
@@ -92,7 +145,10 @@ class DistServer:
   def create_sampling_producer(self, seeds, sampling_config: SamplingConfig,
                                num_workers: int = 1,
                                buffer_size: int = 1 << 26,
-                               worker_key: Optional[str] = None) -> int:
+                               worker_key: Optional[str] = None,
+                               tenant: Optional[str] = None,
+                               priority: Optional[str] = None,
+                               weight: Optional[float] = None) -> int:
     fault_point('server.create_producer')
     with self._lock:
       if worker_key is not None and worker_key in self._worker_key_to_id:
@@ -101,32 +157,44 @@ class DistServer:
         return pid
       pid = self._next_id
       self._next_id += 1
-      buf = ShmChannel(shm_size=buffer_size)
-      import dataclasses
+      if self._admission is not None:
+        # admission BEFORE any resource allocation: an over-quota
+        # tenant's rejection (typed, retryable) must not leak a ring
+        self._admission.admit_producer(
+            tenant or 'default', pid, ring_bytes=int(buffer_size),
+            priority=priority, weight=weight)
+      try:
+        buf = ShmChannel(shm_size=buffer_size)
+        import dataclasses
 
-      from ..sampler import EdgeSamplerInput, SamplingType
-      # the server's dataset is the authority on edge orientation —
-      # remote clients can't know it and default to 'out'
-      sampling_config = dataclasses.replace(
-          sampling_config, edge_dir=self.dataset.edge_dir)
-      if sampling_config.sampling_type == SamplingType.LINK:
-        # seeds arrive as [2, E] (or an EdgeSamplerInput); negatives are
-        # requested through config.with_neg (binary, amount 1 — pass an
-        # EdgeSamplerInput for other modes)
-        if not isinstance(seeds, EdgeSamplerInput):
-          from ..sampler import NegativeSampling
-          ei = np.asarray(seeds)
-          seeds = EdgeSamplerInput(
-              ei[0], ei[1],
-              neg_sampling=(NegativeSampling('binary', 1)
-                            if sampling_config.with_neg else None))
-        sampler_input = seeds
-      else:
-        sampler_input = NodeSamplerInput.cast(seeds)
-      producer = DistMpSamplingProducer(
-          self.dataset, sampler_input, sampling_config, buf,
-          num_workers=num_workers)
-      producer.init()
+        from ..sampler import EdgeSamplerInput, SamplingType
+        # the server's dataset is the authority on edge orientation —
+        # remote clients can't know it and default to 'out'
+        sampling_config = dataclasses.replace(
+            sampling_config, edge_dir=self.dataset.edge_dir)
+        if sampling_config.sampling_type == SamplingType.LINK:
+          # seeds arrive as [2, E] (or an EdgeSamplerInput); negatives
+          # are requested through config.with_neg (binary, amount 1 —
+          # pass an EdgeSamplerInput for other modes)
+          if not isinstance(seeds, EdgeSamplerInput):
+            from ..sampler import NegativeSampling
+            ei = np.asarray(seeds)
+            seeds = EdgeSamplerInput(
+                ei[0], ei[1],
+                neg_sampling=(NegativeSampling('binary', 1)
+                              if sampling_config.with_neg else None))
+          sampler_input = seeds
+        else:
+          sampler_input = NodeSamplerInput.cast(seeds)
+        producer = DistMpSamplingProducer(
+            self.dataset, sampler_input, sampling_config, buf,
+            num_workers=num_workers)
+        producer.init()
+      except BaseException:
+        # a failed create must not hold the tenant's admission slot
+        if self._admission is not None:
+          self._admission.release_producer(pid)
+        raise
       self._producers[pid] = producer
       self._buffers[pid] = buf
       self._fetch_locks[pid] = threading.Lock()
@@ -140,14 +208,16 @@ class DistServer:
   def _live_producer(self, producer_id: int):
     """Producer + buffer for an id, or a diagnosable error: after an
     idle-reap or double-destroy the bare KeyError would reach the
-    client as an inscrutable remote failure."""
+    client as an inscrutable remote failure. With tenancy on, the
+    error carries the pid's tenant + quota snapshot."""
     producer = self._producers.get(producer_id)
     buf = self._buffers.get(producer_id)
     if producer is None or buf is None:
       raise RuntimeError(
           f'producer {producer_id} unknown on this server — it was '
           'destroyed or idle-reaped (producer_ttl); recreate the remote '
-          'loader to register a fresh producer')
+          f'loader to register a fresh producer'
+          f'{self._pid_context(producer_id)}')
     return producer, buf
 
   def producer_num_expected(self, producer_id: int) -> int:
@@ -229,6 +299,8 @@ class DistServer:
     idle reaper may have won the race). Always releases the producer's
     ShmChannel — the shm ring must not outlive the producer, or
     create/destroy churn across epochs leaks shared memory."""
+    if self._admission is not None:
+      self._admission.release_producer(producer_id)
     with self._lock:
       producer = self._producers.pop(producer_id, None)
       buf = self._buffers.pop(producer_id, None)
@@ -259,10 +331,17 @@ class DistServer:
 
   def create_block_producer(self, seeds, sampling_config,
                             wire_dtype: Optional[str] = None,
-                            worker_key: Optional[str] = None) -> int:
+                            worker_key: Optional[str] = None,
+                            tenant: Optional[str] = None,
+                            priority: Optional[str] = None,
+                            weight: Optional[float] = None) -> int:
     """Register a block stream over a seed share. ``worker_key`` dedups
     re-creates (client retries, failover replay producers on
-    survivors) exactly like the sampling producers' key."""
+    survivors) exactly like the sampling producers' key. ``tenant`` /
+    ``priority`` / ``weight`` register the stream with the admission
+    controller (docs/multi_tenancy.md); its staged frame bytes then
+    count against the tenant's in-flight quota and its builds drain
+    through the weighted-fair lane."""
     import dataclasses
 
     from .block_producer import BlockSampleProducer
@@ -273,12 +352,28 @@ class DistServer:
         return pid
       pid = self._next_id
       self._next_id += 1
+      if self._admission is not None:
+        self._admission.admit_producer(
+            tenant or 'default', pid, ring_bytes=0,
+            priority=priority, weight=weight)
       # the server's dataset is the authority on edge orientation —
       # same replace as create_sampling_producer
       cfg = dataclasses.replace(sampling_config,
                                 edge_dir=self.dataset.edge_dir)
-      self._block_producers[pid] = BlockSampleProducer(
-          self.dataset, seeds, cfg, wire_dtype=wire_dtype)
+      try:
+        producer = BlockSampleProducer(
+            self.dataset, seeds, cfg, wire_dtype=wire_dtype)
+      except BaseException:
+        if self._admission is not None:
+          self._admission.release_producer(pid)
+        raise
+      if self._admission is not None:
+        # in-flight byte accounting: frames charged as they stage into
+        # the producer cache, released as the client fetches them
+        adm, t = self._admission, (tenant or 'default')
+        producer.on_stage = lambda n: adm.charge_inflight(t, n)
+        producer.on_fetch = lambda n: adm.release_inflight(t, n)
+      self._block_producers[pid] = producer
       self._touch(pid)
       if worker_key is not None:
         self._block_key_to_id[worker_key] = pid
@@ -290,8 +385,19 @@ class DistServer:
       raise RuntimeError(
           f'block producer {producer_id} unknown on this server — it '
           'was destroyed or idle-reaped (producer_ttl); recreate the '
-          'remote scan trainer to register a fresh stream')
+          f'remote scan trainer to register a fresh stream'
+          f'{self._pid_context(producer_id)}')
     return producer
+
+  def _block_lane(self, producer_id: int, k: int, fn):
+    """Run a block build/fetch through the weighted-fair lane (strict
+    priority + DWRR — docs/multi_tenancy.md); a direct call without
+    tenancy. Cost is the batch count: a tail block is cheaper than a
+    full one."""
+    if self._scheduler is None:
+      return fn()
+    tenant = self._admission.tenant_of(producer_id)
+    return self._scheduler.run(tenant, float(k), fn)
 
   def block_producer_num_batches(self, producer_id: int) -> int:
     """Exact batches per epoch of this block stream (single stream —
@@ -305,30 +411,60 @@ class DistServer:
                     k: int) -> bool:
     """Stage block (epoch, [start, start+k)) into the frame cache —
     the produce half of the client's produce-c+1-while-fetching-c
-    pipelining."""
+    pipelining. With tenancy on, a tenant at its in-flight byte quota
+    gets a retryable TenantThrottled (produce-ahead is optional work —
+    fetching the staged frames drains the quota), and the build drains
+    through the weighted-fair lane."""
     with self._lock:
       producer = self._live_block_producer(producer_id)
       self._touch(producer_id)
-    return producer.produce(epoch, start, k)
+    if self._admission is not None:
+      self._admission.check_inflight(self._admission.tenant_of(producer_id))
+    return self._block_lane(
+        producer_id, k, lambda: producer.produce(epoch, start, k))
 
   def block_fetch(self, producer_id: int, epoch: int, start: int,
                   k: int) -> dict:
     """The block frame (cache pop, or built on demand) — pure, so a
-    retried fetch after a lost response rebuilds identical bytes."""
+    retried fetch after a lost response rebuilds identical bytes.
+    Routed through the weighted-fair lane: under contention an
+    interactive tenant's fetch jumps a bulk tenant's queued builds.
+    Never blocked by the in-flight quota — fetching DRAINS it."""
     with self._lock:
       producer = self._live_block_producer(producer_id)
       self._touch(producer_id)
-    return producer.fetch(epoch, start, k)
+    return self._block_lane(
+        producer_id, k, lambda: producer.fetch(epoch, start, k))
 
   def destroy_block_producer(self, producer_id: int) -> bool:
-    """Idempotent, like destroy_sampling_producer."""
+    """Idempotent, like destroy_sampling_producer. Releases the
+    tenant's admission slot and any still-staged frame bytes (zero
+    leaked quota after a reap — the chaos tests pin this)."""
     with self._lock:
-      self._block_producers.pop(producer_id, None)
+      producer = self._block_producers.pop(producer_id, None)
       self._last_active.pop(producer_id, None)
       for key, pid in list(self._block_key_to_id.items()):
         if pid == producer_id:
           del self._block_key_to_id[key]
+    if self._admission is not None:
+      tenant = self._admission.tenant_of(producer_id)
+      leftover = getattr(producer, 'cached_bytes', lambda: 0)() \
+          if producer is not None else 0
+      if leftover:
+        self._admission.release_inflight(tenant, leftover)
+      self._admission.release_producer(producer_id)
     return True
+
+  def update_tenant(self, tenant: str, priority: Optional[str] = None,
+                    weight: Optional[float] = None) -> dict:
+    """Re-register a tenant's priority/weight mid-flight (the elastic
+    autoscale driver — docs/multi_tenancy.md) and return its quota
+    snapshot. Idempotent by construction."""
+    if self._admission is None:
+      raise RuntimeError('tenancy is not enabled on this server '
+                         '(DistServer(tenancy=TenancyConfig(...)))')
+    self._admission.register(tenant, priority=priority, weight=weight)
+    return self._admission.snapshot(tenant)
 
   def heartbeat(self) -> dict:
     """Cheap liveness probe (resilience.Heartbeat polls this): answers
@@ -356,6 +492,12 @@ class DistServer:
     srv['run_id'] = spans.run_id()
     srv['spans'] = spans.export(limit=spans.SCRAPE_EXPORT_LIMIT)
     out = {'server': srv, 'producers': {}}
+    if self._admission is not None:
+      # per-tenant quota/usage state rides the scrape (and through it
+      # the flight record): visible backpressure, not a silent stall
+      out['tenants'] = self._admission.snapshot_all()
+      if self._scheduler is not None:
+        out['tenant_served'] = dict(self._scheduler.served)
     with self._lock:
       producers = dict(self._producers)
     for pid, producer in producers.items():
@@ -404,6 +546,8 @@ class DistServer:
       self.destroy_sampling_producer(pid)
     for pid in list(self._block_producers):
       self.destroy_block_producer(pid)
+    if self._scheduler is not None:
+      self._scheduler.close()
     self._exit.set()
     return True
 
@@ -422,7 +566,8 @@ def get_server() -> Optional[DistServer]:
 def init_server(num_servers: int, num_clients: int, server_rank: int,
                 dataset, master_addr: str = '127.0.0.1',
                 server_client_master_port: int = 0,
-                producer_ttl: Optional[float] = None) -> Tuple[str, int]:
+                producer_ttl: Optional[float] = None,
+                tenancy=None) -> Tuple[str, int]:
   """Start this server's RPC endpoint (reference: dist_server.py:180-212).
   Returns (host, port) — hand these to clients (the reference's tensorpipe
   rendezvous becomes explicit address exchange). ``producer_ttl`` bounds
@@ -431,10 +576,14 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
   pauses between epochs (eval, checkpointing) longer than the ttl would
   otherwise lose its producer; arm it when clients are expected to
   vanish without calling destroy, and keep it far above the longest
-  legitimate between-epoch pause."""
+  legitimate between-epoch pause. ``tenancy``
+  (tenancy.TenancyConfig) arms the multi-tenant service plane —
+  admission quotas, weighted-fair block scheduling, per-tenant ttls
+  (docs/multi_tenancy.md)."""
   global _server, _rpc_server
   _set_server_context(num_servers, num_clients, server_rank)
-  _server = DistServer(dataset, producer_ttl=producer_ttl)
+  _server = DistServer(dataset, producer_ttl=producer_ttl,
+                       tenancy=tenancy)
   s = _server
   barrier = Barrier(num_clients)
   # handlers registered at construction: the server accepts connections
@@ -453,6 +602,7 @@ def init_server(num_servers: int, num_clients: int, server_rank: int,
           'block_produce': s.block_produce,
           'block_fetch': s.block_fetch,
           'destroy_block_producer': s.destroy_block_producer,
+          'update_tenant': s.update_tenant,
           'get_dataset_meta': s.get_dataset_meta,
           'heartbeat': s.heartbeat,
           'get_metrics': s.get_metrics,

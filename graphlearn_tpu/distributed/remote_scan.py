@@ -83,6 +83,7 @@ from ..utils.strict import strict_guards
 from ..utils.trace import record_dispatch
 from .dist_loader import _norm_num_neighbors, _split_input_type
 from .resilience import NO_RETRY, DeadlineExceeded, ServerDeadError
+from .tenancy import with_backpressure
 
 #: exception classes a block fetch may die with when its server is gone
 #: (TCP reset, probe timeout, exhausted idempotent-retry deadline) —
@@ -135,6 +136,7 @@ class RemoteBlockStager:
     self._stop = False
     self._next_submit = 0
     self.degraded = False   # a worker fetch failed this epoch
+    self._ctx = None        # epoch-root span context adopted by _loop
 
   # ------------------------------------------------------------ lifecycle
 
@@ -150,6 +152,10 @@ class RemoteBlockStager:
       self._slabs = {}
       self._next_submit = int(start_chunk)
       self.degraded = False
+      # capture the caller's (epoch-root) span context so worker-thread
+      # fetch spans — remote.block_fetch, tenant.throttle — parent under
+      # the epoch tree instead of floating as orphans
+      self._ctx = spans.wire_context()
     self._ensure_worker()
     for _ in range(min(self.max_ahead, num_chunks - int(start_chunk))):
       self._submit_next()
@@ -195,12 +201,14 @@ class RemoteBlockStager:
         return
       with self._lock:
         slab = self._slabs.get(c)
+        ctx = self._ctx
       if slab is None or slab.ready.is_set():
         continue   # epoch moved on, or failover already failed it
       try:
         t0 = time.perf_counter()
         fault_point('remote.block_fetch')
-        slab.frame = self.fetch_fn(c)
+        with spans.adopt(ctx):
+          slab.frame = self.fetch_fn(c)
         metrics.observe('remote.block_stage_ms',
                         (time.perf_counter() - t0) * 1e3)
       except BaseException as e:   # a chaos raise must not kill later blocks
@@ -340,6 +348,14 @@ class RemoteScanTrainer:
     self._fetch_timeout = getattr(opts, 'block_timeout', 30.0) \
         if opts else 30.0
     self._failover_enabled = (opts.failover if opts else True)
+    self._tenant = getattr(opts, 'tenant', None) if opts else None
+    self._tenant_priority = getattr(opts, 'tenant_priority', None) \
+        if opts else None
+    self._tenant_weight = getattr(opts, 'tenant_weight', None) \
+        if opts else None
+    self._base_weight = float(self._tenant_weight or 1.0)
+    self._bp_budget = getattr(opts, 'backpressure_budget', 120.0) \
+        if opts else 120.0
     self._config = SamplingConfig(
         SamplingType.NODE, _norm_num_neighbors(num_neighbors),
         self.batch_size, self._shuffle, self._drop_last, False,
@@ -355,14 +371,20 @@ class RemoteScanTrainer:
     for i, (rank, share) in enumerate(zip(self.server_ranks, splits)):
       cfg_i = dataclasses.replace(self._config,
                                   seed=(seed or 0) * 7919 + i)
-      pid = dist_client.request_server(
-          rank, 'create_block_producer', share, cfg_i,
-          self._wire_dtype, worker_key=f'{base_key}/blk/{i}',
-          idempotent=True)
+      pid = with_backpressure(
+          lambda rank=rank, share=share, cfg_i=cfg_i, i=i:
+          dist_client.request_server(
+              rank, 'create_block_producer', share, cfg_i,
+              self._wire_dtype, worker_key=f'{base_key}/blk/{i}',
+              idempotent=True, **self._tenant_kwargs()),
+          describe=f'create_block_producer stream {i} rank {rank}',
+          budget_s=self._bp_budget, tenant=self._tenant)
       nb = dist_client.request_server(
           rank, 'block_producer_num_batches', pid, idempotent=True)
       self._streams.append(dict(rank=rank, pid=pid, seeds=share,
                                 cfg=cfg_i, num_batches=int(nb)))
+    self._active_ranks = list(self.server_ranks)
+    self._current_chunk = -1
     self._dead_ranks: Dict[int, str] = {}
     self._replay_pids: Dict[tuple, int] = {}
     self._epochs = 0
@@ -484,7 +506,9 @@ class RemoteScanTrainer:
                           epoch=epoch, start=b0, k=k, step0=gstep))
       step0 += nb
     if self._dead_ranks:
-      survivors = [r for r in self.server_ranks
+      survivors = [r for r in self._active_ranks
+                   if r not in self._dead_ranks] or \
+                  [r for r in self.server_ranks
                    if r not in self._dead_ranks]
       if not survivors:
         raise RuntimeError('no live sampling server to start the '
@@ -497,6 +521,25 @@ class RemoteScanTrainer:
           d['pid'] = self._replay_pid(surv, d['stream'])
           d['rank'] = surv
           moved += 1
+    # policy shrink (set_block_ranks / set_tenant_weight): home ranks
+    # outside the active set hand their blocks to replay producers on
+    # active ranks — the same counter-addressed contract as failover,
+    # driven by policy instead of death
+    inactive = [r for r in self.server_ranks
+                if r not in self._active_ranks and
+                r not in self._dead_ranks]
+    if inactive:
+      targets = [r for r in self._active_ranks
+                 if r not in self._dead_ranks]
+      moved = 0
+      for d in descs:
+        if d['rank'] in inactive:
+          tgt = targets[moved % len(targets)]
+          d['pid'] = self._replay_pid(tgt, d['stream'])
+          d['rank'] = tgt
+          moved += 1
+      if moved:
+        metrics.inc('tenant.rebalanced_blocks', moved)
     return descs
 
   # -------------------------------------------------------- block fetch
@@ -521,9 +564,12 @@ class RemoteScanTrainer:
     t0 = time.perf_counter()
     with spans.span('remote.block_fetch', chunk=int(c),
                     rank=int(desc['rank']), start=int(desc['start'])):
-      frame = self._dist_client.request_server(
-          desc['rank'], 'block_fetch', desc['pid'], desc['epoch'],
-          desc['start'], desc['k'], idempotent=True)
+      frame = with_backpressure(
+          lambda: self._dist_client.request_server(
+              desc['rank'], 'block_fetch', desc['pid'], desc['epoch'],
+              desc['start'], desc['k'], idempotent=True),
+          describe=f'block_fetch chunk {c} rank {desc["rank"]}',
+          budget_s=self._bp_budget, tenant=self._tenant)
     metrics.observe('remote.block_fetch_ms',
                     (time.perf_counter() - t0) * 1e3)
     nbytes = sum(int(np.asarray(v).nbytes) for v in frame.values())
@@ -557,13 +603,81 @@ class RemoteScanTrainer:
     if pid is not None:
       return pid
     st = self._streams[stream_i]
-    pid = self._dist_client.request_server(
-        survivor, 'create_block_producer', st['seeds'], st['cfg'],
-        self._wire_dtype,
-        worker_key=f'{self._worker_key}/bfo/s{stream_i}/r{survivor}',
-        idempotent=True)
+    pid = with_backpressure(
+        lambda: self._dist_client.request_server(
+            survivor, 'create_block_producer', st['seeds'], st['cfg'],
+            self._wire_dtype,
+            worker_key=f'{self._worker_key}/bfo/s{stream_i}/r{survivor}',
+            idempotent=True, **self._tenant_kwargs()),
+        describe=f'replay producer stream {stream_i} rank {survivor}',
+        budget_s=self._bp_budget, tenant=self._tenant)
     self._replay_pids[key] = pid
     return pid
+
+  # ------------------------------------------------- tenancy / elasticity
+
+  def _tenant_kwargs(self) -> dict:
+    """create_block_producer kwargs registering this trainer's streams
+    under its tenant — empty (and wire-compatible with pre-tenancy
+    servers) when no tenant is configured."""
+    if self._tenant is None:
+      return {}
+    return dict(tenant=self._tenant, priority=self._tenant_priority,
+                weight=self._tenant_weight)
+
+  def set_block_ranks(self, ranks: List[int]):
+    """Elastic resize: restrict block production to ``ranks`` (grow by
+    passing a superset again). Mid-epoch, pending not-yet-staged chunks
+    whose home rank left the active set are re-pointed at replay
+    producers on active ranks — the PR 11 counter-addressed contract
+    makes the re-produced blocks bit-identical, so this is failover
+    machinery driven by policy instead of death."""
+    live = [r for r in dict.fromkeys(ranks) if r not in self._dead_ranks]
+    unknown = [r for r in live if r not in self.server_ranks]
+    if unknown:
+      raise ValueError(f'unknown server ranks {unknown}; trainer knows '
+                       f'{self.server_ranks}')
+    if not live:
+      raise ValueError('set_block_ranks needs at least one live rank '
+                       f'(dead={self._dead_ranks})')
+    self._active_ranks = live
+    if not self._schedule:
+      return
+    moved = 0
+    for j in range(self._current_chunk + 1, len(self._schedule)):
+      d = self._schedule[j]
+      if (d['rank'] in self._active_ranks or
+          d['rank'] in self._dead_ranks or self._stager.has_frame(j)):
+        continue
+      tgt = self._active_ranks[moved % len(self._active_ranks)]
+      d['pid'] = self._replay_pid(tgt, d['stream'])
+      d['rank'] = tgt
+      moved += 1
+    if moved:
+      metrics.inc('tenant.rebalanced_blocks', moved)
+
+  def set_tenant_weight(self, weight: float):
+    """Autoscale on a weight change: push the new fair-share weight to
+    every live server, then grow/shrink the active producer rank set
+    proportionally (weight halved -> half the ranks produce for this
+    tenant; blocks stay bit-identical under the re-point)."""
+    if weight <= 0:
+      raise ValueError(f'tenant weight must be > 0, got {weight}')
+    if self._tenant is not None:
+      for r in self.server_ranks:
+        if r in self._dead_ranks:
+          continue
+        try:
+          self._dist_client.request_server(
+              r, 'update_tenant', self._tenant, weight=float(weight),
+              idempotent=True)
+        except _DEAD_EXCS:
+          pass   # heartbeat will declare it; re-point happens there
+    self._tenant_weight = float(weight)
+    live = [r for r in self.server_ranks if r not in self._dead_ranks]
+    frac = min(1.0, float(weight) / max(self._base_weight, 1e-9))
+    target = max(1, int(np.ceil(frac * len(live))))
+    self.set_block_ranks(live[:target])
 
   def _handle_dead_rank(self, rank: int, cause: str, ci: int):
     """Declare ``rank`` dead and re-point its pending (unfetched)
@@ -707,6 +821,7 @@ class RemoteScanTrainer:
     if start_step:
       start_idx = next(i for i, d in enumerate(self._schedule)
                        if d['step0'] == start_step)
+    self._current_chunk = start_idx - 1
     self._seen_ids: List[np.ndarray] = []
     self._stager.begin_epoch(len(self._schedule), start_chunk=start_idx)
     losses, accs = [], []
@@ -716,6 +831,7 @@ class RemoteScanTrainer:
           jax.device_put(state),
           jax.device_put(np.asarray(bool(resume_overflow))))
       for ci in range(start_idx, len(self._schedule)):
+        self._current_chunk = ci   # elastic re-points only chunks > ci
         desc = self._schedule[ci]
         if self.stage_hook is not None:
           self.stage_hook(ci, desc['step0'], desc['k'])
@@ -793,7 +909,11 @@ class RemoteScanTrainer:
                 shuffle=self._shuffle, drop_last=self._drop_last,
                 num_classes=self.num_classes, seed=self.seed,
                 servers=list(self.server_ranks),
-                wire_dtype=self._wire_dtype)
+                wire_dtype=self._wire_dtype,
+                tenant=self._tenant,
+                tenant_priority=self._tenant_priority,
+                tenant_weight=self._tenant_weight,
+                active_ranks=list(self._active_ranks))
 
   # -------------------------------------------------- recovery protocol
   # (recovery/checkpoint.py ChunkCheckpointer — docs/recovery.md). The
@@ -805,6 +925,10 @@ class RemoteScanTrainer:
   def _recovery_config(self) -> dict:
     import hashlib
     cfg = self._flight_config()
+    # elastic tenancy state changes mid-run by design; it must not
+    # invalidate the snapshot fingerprint
+    cfg.pop('active_ranks', None)
+    cfg.pop('tenant_weight', None)
     cfg.update(
         collect_features=self._config.collect_features,
         seeds_sha=hashlib.sha1(

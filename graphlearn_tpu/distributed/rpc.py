@@ -50,6 +50,23 @@ logger = logging.getLogger('graphlearn_tpu.rpc')
 _HDR = struct.Struct('<Q')
 _SECRET_ENV = 'GLT_RPC_SECRET'
 
+# Typed wire errors (distributed/tenancy.py registers its retryable
+# rejections here): a server-side exception whose class carries a
+# WIRE_TYPE registered in this table ships as a STRUCTURED
+# ``(etype, payload-dict)`` pair in the error frame — plain primitives,
+# never a pickled exception object — and the client reconstructs the
+# typed exception instead of a generic RuntimeError. Anything
+# unregistered keeps the legacy string-only error path.
+_WIRE_ERRORS: Dict[str, Callable[[dict], BaseException]] = {}
+
+
+def register_wire_error(etype: str, factory: Callable[[dict],
+                                                      BaseException]):
+  """Register a typed error for structured RPC propagation. The
+  factory receives the server's payload dict and returns the exception
+  instance to raise client-side."""
+  _WIRE_ERRORS[etype] = factory
+
 
 def _env_secret() -> Optional[bytes]:
   s = os.environ.get(_SECRET_ENV)
@@ -157,8 +174,17 @@ class RpcServer:
                             **req.get('kwargs', {}))
               _send_frame(sock, {'ok': True, 'result': result})
             except Exception as e:  # noqa: BLE001 - errors cross the wire
-              _send_frame(sock, {'ok': False,
-                                 'error': f'{type(e).__name__}: {e}'})
+              reply = {'ok': False,
+                       'error': f'{type(e).__name__}: {e}'}
+              # typed rejections (tenancy throttles/quotas) ship a
+              # structured payload so the client reconstructs the
+              # exact exception — see register_wire_error
+              etype = getattr(type(e), 'WIRE_TYPE', None)
+              if etype in _WIRE_ERRORS:
+                to_wire = getattr(e, 'to_wire', None)
+                reply['etype'] = etype
+                reply['payload'] = to_wire() if to_wire else {}
+              _send_frame(sock, reply)
         except (ConnectionError, EOFError, OSError):
           pass
 
@@ -302,6 +328,14 @@ class RpcClient:
       spans.end(sp, ok=False, error=type(e).__name__)
       raise
     if not resp['ok']:
+      factory = _WIRE_ERRORS.get(resp.get('etype'))
+      if factory is not None:
+        # typed rejection: reconstruct it so callers can distinguish
+        # 'back off and retry' (tenancy throttle) from a remote fault.
+        # NOT in request_sync's retry_on — visible-backpressure layers
+        # (tenancy.with_backpressure) own the wait
+        spans.end(sp, ok=False, error=str(resp.get('etype')))
+        raise factory(resp.get('payload') or {})
       spans.end(sp, ok=False, error='remote')
       raise RuntimeError(
           f'remote error from rank {rank}: {resp["error"]}')

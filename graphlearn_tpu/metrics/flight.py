@@ -215,7 +215,12 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
   # synchronous fallback reads (prefetch_miss) — a degrading prefetch
   # hit rate is visible epoch by epoch
   storage = split('storage')
-  known = set(feature) | set(resilience) | set(fault) | set(storage)
+  # multi-tenant backpressure deltas (distributed/tenancy.py): the
+  # throttle/starve counters and backpressure_ms a contended epoch
+  # accumulated — visible per epoch, next to the resilience story
+  tenant = split('tenant')
+  known = (set(feature) | set(resilience) | set(fault) | set(storage)
+           | set(tenant))
   record = {
       'schema': SCHEMA,
       'kind': 'epoch',
@@ -234,6 +239,7 @@ def epoch_end(token: Optional[dict], emitter: str, epoch: int,
       'resilience': resilience,
       'fault': fault,
       'storage': storage,
+      'tenant': tenant,
       'programs': prog,
       'counters': {k: v for k, v in cdelta.items() if k not in known},
       'config': _jsonable(config or {}),

@@ -122,6 +122,17 @@ REGISTERED_METRICS = frozenset({
     # (the stop point itself is device state, read from the report)
     'run.runs',
     'run.epochs_scheduled',
+    # multi-tenant service fabric (distributed/tenancy.py +
+    # dist_server.py, docs/multi_tenancy.md): admission rejections,
+    # fair-scheduler waits, client-visible backpressure, and the
+    # per-tenant reap family (tails minted as tenant.reaped.<tenant>)
+    'tenant.admit_rejections',
+    'tenant.throttled',
+    'tenant.starved',
+    'tenant.sched_wait_ms',
+    'tenant.backpressure_ms',
+    'tenant.rebalanced_blocks',
+    'tenant.*',
 })
 
 # The closed inventory of SPAN names (metrics/spans.py) — the same
@@ -177,4 +188,8 @@ REGISTERED_SPANS = frozenset({
     # whole multi-epoch run; the inherited epoch.run/epoch.chunk spans
     # parent under it
     'run.train',
+    # multi-tenant backpressure (distributed/tenancy.py): one span per
+    # bounded-backoff throttle wait on the client, parented under the
+    # epoch root via the stager's adopted context (docs/multi_tenancy.md)
+    'tenant.throttle',
 })

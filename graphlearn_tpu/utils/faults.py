@@ -69,6 +69,9 @@ REGISTERED_SITES = frozenset({
     'recovery.save',
     'recovery.restore',
     'recovery.roll_back',
+    'tenant.admit',
+    'tenant.throttle',
+    'tenant.reap',
 })
 
 

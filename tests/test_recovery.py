@@ -462,6 +462,9 @@ def _torn_and_faulted_restores(scan_ref, ckdir, snaps):
 # ------------------------------------------------------- tiered + dist
 
 
+@pytest.mark.slow  # tier-1 budget (PR 17): tiered variant of the
+                   # crash-resume family — the scan and dist reps stay
+                   # tier-1 (+ the SIGKILL matrix under slow)
 def test_tiered_crash_resume_bit_identical(scan_ref, tmp_path):
   """TieredScanTrainer (hot/warm/disk tiers, shuffle=True) killed
   mid-epoch resumes bit-identically to the ALL-HBM reference: the

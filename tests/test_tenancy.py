@@ -1,0 +1,636 @@
+"""Multi-tenant service fabric (docs/multi_tenancy.md).
+
+The contracts under test, in order:
+
+* **Typed, retryable rejections** — over-quota admission answers
+  ``TenantQuotaExceeded`` THROUGH the RPC wire with the tenant id and
+  quota snapshot aboard; never a ConnectionError (no bogus failover),
+  never a timeout.
+* **Weighted-fair scheduling** — the server's block lane drains by
+  deficit-weighted round-robin within a priority class and an
+  interactive tenant's work preempts a queued training backlog.
+* **Visible backpressure** — a throttled produce surfaces as a bounded
+  ``with_backpressure`` wait emitting ``tenant.backpressure_ms`` + a
+  ``tenant.throttle`` span, and succeeds once the quota drains; an
+  exhausted budget fails loudly with the quota state.
+* **Contention bit-identity** — 2 training tenants + 1 interactive
+  tenant sharing one cluster complete concurrent epochs bit-identical
+  to uncontended runs with exact per-tenant seed coverage (blocks are
+  counter-addressed: scheduling order cannot change bytes).
+* **Elastic producers** — a mid-epoch weight flip shrinks the tenant's
+  active rank set; pending blocks re-point to replay producers
+  bit-identically (PR 11 failover machinery driven by policy), riding
+  out an admission bounce as visible backpressure under the epoch root.
+* **Quota/TTL interplay** — per-tenant ``producer_ttl`` reaps ONLY the
+  vanished tenant's streams (zero leaked ring channels, per-tenant
+  ``tenant.reaped.<t>`` counter), survivors bit-identical, and a
+  reaped pid's stale-handle error names the tenant + quota.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu.distributed.tenancy import (
+    AdmissionController, TenancyConfig, TenantQuotaExceeded,
+    TenantRejection, TenantSpec, TenantStarvedError, TenantThrottled,
+    WeightedFairScheduler, with_backpressure)
+from graphlearn_tpu.models import GraphSAGE, train as train_lib
+from graphlearn_tpu.utils import faults, trace
+
+N = 38          # 38 seeds / bs 4 -> 10 batches, ragged tail batch of 2
+BS = 4
+K = 4           # 10 steps at K=4 -> chunks of 4, 4 and a tail chunk of 2
+CLASSES = 3
+FANOUTS = [2, 2]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  faults.disarm()
+  trace.reset_counters()
+  yield
+  faults.disarm()
+  trace.reset_counters()
+  from graphlearn_tpu.distributed import dist_client
+  if dist_client._client is not None:
+    dist_client._client.close()
+    dist_client._client = None
+
+
+def make_dataset(n=N):
+  rows = np.concatenate([np.arange(n), np.arange(n)])
+  cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n])
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([rows, cols]), graph_mode='CPU', num_nodes=n)
+  feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                           np.float32)
+  ds.init_node_features(feat)
+  ds.init_node_labels(np.arange(n) % CLASSES)
+  return ds
+
+
+def _start_server(ds, tenancy=None, producer_ttl=None):
+  """DistServer + RpcServer in THIS process (the chaos-suite pattern):
+  fast, fault sites arm deterministically, and the admission state is
+  directly inspectable."""
+  from graphlearn_tpu.distributed.dist_server import DistServer
+  from graphlearn_tpu.distributed.rpc import RpcServer
+  s = DistServer(ds, producer_ttl=producer_ttl, tenancy=tenancy)
+  rpc = RpcServer(handlers={
+      'create_sampling_producer': s.create_sampling_producer,
+      'producer_num_expected': s.producer_num_expected,
+      'start_new_epoch_sampling': s.start_new_epoch_sampling,
+      'fetch_one_sampled_message': s.fetch_one_sampled_message,
+      'destroy_sampling_producer': s.destroy_sampling_producer,
+      'create_block_producer': s.create_block_producer,
+      'block_producer_num_batches': s.block_producer_num_batches,
+      'block_produce': s.block_produce,
+      'block_fetch': s.block_fetch,
+      'destroy_block_producer': s.destroy_block_producer,
+      'update_tenant': s.update_tenant,
+      'get_dataset_meta': s.get_dataset_meta,
+      'heartbeat': s.heartbeat,
+      'get_metrics': s.get_metrics,
+      'exit': s.exit,
+  })
+  return s, rpc
+
+
+def _init_client(pairs):
+  from graphlearn_tpu.distributed import dist_client
+  dist_client.init_client(
+      num_servers=len(pairs), num_clients=1, client_rank=0,
+      server_addrs=[(rpc.host, rpc.port) for _, rpc in pairs])
+
+
+def _teardown(pairs):
+  from graphlearn_tpu.distributed import dist_client
+  if dist_client._client is not None:
+    dist_client._client.close()
+    dist_client._client = None
+  for s, rpc in pairs:
+    s.exit()
+    rpc.shutdown()
+
+
+def _model_and_state(ds, seeds, key=0):
+  import jax
+  loader = glt.loader.NeighborLoader(ds, FANOUTS, seeds, batch_size=BS,
+                                     shuffle=False)
+  template = train_lib.batch_to_dict(next(iter(loader)))
+  model = GraphSAGE(hidden_dim=8, out_dim=CLASSES, num_layers=2)
+  state, tx = train_lib.create_train_state(model, jax.random.PRNGKey(key),
+                                           template)
+  return model, tx, state, template
+
+
+def _make_trainer(model, tx, seeds, ranks=0, **opt_kw):
+  opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+      server_rank=ranks, **opt_kw)
+  return glt.distributed.RemoteScanTrainer(
+      FANOUTS, seeds, model, tx, CLASSES, batch_size=BS, chunk_size=K,
+      seed=0, worker_options=opts)
+
+
+def _block_cfg(seed=0):
+  from graphlearn_tpu.sampler import SamplingConfig, SamplingType
+  from graphlearn_tpu.distributed.dist_loader import _norm_num_neighbors
+  return SamplingConfig(SamplingType.NODE, _norm_num_neighbors(FANOUTS),
+                        BS, False, False, False, True, False, False,
+                        'out', seed)
+
+
+# ----------------------------------------------------------- unit layer
+
+
+def test_spec_validation_and_wire_roundtrip():
+  with pytest.raises(ValueError, match='priority'):
+    TenantSpec(tenant='x', priority='vip')
+  with pytest.raises(ValueError, match='weight'):
+    TenantSpec(tenant='x', weight=0.0)
+  for cls in (TenantRejection, TenantQuotaExceeded, TenantThrottled):
+    e = cls('trainA', 'producers', 'at quota',
+            quota={'producers': 2, 'max_producers': 2}, retry_after=0.5)
+    e2 = cls.from_wire(e.to_wire())
+    assert type(e2) is cls and e2.tenant == 'trainA'
+    assert e2.quota == e.quota and e2.retry_after == 0.5
+    assert e2.retryable
+    # NOT a dead-server class: must never trip the failover/retry paths
+    assert not isinstance(e, (ConnectionError, TimeoutError, OSError))
+  starved = TenantStarvedError('fetch', e, 3.5)
+  assert starved.tenant == 'trainA' and starved.quota['producers'] == 2
+  assert 'starved' in str(starved) and 'quota' in str(starved)
+
+
+def test_queue_timeout_with_context():
+  from graphlearn_tpu.channel import QueueTimeoutError
+  e = QueueTimeoutError('idle for 180.0s').with_context(
+      tenant='bulk1', quota={'producers': 4, 'max_producers': 4})
+  assert isinstance(e, QueueTimeoutError)
+  assert e.tenant == 'bulk1' and e.quota['max_producers'] == 4
+  assert "tenant='bulk1'" in str(e) and 'idle for 180.0s' in str(e)
+  # no tenant configured: message unchanged
+  assert str(QueueTimeoutError('plain').with_context()) == 'plain'
+
+
+def test_scheduler_weighted_fairness_and_priority_preemption():
+  """DWRR: two contending training tenants split grants ~ by weight;
+  a later-arriving interactive tenant's work jumps the whole queued
+  training backlog (strict priority between classes)."""
+  adm = AdmissionController(TenancyConfig())
+  adm.register('heavy', priority='training', weight=3.0)
+  adm.register('light', priority='training', weight=1.0)
+  adm.register('ui', priority='interactive', weight=1.0)
+  sched = WeightedFairScheduler(adm, quantum=2.0, timeout=10.0)
+  try:
+    order = []
+    olock = threading.Lock()
+
+    def pump(tenant, n):
+      for _ in range(n):
+        def work():
+          with olock:
+            order.append(tenant)
+          time.sleep(0.002)
+        sched.run(tenant, 4.0, work)
+
+    th = [threading.Thread(target=pump, args=('heavy', 30)),
+          threading.Thread(target=pump, args=('light', 30))]
+    for t in th:
+      t.start()
+    time.sleep(0.05)   # let the training backlog queue up...
+    ui = threading.Thread(target=pump, args=('ui', 5))
+    ui.start()         # ...then the interactive tenant arrives
+    for t in th + [ui]:
+      t.join()
+    assert sched.served['heavy'] == 120.0
+    assert sched.served['light'] == 120.0
+    assert sched.served['ui'] == 20.0
+    # preemption: once queued, the 5 ui grants run back to back
+    first_ui = order.index('ui')
+    assert order[first_ui:first_ui + 5] == ['ui'] * 5
+    # fairness: in the window where both training tenants contend
+    # (before light's backlog drains), heavy's grant share tracks its
+    # 3x weight (exact DRR ratio depends on arrival interleave)
+    window = order[4:24]
+    h, l = window.count('heavy'), window.count('light')
+    assert h > l, (h, l)
+  finally:
+    sched.close()
+
+
+def test_backpressure_budget_exhaustion_fails_loudly():
+  calls = []
+
+  def always_throttled():
+    calls.append(1)
+    raise TenantThrottled('bulk1', 'inflight_bytes', 'throttled',
+                          quota={'inflight_bytes': 9}, retry_after=0.01)
+
+  with pytest.raises(TenantStarvedError) as ei:
+    with_backpressure(always_throttled, describe='produce',
+                      budget_s=0.05, base_delay=0.01)
+  assert ei.value.tenant == 'bulk1'
+  assert ei.value.quota == {'inflight_bytes': 9}
+  assert len(calls) >= 2          # it DID retry before giving up
+  assert trace.counter_get('tenant.starved') == 1
+
+
+# ------------------------------------------------------ wire/admission
+
+
+def test_admission_quota_typed_rejection_over_wire():
+  """Over-quota create answers TenantQuotaExceeded THROUGH the RPC
+  wire — typed, retryable, quota snapshot aboard — and the slot frees
+  on destroy (retry then succeeds)."""
+  ds = make_dataset()
+  tenancy = TenancyConfig(specs=[
+      TenantSpec(tenant='trainA', priority='training', max_producers=1)])
+  pairs = [_start_server(ds, tenancy=tenancy)]
+  try:
+    _init_client(pairs)
+    from graphlearn_tpu.distributed import dist_client
+    cfg = _block_cfg()
+    seeds = np.arange(N)
+    pid = dist_client.request_server(
+        0, 'create_block_producer', seeds, cfg, None,
+        worker_key='t/a/0', tenant='trainA', priority='training')
+    with pytest.raises(TenantQuotaExceeded) as ei:
+      dist_client.request_server(
+          0, 'create_block_producer', seeds, cfg, None,
+          worker_key='t/a/1', tenant='trainA')
+    assert ei.value.tenant == 'trainA'
+    assert ei.value.resource == 'producers'
+    assert ei.value.quota['max_producers'] == 1
+    assert ei.value.retryable
+    assert trace.counter_get('tenant.admit_rejections') == 1
+    # quota state is published: get_metrics carries the snapshot
+    snap = dist_client.request_server(0, 'get_metrics')['tenants']
+    assert snap['trainA']['producers'] == 1
+    # retryable for real: destroy frees the slot
+    dist_client.request_server(0, 'destroy_block_producer', pid)
+    pid2 = dist_client.request_server(
+        0, 'create_block_producer', seeds, cfg, None,
+        worker_key='t/a/2', tenant='trainA')
+    assert pid2 != pid
+  finally:
+    _teardown(pairs)
+
+
+def test_inflight_throttle_visible_backpressure_then_drain():
+  """The produce-ahead throttle end to end: a tenant at its in-flight
+  byte quota gets TenantThrottled over the wire; with_backpressure
+  absorbs it as a visible wait (tenant.backpressure_ms + tenant.throttle
+  span, orphan-free) and the SAME produce succeeds once a fetch drains
+  the staged frame."""
+  from graphlearn_tpu.metrics import spans
+  ds = make_dataset()
+  tenancy = TenancyConfig(specs=[
+      TenantSpec(tenant='trainA', max_inflight_bytes=1)])
+  pairs = [_start_server(ds, tenancy=tenancy)]
+  try:
+    _init_client(pairs)
+    from graphlearn_tpu.distributed import dist_client
+    pid = dist_client.request_server(
+        0, 'create_block_producer', np.arange(N), _block_cfg(), None,
+        worker_key='t/bp/0', tenant='trainA')
+    spans.reset()
+    dist_client.request_server(0, 'block_produce', pid, 0, 0, K)
+    # the staged frame holds the whole 1-byte quota: next produce bounces
+    with pytest.raises(TenantThrottled) as ei:
+      dist_client.request_server(0, 'block_produce', pid, 0, K, K)
+    assert ei.value.resource == 'inflight_bytes'
+    assert ei.value.retry_after is not None
+
+    def drain():
+      time.sleep(0.25)
+      dist_client.request_server(0, 'block_fetch', pid, 0, 0, K,
+                                 idempotent=True)
+
+    t = threading.Thread(target=drain)
+    t.start()
+    with_backpressure(
+        lambda: dist_client.request_server(0, 'block_produce', pid, 0,
+                                           K, K),
+        describe='produce ahead', budget_s=30.0, tenant='trainA')
+    t.join()
+    assert trace.counter_get('tenant.throttled') >= 2
+    collected = list(spans.export(trace=spans.run_id()))
+    throttles = [r for r in collected if r['name'] == 'tenant.throttle']
+    assert throttles, 'backpressure wait must be a visible span'
+    assert throttles[0]['attrs']['tenant'] == 'trainA'
+    assert throttles[0]['attrs']['resource'] == 'inflight_bytes'
+    assert spans.build_tree(collected)['orphans'] == []
+  finally:
+    _teardown(pairs)
+
+
+# ------------------------------------------------- contention (tentpole)
+
+
+def test_contention_three_tenants_bit_identical_epochs():
+  """The acceptance rep: 2 training tenants (weights 2:1) + 1
+  interactive tenant share one cluster and run their epochs
+  CONCURRENTLY through the weighted-fair lane. Every tenant's losses
+  are bit-identical to an uncontended run and seed coverage is exact —
+  the counter-addressed block contract makes scheduling order
+  invisible to the numerics; the server accounts fair-share service
+  per tenant."""
+  import jax
+  ds = make_dataset()
+  seeds = np.arange(N)
+  tenancy = TenancyConfig(specs=[
+      TenantSpec(tenant='trainA', priority='training', weight=2.0),
+      TenantSpec(tenant='trainB', priority='training', weight=1.0),
+      TenantSpec(tenant='ui', priority='interactive', weight=1.0)])
+  pairs = [_start_server(ds, tenancy=tenancy)]
+  try:
+    _init_client(pairs)
+    model, tx, state0, template = _model_and_state(ds, seeds)
+
+    # uncontended reference (default tenant, same seed/config: every
+    # tenant's stream is the same pure function of (share, cfg, epoch))
+    ref = _make_trainer(model, tx, seeds)
+    sref, losses_ref, _ = ref.run_epoch(jax.device_put(state0))
+    losses_ref = np.asarray(losses_ref)
+    ref.shutdown()
+
+    tenants = [('trainA', 'training', 2.0), ('trainB', 'training', 1.0),
+               ('ui', 'interactive', 1.0)]
+    results, errors = {}, []
+
+    def run(tenant, priority, weight):
+      try:
+        import jax
+        tr = _make_trainer(model, tx, seeds, tenant=tenant,
+                           tenant_priority=priority,
+                           tenant_weight=weight)
+        st, _ = train_lib.create_train_state(
+            model, jax.random.PRNGKey(0), template, optimizer=tx)
+        st, losses, _ = tr.run_epoch(st)
+        results[tenant] = (np.asarray(losses),
+                           sorted(tr.last_epoch_seed_ids.tolist()))
+        tr.shutdown()
+      except BaseException as e:   # noqa: BLE001 - surfaced via join
+        errors.append((tenant, e))
+
+    threads = [threading.Thread(target=run, args=t) for t in tenants]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join(timeout=300)
+    assert not errors, errors
+    for tenant, _, _ in tenants:
+      losses, cover = results[tenant]
+      np.testing.assert_array_equal(losses, losses_ref)
+      assert cover == list(range(N)), tenant
+    served = pairs[0][0].get_metrics()['tenant_served']
+    assert all(served.get(t, 0) > 0 for t, _, _ in tenants), served
+    snaps = pairs[0][0].get_metrics()['tenants']
+    assert snaps['ui']['priority'] == 'interactive'
+    assert snaps['trainA']['weight'] == 2.0
+  finally:
+    _teardown(pairs)
+
+
+def test_mid_epoch_weight_flip_elastic_shrink_bit_identical(
+    monkeypatch, tmp_path):
+  """Elastic producers: halving a tenant's weight mid-epoch shrinks
+  its active rank set; the dropped rank's pending blocks re-point to a
+  replay producer on the surviving rank BIT-IDENTICALLY (policy-driven
+  failover). The replay create bounces off the tenant's producer quota
+  first — visible backpressure (tenant.throttle span under the epoch
+  root, counters on the flight record), resolved when the quota
+  frees."""
+  import jax
+  from graphlearn_tpu.metrics import flight, spans
+  run_log = tmp_path / 'flip.jsonl'
+  monkeypatch.setenv('GLT_RUN_LOG', str(run_log))
+  ds = make_dataset(40)
+  seeds = np.arange(40)
+  tenancy = TenancyConfig(specs=[
+      TenantSpec(tenant='train', priority='training', weight=1.0,
+                 max_producers=2)])   # per-SERVER: rank 0 holds the
+  # occupier + the tenant's home stream, so the mid-epoch replay create
+  # must wait for the occupier to free
+  pairs = [_start_server(ds, tenancy=tenancy) for _ in range(2)]
+  try:
+    _init_client(pairs)
+    model, tx, state0, template = _model_and_state(ds, seeds)
+
+    clean = _make_trainer(model, tx, seeds, ranks=[0, 1])
+    sA, losses_clean, _ = clean.run_epoch(jax.device_put(state0))
+    clean.shutdown()
+
+    # a third producer occupies the tenant's last quota slot, so the
+    # mid-epoch replay create MUST ride backpressure until it frees
+    hold = pairs[0][0].create_block_producer(
+        seeds[:4], _block_cfg(seed=7), None, worker_key='t/hold',
+        tenant='train')
+    trainer = _make_trainer(model, tx, seeds, ranks=[0, 1],
+                            tenant='train', tenant_priority='training',
+                            tenant_weight=1.0, block_ahead=1)
+    spans.reset()
+    flipped = []
+
+    def flip(c, start, k):
+      if c == 0 and not flipped:
+        flipped.append(True)
+        threading.Timer(
+            0.3, pairs[0][0].destroy_block_producer, args=(hold,)
+        ).start()
+        trainer.set_tenant_weight(0.5)   # 2 ranks -> 1 active rank
+
+    trainer.ack_hook = flip
+    st, _ = train_lib.create_train_state(
+        model, jax.random.PRNGKey(0), template, optimizer=tx)
+    st, losses, _ = trainer.run_epoch(st)
+    np.testing.assert_array_equal(np.asarray(losses),
+                                  np.asarray(losses_clean))
+    assert sorted(trainer.last_epoch_seed_ids.tolist()) == list(range(40))
+    assert trainer._active_ranks == [0]
+    assert trace.counter_get('tenant.admit_rejections') >= 1
+    assert trace.counter_get('tenant.rebalanced_blocks') >= 1
+    # the throttle wait is a SPAN under the completed epoch root
+    collected = list(spans.export(trace=spans.run_id()))
+    tree = spans.build_tree(collected)
+    assert tree['orphans'] == []
+    by_name = {}
+    for r in collected:
+      by_name.setdefault(r['name'], []).append(r)
+    [root] = [r for r in by_name['epoch.run']
+              if r['attrs'].get('completed')]
+    throttles = by_name.get('tenant.throttle', [])
+    assert throttles and all(t['parent'] == root['span']
+                             for t in throttles)
+    # the new weight reached the servers' fair-share plane
+    assert pairs[0][0].get_metrics()['tenants']['train']['weight'] == 0.5
+    trainer.shutdown()
+    # ...and the whole episode rides the flight record
+    rec = [r for r in flight.read_records(str(run_log))
+           if r['emitter'] == 'RemoteScanTrainer'][-1]
+    assert rec['completed'] and rec['config']['tenant'] == 'train'
+    assert rec['tenant'].get('tenant.admit_rejections', 0) >= 1
+    assert rec['tenant'].get('tenant.rebalanced_blocks', 0) >= 1
+  finally:
+    _teardown(pairs)
+
+
+# ------------------------------------------------------- quota/TTL chaos
+
+
+def test_tenant_reap_scopes_to_tenant_with_admit_chaos():
+  """Satellite chaos rep: an armed tenant.admit fault bounces one
+  create (counted), the idle tenant's producers are reaped — ONLY its
+  own (per-tenant ttl), zero leaked ring channels, per-tenant
+  tenant.reaped counter — and the surviving tenant's epoch is
+  bit-identical with exact counts. A reaped pid's stale-handle error
+  names the tenant and its quota."""
+  import jax
+  from graphlearn_tpu.channel import live_channel_count
+  ds = make_dataset()
+  seeds = np.arange(N)
+  tenancy = TenancyConfig(specs=[
+      TenantSpec(tenant='idle', producer_ttl=0.3),
+      TenantSpec(tenant='live', producer_ttl=60.0)])
+  pairs = [_start_server(ds, tenancy=tenancy)]
+  server = pairs[0][0]
+  try:
+    _init_client(pairs)
+    from graphlearn_tpu.distributed import dist_client
+    model, tx, state0, template = _model_and_state(ds, seeds)
+
+    ref = _make_trainer(model, tx, seeds)
+    s_ref, losses_ref, _ = ref.run_epoch(jax.device_put(state0))
+    ref.shutdown()
+
+    # armed admission chaos: the first create of the epoch fails hard
+    # (the fault is not a typed rejection — with_backpressure must NOT
+    # absorb it) and the retry path is the CLIENT's to choose
+    faults.arm('tenant.admit', 'raise', times=1)
+    with pytest.raises(RuntimeError):
+      dist_client.request_server(
+          0, 'create_block_producer', seeds, _block_cfg(), None,
+          worker_key='t/chaos', tenant='live')
+    assert trace.counter_get('fault.tenant.admit') == 1
+
+    base_channels = live_channel_count()
+    cfg = _block_cfg()
+    idle_spid = server.create_sampling_producer(
+        seeds[:8], cfg, num_workers=1, worker_key='t/idle/s',
+        tenant='idle')
+    idle_bpid = server.create_block_producer(
+        seeds[:8], cfg, None, worker_key='t/idle/b', tenant='idle')
+    live_bpid = server.create_block_producer(
+        seeds, cfg, None, worker_key='t/live/b', tenant='live')
+    assert live_channel_count() > base_channels   # idle's shm ring lives
+
+    time.sleep(0.45)   # idle tenant's ttl (0.3 s) expires; live's is 60 s
+    server.block_producer_num_batches(live_bpid)   # touch the survivor
+    # the server's own reaper thread polls at ttl/4 and races a manual
+    # sweep — assert the OUTCOME (both of idle's producers reaped, by
+    # either mechanism), not which sweep got there first
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+        trace.counter_get('tenant.reaped.idle') < 2:
+      server.reap_idle_producers()
+      time.sleep(0.05)
+    assert trace.counter_get('tenant.reaped.idle') == 2
+    assert trace.counter_get('tenant.reaped.live') == 0
+    # zero leaked rings — the reaped mp producer's worker may still be
+    # mid-spawn, so its ring teardown completes asynchronously
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and \
+        live_channel_count() > base_channels:
+      time.sleep(0.1)
+    assert live_channel_count() == base_channels
+    # survivor untouched, reaped handles answer WITH tenant context
+    assert server.block_producer_num_batches(live_bpid) == 10
+    with pytest.raises(RuntimeError, match=r"tenant='idle'.*idle-reaped"):
+      server.block_produce(idle_bpid, 0, 0, K)
+    with pytest.raises(RuntimeError, match=r"tenant='idle'"):
+      server.fetch_one_sampled_message(idle_spid, timeout_ms=10)
+    # the admission slots freed with the reap: 'idle' can come back
+    server.create_block_producer(seeds[:8], cfg, None,
+                                 worker_key='t/idle/b2', tenant='idle')
+
+    # the surviving tenant's epoch after all of the above: bit-identical
+    surv = _make_trainer(model, tx, seeds, tenant='live',
+                         tenant_priority='training')
+    st, _ = train_lib.create_train_state(
+        model, jax.random.PRNGKey(0), template, optimizer=tx)
+    st, losses, _ = surv.run_epoch(st)
+    np.testing.assert_array_equal(np.asarray(losses),
+                                  np.asarray(losses_ref))
+    assert sorted(surv.last_epoch_seed_ids.tolist()) == list(range(N))
+    surv.shutdown()
+  finally:
+    _teardown(pairs)
+
+
+def _victim_main(host, port, ready):
+  # spawn target (module-level for picklability): register one block
+  # producer under tenant 'victim', signal, then hang until SIGKILLed
+  from graphlearn_tpu.distributed import dist_client as dc
+  dc.init_client(num_servers=1, num_clients=1, client_rank=0,
+                 server_addrs=[(host, port)])
+  dc.request_server(0, 'create_block_producer', np.arange(8),
+                    _block_cfg(), None, worker_key='v/b',
+                    tenant='victim')
+  ready.set()
+  time.sleep(60)
+
+
+@pytest.mark.slow
+def test_tenant_sigkill_reap_survivor_bit_identical():
+  """The real-process variant: a client process creates producers
+  under its own tenant and is SIGKILLed; the per-tenant ttl reaps only
+  its streams, and a surviving tenant in THIS process still runs a
+  bit-identical epoch against the same server."""
+  import multiprocessing as mp
+  import jax
+  ds = make_dataset()
+  seeds = np.arange(N)
+  tenancy = TenancyConfig(specs=[
+      TenantSpec(tenant='victim', producer_ttl=0.3),
+      TenantSpec(tenant='live', producer_ttl=60.0)])
+  pairs = [_start_server(ds, tenancy=tenancy)]
+  server = pairs[0][0]
+  try:
+    _init_client(pairs)
+    model, tx, state0, template = _model_and_state(ds, seeds)
+    ref = _make_trainer(model, tx, seeds)
+    s_ref, losses_ref, _ = ref.run_epoch(jax.device_put(state0))
+    ref.shutdown()
+
+    host, port = pairs[0][1].host, pairs[0][1].port
+    ctx = mp.get_context('spawn')
+    ready = ctx.Event()
+    proc = ctx.Process(target=_victim_main, args=(host, port, ready))
+    proc.start()
+    assert ready.wait(60)
+    proc.kill()
+    proc.join(10)
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+      if trace.counter_get('tenant.reaped.victim') >= 1:
+        break
+      server.reap_idle_producers()
+      time.sleep(0.1)
+    assert trace.counter_get('tenant.reaped.victim') >= 1
+    assert trace.counter_get('tenant.reaped.live') == 0
+
+    surv = _make_trainer(model, tx, seeds, tenant='live')
+    st, _ = train_lib.create_train_state(
+        model, jax.random.PRNGKey(0), template, optimizer=tx)
+    st, losses, _ = surv.run_epoch(st)
+    np.testing.assert_array_equal(np.asarray(losses),
+                                  np.asarray(losses_ref))
+    assert sorted(surv.last_epoch_seed_ids.tolist()) == list(range(N))
+    surv.shutdown()
+  finally:
+    _teardown(pairs)

@@ -203,6 +203,9 @@ def test_config_fingerprint_refuses_drifted_dataset(tmp_path):
     TuneArtifact.load(path)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 17): tie-break-knob variant of
+                   # the tune() selection policy — exact_pins stays
+                   # tier-1 as the family rep
 def test_tune_cost_tiebreak_env(monkeypatch):
   """Under GLT_PROGRAM_COST=1 the candidate records carry XLA cost
   attribution (flops / peak HBM) — the CPU-replica tie-break signal —
