@@ -427,6 +427,20 @@ BENCH_KEY_REGISTRY = {
                               'wins; arms bit-identical)',
     'run_scan_config': 'E/steps/K/batch shape + both arms\' dispatch '
                        'counts behind the run_scan figures',
+    # topology-wide autotune + continuous retune (ISSUE 18, tune/
+    # topology.py + tune/retune.py, docs/tuning.md): the one-call cost
+    # of tuning a DISTRIBUTED scenario (every candidate a freshly built
+    # store), and the drift-to-published-config latency of the shadow
+    # retune daemon
+    'dist_tune_wall_s': "tune(topology='dist') wall seconds on the "
+                        'CPU-replica mesh fixture (feasibility screen '
+                        '+ per-scenario compile/steady A/Bs + artifact)',
+    'topology_tune_config': "the dist tune's winning topology knob "
+                            'assignment + winner + artifact '
+                            'fingerprint (evidence string)',
+    'retune_trigger_to_publish_s': 'RetuneScheduler latency from drift-'
+                                   'trigger fire to published artifact '
+                                   '(shadow tune + config= publish)',
     # scanned DISTRIBUTED epoch (PR 4)
     'dist_epoch_dispatches': 'per-step collocated dist epoch dispatches',
     'dist_epoch_wall_s': 'per-step collocated dist epoch wall seconds',
@@ -606,7 +620,7 @@ BENCH_ERROR_SECTIONS = (
     'run_softmax_impl', 'hetero_step', 'hetero_ref', 'feature_exchange',
     'serving', 'oversub', 'dist_oversub', 'rotation', 'recovery',
     'remote_scan', 'gather2', 'fused_hop', 'fused_multihop',
-    'oversub_per_step', 'tune', 'run_scan', 'tenancy',
+    'oversub_per_step', 'tune', 'topology_tune', 'run_scan', 'tenancy',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -631,6 +645,10 @@ BENCH_LOWER_IS_BETTER = frozenset({
     'retrace_count', 'compile_time_s_total',
     'dist_epoch_dispatches', 'dist_epoch_wall_s',
     'dist_scan_epoch_dispatches', 'dist_scan_epoch_wall_s',
+    # the topology-tune cost pair: the one-call dist tune and the
+    # drift-to-published-config latency (a retune daemon that gets
+    # slower to publish is a serving-freshness regression)
+    'dist_tune_wall_s', 'retune_trigger_to_publish_s',
     'feature_exchange_mb_per_batch',
     'run_mean_impl_reshape_ms', 'run_mean_impl_window_ms',
     'run_softmax_impl_reshape_ms', 'run_softmax_impl_window_ms',
@@ -1356,6 +1374,116 @@ def main():
         ddc.total / max(sdc.total, 1), 1)
   except Exception as e:
     result['dist_scan_epoch_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- topology-wide autotune + continuous retune (tune/topology.py +
+  # tune/retune.py, docs/tuning.md 'Topology candidates' / 'Continuous
+  # retuning'): one dist-scenario tune on the CPU-replica mesh — every
+  # candidate is a freshly BUILT scenario because the dist knobs are
+  # store-construction parameters — then a live RetuneScheduler timed
+  # from drift-trigger fire to published artifact.
+  try:
+    import threading
+
+    import jax.numpy as jnp
+    import optax
+    from graphlearn_tpu.models import GraphSAGE
+    from graphlearn_tpu.models import train as train_lib
+    from graphlearn_tpu.typing import GraphPartitionData
+    from jax.sharding import Mesh
+    tp_ = min(4, len(jax.devices()))
+    tt_n, tt_deg, tt_batch, tt_steps = 4_000, 8, 8, 4
+    tt_rng = np.random.default_rng(7)
+    tt_rows = tt_rng.integers(0, tt_n, tt_n * tt_deg)
+    tt_cols = tt_rng.integers(0, tt_n, tt_n * tt_deg)
+    tt_node_pb = (np.arange(tt_n) % tp_).astype(np.int32)
+    tt_epb = tt_node_pb[tt_rows]
+    tt_eids = np.arange(tt_rows.shape[0])
+    tt_parts, tt_feats = [], []
+    for q_ in range(tp_):
+      m_ = tt_epb == q_
+      tt_parts.append(GraphPartitionData(
+          edge_index=np.stack([tt_rows[m_], tt_cols[m_]]),
+          eids=tt_eids[m_]))
+      ids_ = np.nonzero(tt_node_pb == q_)[0]
+      tt_feats.append((ids_.astype(np.int64),
+                       tt_rng.standard_normal((ids_.shape[0], 16))
+                       .astype(np.float32)))
+    tt_mesh = Mesh(np.array(jax.devices()[:tp_]), ('g',))
+    tt_dg = glt.distributed.DistGraph(tp_, 0, tt_parts, tt_node_pb,
+                                      tt_epb)
+    tt_labels = tt_rng.integers(0, 8, tt_n)
+    tt_seeds = tt_rng.integers(0, tt_n, tp_ * tt_batch * tt_steps)
+    tt_model = GraphSAGE(hidden_dim=32, out_dim=8, num_layers=2)
+    tt_tx = optax.adam(1e-3)
+
+    def _topo_scenario(knobs, chunk_k):
+      wire = jnp.bfloat16 if knobs.get('wire_dtype') == 'bf16' else None
+      df_ = glt.distributed.DistFeature(
+          tp_, tt_feats, tt_node_pb, tt_mesh,
+          split_ratio=knobs.get('split_ratio') or 0.0,
+          wire_dtype=wire, bucket_frac=knobs.get('bucket_frac'))
+      ds_ = glt.distributed.DistDataset(tp_, 0, tt_dg, df_,
+                                        node_labels=tt_labels)
+      loader_ = glt.distributed.DistNeighborLoader(
+          ds_, [4, 2], tt_seeds, batch_size=tt_batch, shuffle=False,
+          drop_last=True, seed=0, mesh=tt_mesh)
+      first_ = next(iter(loader_))
+      params_ = tt_model.init(jax.random.PRNGKey(0),
+                              np.asarray(first_.x)[0],
+                              np.asarray(first_.edge_index)[0],
+                              np.asarray(first_.edge_mask)[0])
+      state_ = train_lib.TrainState(params_, tt_tx.init(params_),
+                                    jnp.zeros((), jnp.int32))
+      trainer_ = glt.loader.DistScanTrainer(loader_, tt_model, tt_tx, 8,
+                                            chunk_size=chunk_k)
+      return trainer_, state_
+
+    tt_base = glt.distributed.DistDataset(
+        tp_, 0, tt_dg,
+        glt.distributed.DistFeature(tp_, tt_feats, tt_node_pb, tt_mesh,
+                                    split_ratio=0.2),
+        node_labels=tt_labels)
+    tt_cfg = dict(make_scenario=_topo_scenario, fanouts=[4, 2],
+                  batch_size=tt_batch, feat_dim=16, num_partitions=tp_,
+                  epoch_steps=tt_steps)
+    t0 = time.perf_counter()
+    topo_art = glt.tune(tt_base, tt_cfg, topology='dist',
+                        probe_steps=tt_steps)
+    result['dist_tune_wall_s'] = round(time.perf_counter() - t0, 3)
+    _tw = [e for e in topo_art.evidence if e.get('kind') == 'winner'][0]
+    tch = topo_art.choices
+    result['topology_tune_config'] = (
+        f"topology={tch['topology']} winner={_tw['name']} "
+        f"K={tch['chunk_k']} split={tch['split_ratio']} "
+        f"bucket_frac={tch['bucket_frac']} wire={tch['wire_dtype']} "
+        f"by {_tw['tie_break']}, "
+        f"fingerprint {topo_art.fingerprint[:12]}")
+    # trigger-to-publish latency through a LIVE scheduler: a manual
+    # drift probe flips, the shadow tune re-runs the same dist field,
+    # and the clock stops when publish_fn lands the fresh artifact
+    published = threading.Event()
+    tt_trig = [False]
+    sched = glt.tune.RetuneScheduler(
+        shadow_tune_fn=lambda: glt.tune(tt_base, tt_cfg,
+                                        topology='dist',
+                                        probe_steps=tt_steps),
+        publish_fn=lambda art: published.set(),
+        triggers={'bench_drift': lambda: tt_trig[0]},
+        initial=topo_art, poll_s=0.05)
+    sched.start()
+    try:
+      tt_trig[0] = True
+      t0 = time.perf_counter()
+      if not published.wait(timeout=300):
+        raise TimeoutError('retune did not publish within 300s '
+                           f'(last_error={sched.last_error})')
+      result['retune_trigger_to_publish_s'] = round(
+          time.perf_counter() - t0, 3)
+    finally:
+      tt_trig[0] = False
+      sched.stop()
+  except Exception as e:
+    result['topology_tune_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- RUN_MEAN_IMPL A/B (the prof_copytax.py decision, VERDICT r5):
   # emit both impls' e2e step ms as bench keys so the next on-chip run

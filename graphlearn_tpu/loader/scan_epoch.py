@@ -75,14 +75,26 @@ from .pipeline import (_RECOMPUTE_MSG, DistFusedEpochTrainer,
 
 
 def _resolve_tuned_config(trainer_name: str, dataset, chunk_size,
-                          config) -> int:
+                          config, topology: str = 'local') -> int:
   """Resolve the chunk size from an explicit value or a tune-artifact
   ``config=`` (graphlearn_tpu/tune/, docs/tuning.md). An artifact is
   validated against the loader's dataset BY FINGERPRINT — a tuned
   config on a drifted graph refuses loudly, the recovery-snapshot
-  refusal contract. Duck-typed (validate_dataset + trainer_kwargs) so
-  the loader package never imports tune/."""
+  refusal contract — and against the trainer's TOPOLOGY: a non-local
+  artifact only fits the scenario it was tuned for (a remote
+  block-stream assignment says nothing about a tiered exchange), while
+  a local artifact's knobs (chunk K, kernel routing) stay generically
+  acceptable everywhere. Duck-typed (validate_dataset +
+  trainer_kwargs) so the loader package never imports tune/."""
   if config is not None:
+    art_topo = getattr(config, 'topology', 'local') or 'local'
+    if art_topo not in ('local', topology):
+      raise ValueError(
+          f'{trainer_name}: tune artifact was tuned for topology '
+          f'{art_topo!r}, but this trainer runs the {topology!r} '
+          'scenario — per-topology knobs do not transfer; re-run '
+          f'graphlearn_tpu.tune(topology={topology!r}) '
+          '(docs/tuning.md "Topology candidates")')
     config.validate_dataset(dataset, where=trainer_name)
     if chunk_size is None:
       chunk_size = config.trainer_kwargs()['chunk_size']
@@ -134,6 +146,9 @@ class ScanTrainer(FusedEpochTrainer):
   """
 
   _NAME = 'ScanTrainer'
+  #: which tune() scenario this trainer runs — the config= topology
+  #: compatibility check (_resolve_tuned_config; docs/tuning.md)
+  _TOPOLOGY = 'local'
 
   # chunk-boundary staging hooks (storage/ subsystem, docs/storage.md;
   # recovery/ checkpointing, docs/recovery.md): ``stage_hook(
@@ -158,7 +173,8 @@ class ScanTrainer(FusedEpochTrainer):
     # docs/tuning.md): dataset-fingerprint-validated, supplies the
     # tuned chunk K when chunk_size is not given explicitly
     chunk_size = _resolve_tuned_config(self._NAME, loader.data,
-                                       chunk_size, config)
+                                       chunk_size, config,
+                                       topology=self._TOPOLOGY)
     if chunk_size < 1:
       raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
     self.chunk_size = int(chunk_size)
@@ -558,6 +574,7 @@ class DistScanTrainer(DistFusedEpochTrainer):
   """
 
   _NAME = 'DistScanTrainer'
+  _TOPOLOGY = 'dist'
 
   # chunk-boundary staging hooks — same contract as ScanTrainer's:
   # host-side callables around each chunk dispatch, the attachment
@@ -572,11 +589,12 @@ class DistScanTrainer(DistFusedEpochTrainer):
                perm_seed: Optional[int] = None, config=None):
     import jax
     super().__init__(loader, model, tx, num_classes, seed_labels_only)
-    # config= takes a tune artifact (docs/tuning.md); a DistDataset
-    # has no homogeneous fingerprint, so validation degrades to the
-    # artifact's warning path rather than a spurious refusal
+    # config= takes a tune artifact (docs/tuning.md): topology-checked
+    # ('dist' or a generic local artifact) and validated against the
+    # DistGraph's stacked-partition fingerprint (tune/artifact.py)
     chunk_size = _resolve_tuned_config(self._NAME, loader.data,
-                                       chunk_size, config)
+                                       chunk_size, config,
+                                       topology=self._TOPOLOGY)
     if chunk_size < 1:
       raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
     self.chunk_size = int(chunk_size)

@@ -117,6 +117,12 @@ REGISTERED_METRICS = frozenset({
     'tune.rejected',
     'tune.probe_ms',
     'tune.artifacts',
+    # continuous retuning (tune/retune.py, docs/tuning.md 'Continuous
+    # retuning'): drift-trigger fires, successful shadow-retune
+    # publishes, and the shadow replica's tune wall
+    'tune.retunes',
+    'tune.drift_triggers',
+    'tune.shadow_wall_ms',
     # run-as-a-program (loader/run_epoch.py): whole-run scans with
     # in-carry eval + early stop — host-side schedule counters only
     # (the stop point itself is device state, read from the report)
@@ -184,6 +190,9 @@ REGISTERED_SPANS = frozenset({
     # per candidate A/B (compile + steady epochs inside)
     'tune.run',
     'tune.candidate',
+    # continuous retuning (tune/retune.py): one span per shadow
+    # retune attempt, carrying the firing drift trigger in its attrs
+    'tune.retune',
     # run-as-a-program (loader/run_epoch.py): one span wrapping the
     # whole multi-epoch run; the inherited epoch.run/epoch.chunk spans
     # parent under it

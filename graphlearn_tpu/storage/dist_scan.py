@@ -108,12 +108,13 @@ class TieredDistScanTrainer(DistScanTrainer):
   """
 
   _NAME = 'TieredDistScanTrainer'
+  _TOPOLOGY = 'tiered_dist'
 
   def __init__(self, loader, model, tx, num_classes: int,
-               chunk_size: int = 32,
+               chunk_size: Optional[int] = None,
                seed_labels_only: Optional[bool] = None,
                perm_seed: Optional[int] = None, max_ahead: int = 2,
-               stage_timeout_s: float = 30.0):
+               stage_timeout_s: float = 30.0, config=None):
     sampler = getattr(loader, 'sampler', None)
     if sampler is not None and getattr(sampler, 'is_hetero', False):
       raise ValueError(
@@ -131,9 +132,25 @@ class TieredDistScanTrainer(DistScanTrainer):
           f'{self._NAME} needs TieredDistFeature(hot_prefix_rows >= 1) '
           '— the chunk program clamps pad positions into the hot '
           'prefix')
+    if config is not None:
+      # config= takes a tune artifact (docs/tuning.md 'Topology
+      # candidates'). hot_prefix_rows is a STORE-construction knob —
+      # the trainer cannot apply it after the fact, so a tuned value
+      # that disagrees with the store it is handed is a loud error,
+      # not a silent acceptance of untuned capacity
+      tuned_hot = (config.choices or {}).get('hot_prefix_rows') \
+          if hasattr(config, 'choices') else None
+      if tuned_hot is not None and \
+          int(tuned_hot) != int(store.hot_prefix_rows):
+        raise ValueError(
+            f'{self._NAME}: tune artifact pins hot_prefix_rows='
+            f'{int(tuned_hot)} but the TieredDistFeature store was '
+            f'built with hot_prefix_rows={int(store.hot_prefix_rows)} '
+            '— rebuild the store with the tuned value (the knob is '
+            'storage layout, not a trainer kwarg; docs/tuning.md)')
     self._store = store
     super().__init__(loader, model, tx, num_classes, chunk_size,
-                     seed_labels_only, perm_seed)
+                     seed_labels_only, perm_seed, config=config)
     self._stager = DistChunkStager(store, max_ahead=max_ahead,
                                    timeout_s=stage_timeout_s)
     self.last_plan = None   # ExchangePlan of the most recent epoch

@@ -8,14 +8,25 @@ lands on the fast path from one call, and a config that would retrace
 is rejected by construction.
 """
 from .artifact import (ARTIFACT_VERSION, KERNEL_CHOICE_DEFAULTS,
-                       KERNEL_CHOICE_KEYS, TuneArtifact,
+                       KERNEL_CHOICE_KEYS, TOPOLOGY_CHOICE_DEFAULTS,
+                       TOPOLOGY_CHOICE_KEYS, TuneArtifact,
                        apply_kernel_routing, dataset_fingerprint)
+from .retune import (RetuneScheduler, hit_rate_decay_probe,
+                     p99_creep_probe, retrace_overrun_probe)
+from .topology import (TOPOLOGY_KNOBS, TOPOLOGY_SITES,
+                       TopologyCandidate, default_topology_candidates,
+                       screen_candidate, tune_topology)
 from .tuner import (Candidate, default_candidates, kernel_candidates,
                     retrace_probe_candidate, score_candidate, tune)
 
 __all__ = [
     'ARTIFACT_VERSION', 'KERNEL_CHOICE_DEFAULTS', 'KERNEL_CHOICE_KEYS',
+    'TOPOLOGY_CHOICE_DEFAULTS', 'TOPOLOGY_CHOICE_KEYS',
     'TuneArtifact', 'apply_kernel_routing', 'dataset_fingerprint',
+    'RetuneScheduler', 'hit_rate_decay_probe', 'p99_creep_probe',
+    'retrace_overrun_probe',
+    'TOPOLOGY_KNOBS', 'TOPOLOGY_SITES', 'TopologyCandidate',
+    'default_topology_candidates', 'screen_candidate', 'tune_topology',
     'Candidate', 'default_candidates', 'kernel_candidates',
     'retrace_probe_candidate', 'score_candidate', 'tune',
 ]

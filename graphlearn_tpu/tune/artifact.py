@@ -32,8 +32,9 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 #: bump when the schema changes shape (loaders refuse unknown versions;
-#: version 1 — pre-kernel-routing — loads with the documented defaults)
-ARTIFACT_VERSION = 2
+#: versions 1/2 — pre-kernel-routing / pre-topology — load with the
+#: documented defaults via the per-version upgrade path below)
+ARTIFACT_VERSION = 3
 
 #: version 1's closed knob set — a v1 file is validated against THIS
 #: set (and its own version-1 fingerprint) before the upgrade path
@@ -62,9 +63,40 @@ KERNEL_CHOICE_DEFAULTS = {
     'fused_hop_window': 512,
 }
 
+#: version 2's closed knob set — v1 plus kernel routing; a v2 file is
+#: validated against THIS set (and its own version-2 fingerprint)
+#: before the upgrade path fills in the topology keys it predates
+_V2_CHOICE_KEYS = _V1_CHOICE_KEYS | KERNEL_CHOICE_KEYS
+
+#: the per-topology knobs added in schema version 3 (docs/tuning.md
+#: 'Topology candidates'): which trainer scenario the artifact was
+#: tuned FOR, plus the scenario knobs only that topology consumes
+#: (remote block streams, tiered hot prefix)
+TOPOLOGY_CHOICE_KEYS = frozenset({
+    'topology', 'hot_prefix_rows', 'block_ahead', 'block_wire_dtype',
+})
+
+#: defaults for a choices dict missing topology keys (hand-built, or a
+#: version-1/2 artifact on the upgrade path): a LOCAL artifact — the
+#: pre-v3 tuner only ever scored the homo local-scan path, so that is
+#: exactly what an upgraded file's choices were measured on
+TOPOLOGY_CHOICE_DEFAULTS = {
+    'topology': 'local', 'hot_prefix_rows': None, 'block_ahead': None,
+    'block_wire_dtype': None,
+}
+
 #: the knob set every artifact carries (docs/tuning.md knob table) —
 #: a choices dict is validated against this closed set on load
-CHOICE_KEYS = _V1_CHOICE_KEYS | KERNEL_CHOICE_KEYS
+CHOICE_KEYS = _V2_CHOICE_KEYS | TOPOLOGY_CHOICE_KEYS
+
+#: each schema version's own closed knob set — from_json validates a
+#: file against ITS version's set (and its own fingerprint) before any
+#: upgrade fills in the keys that version predates
+_VERSION_CHOICE_KEYS = {
+    1: _V1_CHOICE_KEYS,
+    2: _V2_CHOICE_KEYS,
+    3: CHOICE_KEYS,
+}
 
 
 def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
@@ -75,13 +107,36 @@ def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
   partition-only dist datasets) — validation then degrades to a
   warning, never a spurious refusal."""
   graph = getattr(dataset, 'graph', dataset)
-  if graph is None or isinstance(graph, dict):
+  if graph is None or isinstance(graph, dict) or \
+      getattr(graph, 'is_hetero', False):
     return None
   src = getattr(graph, 'topo', graph)
   indptr = getattr(src, 'indptr', None)
   if indptr is None:
     return None
   indptr = np.asarray(indptr, np.int64)
+  if indptr.ndim == 2:
+    # stacked sharded partitions (distributed DistGraph, [P, r_max+1]):
+    # fingerprint the per-shard degree sequences plus the partition
+    # book — the identity a dist/tiered topology artifact is tuned FOR
+    # (a repartition or a node-ownership change both shift the
+    # exchange volumes every dist knob was measured against)
+    deg = np.diff(indptr, axis=1)
+    fp = dict(
+        num_partitions=int(indptr.shape[0]),
+        degree_sha1=hashlib.sha1(
+            np.ascontiguousarray(deg).tobytes()).hexdigest()[:16])
+    node_pb = getattr(graph, 'node_pb', None)
+    if node_pb is not None:
+      node_pb = np.asarray(node_pb, np.int64)
+      fp['num_nodes'] = int(node_pb.shape[0])
+      fp['node_pb_sha1'] = hashlib.sha1(
+          np.ascontiguousarray(node_pb).tobytes()).hexdigest()[:16]
+    feats = getattr(dataset, 'node_features', None)
+    fdim = getattr(feats, 'feature_dim', None)
+    if fdim is not None:
+      fp['feature_dim'] = int(fdim)
+    return fp
   deg = np.diff(indptr)
   fp = dict(
       num_nodes=int(indptr.shape[0] - 1),
@@ -140,11 +195,19 @@ class TuneArtifact:
                        f'artifact knob set is closed (docs/tuning.md)')
     self.version = ARTIFACT_VERSION
     self.choices = dict(choices)
-    # kernel-routing keys are part of the closed v2 set: complete a
-    # partial dict with the documented kernels-off defaults so the
-    # fingerprint is a function of the FULL assignment
+    # kernel-routing and topology keys are part of the closed v3 set:
+    # complete a partial dict with the documented defaults (kernels
+    # off, local topology) so the fingerprint is a function of the
+    # FULL assignment
     for key, default in KERNEL_CHOICE_DEFAULTS.items():
       self.choices.setdefault(key, default)
+    for key, default in TOPOLOGY_CHOICE_DEFAULTS.items():
+      self.choices.setdefault(key, default)
+    topo = self.choices['topology']
+    if topo not in ('local', 'dist', 'remote', 'tiered_dist'):
+      raise ValueError(f'unknown topology {topo!r} — the artifact '
+                       "topology set is closed ('local', 'dist', "
+                       "'remote', 'tiered_dist'; docs/tuning.md)")
     self.dataset = dict(dataset) if dataset is not None else None
     self.evidence = list(evidence or [])
     self.fingerprint = compute_fingerprint(self.version, self.dataset,
@@ -160,25 +223,26 @@ class TuneArtifact:
   @classmethod
   def from_json(cls, obj: dict) -> 'TuneArtifact':
     v = obj.get('version')
-    if v not in (1, ARTIFACT_VERSION):
+    if v not in _VERSION_CHOICE_KEYS:
       raise ValueError(f'unsupported tune-artifact version {v!r} '
-                       f'(this build reads versions 1 and '
-                       f'{ARTIFACT_VERSION})')
+                       f'(this build reads versions '
+                       f'{sorted(_VERSION_CHOICE_KEYS)})')
     stored = obj.get('fingerprint')
-    if v == 1:
-      # pre-kernel-routing artifact: validate against ITS OWN closed
-      # knob set and version-1 fingerprint (the file must still be the
-      # tuner's, untouched), then upgrade — the kernel-routing keys it
-      # predates load as the documented defaults (kernels off,
-      # docs/tuning.md 'Artifact schema'), never as a refusal
+    if v < ARTIFACT_VERSION:
+      # older-schema artifact: validate against ITS OWN closed knob
+      # set and its own-version fingerprint (the file must still be
+      # the tuner's, untouched), then upgrade — the keys it predates
+      # load as the documented defaults (kernels off for v1, local
+      # topology for v1/v2; docs/tuning.md 'Artifact schema'), never
+      # as a refusal
       choices = dict(obj['choices'])
-      unknown = set(choices) - _V1_CHOICE_KEYS
+      unknown = set(choices) - _VERSION_CHOICE_KEYS[v]
       if unknown:
         raise ValueError(f'unknown choice keys {sorted(unknown)} — the '
-                         'version-1 artifact knob set is closed '
+                         f'version-{v} artifact knob set is closed '
                          '(docs/tuning.md)')
       if stored is not None:
-        expect = compute_fingerprint(1, obj.get('dataset'), choices)
+        expect = compute_fingerprint(v, obj.get('dataset'), choices)
         if stored != expect:
           raise ValueError(
               f'tune-artifact fingerprint mismatch: stored {stored}, '
@@ -187,10 +251,14 @@ class TuneArtifact:
               'a signed artifact (docs/tuning.md)')
       art = cls(choices, obj.get('dataset'), obj.get('evidence'))
       art.evidence.append(dict(
-          kind='schema_upgrade', from_version=1,
+          kind='schema_upgrade', from_version=v,
           to_version=ARTIFACT_VERSION,
-          note='pre-kernel-routing artifact: kernel choices defaulted '
-               'to off (docs/tuning.md)'))
+          note=('pre-kernel-routing artifact: kernel choices defaulted '
+                'to off, topology to local (docs/tuning.md)' if v == 1
+                else
+                'pre-topology artifact: topology defaulted to local — '
+                'the only scenario the v2 tuner scored '
+                '(docs/tuning.md)')))
       return art
     art = cls(obj['choices'], obj.get('dataset'),
               obj.get('evidence'))
@@ -279,6 +347,24 @@ class TuneArtifact:
     selection is an artifact choice, not an env var). Returns True
     when at least one store accepted the flags."""
     return apply_kernel_routing(target, self.kernel_kwargs())
+
+  @property
+  def topology(self) -> str:
+    """Which trainer scenario this artifact was tuned for ('local' /
+    'dist' / 'remote' / 'tiered_dist'). The ``config=`` acceptors
+    refuse a mismatched non-local topology — a remote block-stream
+    assignment says nothing about a tiered exchange (docs/tuning.md
+    'Topology candidates')."""
+    return self.choices.get('topology') or 'local'
+
+  def topology_kwargs(self) -> dict:
+    """The tuned scenario knobs only this artifact's topology consumes
+    (TOPOLOGY_CHOICE_KEYS minus the topology tag itself), Nones
+    dropped: ``block_ahead``/``block_wire_dtype`` for remote block
+    streams, ``hot_prefix_rows`` for the tiered exchange."""
+    out = {k: self.choices.get(k)
+           for k in TOPOLOGY_CHOICE_KEYS if k != 'topology'}
+    return {k: v for k, v in out.items() if v is not None}
 
   def trainer_kwargs(self) -> dict:
     """Scan-trainer kwargs (chunk K); the trainers also re-validate the

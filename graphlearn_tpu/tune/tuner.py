@@ -107,7 +107,8 @@ class Candidate:
 def retrace_probe_candidate(base: Candidate) -> Candidate:
   """A deliberately retracing copy of ``base`` — the live-fire check
   that the observatory scoring actually rejects a retracing config
-  (tests/test_tune.py; docs/tuning.md 'Scoring rule')."""
+  (tests/test_tune.py; docs/tuning.md 'The observatory scoring
+  rule')."""
   return Candidate(f'{base.name}+retrace_probe', base.loader_kwargs,
                    chunk_k=base.chunk_k,
                    exact_semantics=base.exact_semantics,
@@ -170,6 +171,46 @@ def default_candidates(caps: List[int], exact: bool,
   if kernels:
     cands.extend(kernel_candidates(base))
   return cands
+
+
+def _check_homo(dataset, where: str):
+  """The documented hetero error path (docs/tuning.md 'Scope and
+  hetero datasets'): a
+  hetero dataset has no homogeneous fingerprint and no homo scan
+  trainer to A/B, so tuning one must refuse LOUDLY — the silent
+  degrade-to-warning path let a hetero artifact ship without a typed
+  identity (ROADMAP item 3 edge)."""
+  graph = getattr(dataset, 'graph', dataset)
+  if isinstance(graph, dict) or getattr(graph, 'is_hetero', False) or \
+      isinstance(getattr(dataset, 'node_features', None), dict):
+    raise TypeError(
+        f'{where} is homogeneous-only: hetero datasets have no typed '
+        'dataset fingerprint, so a hetero artifact could never be '
+        'validated on load. Tune the homo projection of each edge '
+        'type, or keep hand-picked knobs for hetero scenarios '
+        '(docs/tuning.md "Scope and hetero datasets")')
+
+
+def _refuse_padded_candidates(cands: Sequence[Candidate]):
+  """PR 15 residual (b), resolved as a loud refusal: a padded-window
+  config cannot ride the whole-run program stream — the per-epoch
+  padded-table reseed is a HOST-side adjacency rebuild
+  (NodeLoader._begin_epoch), which RunTrainer refuses for exactly that
+  reason (loader/run_epoch.py). An artifact tune() signed with
+  padded_window set would therefore be accepted by the per-epoch
+  trainers but refused by RunTrainer — a split this error documents
+  instead of leaving silent."""
+  bad = [c.name for c in cands
+         if c.loader_kwargs.get('padded_window') is not None]
+  if bad:
+    raise ValueError(
+        f'tune(): padded-window candidates {bad} are not tunable — '
+        'the per-epoch padded-table reseed is a host-side adjacency '
+        'rebuild that cannot fold into the whole-run program stream, '
+        'so RunTrainer(config=) would refuse the resulting artifact '
+        '(loader/run_epoch.py). Drop padded_window from the candidate '
+        'field, or hand-tune it for per-epoch ScanTrainer use only '
+        '(docs/tuning.md "Padded windows")')
 
 
 def _norm_cfg(loader_cfg: Dict) -> Dict:
@@ -340,18 +381,31 @@ def _pick_winner(records: List[dict]) -> dict:
   return best
 
 
-def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
+def tune(dataset, loader_cfg: Dict, *, topology: str = 'local',
+         exact: bool = False,
          candidates: Optional[Sequence[Candidate]] = None,
          probe_steps: Optional[int] = None, model=None, tx=None,
          num_probes: int = 8, seed: int = 0,
+         budget_s: Optional[float] = None,
          out_path: Optional[str] = None) -> TuneArtifact:
   """One call from a dataset + loader shape to a validated config
   artifact (module docstring; docs/tuning.md has the quickstart).
 
   Args:
-    dataset: a homogeneous ``data.Dataset`` with features + labels.
+    dataset: a homogeneous ``data.Dataset`` with features + labels
+      (for distributed topologies: the scenario's dataset — used for
+      the artifact fingerprint; the scenarios themselves come from
+      ``loader_cfg['make_scenario']``).
     loader_cfg: dict with ``fanouts``, ``input_nodes``, ``batch_size``
-      (+ optional shuffle / drop_last / seed / num_classes).
+      (+ optional shuffle / drop_last / seed / num_classes). For
+      ``topology != 'local'`` see :func:`tune.topology.tune_topology`
+      (``make_scenario``, analytics inputs, quotas).
+    topology: which trainer scenario to field candidates for —
+      ``'local'`` (homo ScanTrainer, the default), ``'dist'``
+      (DistScanTrainer), ``'remote'`` (RemoteScanTrainer), or
+      ``'tiered_dist'`` (TieredDistScanTrainer). One artifact per
+      topology; the matching trainer's ``config=`` accepts it and a
+      mismatched one refuses (docs/tuning.md 'Topology candidates').
     exact: pin the exact-semantics set (calibrated exact dedup, f32
       wire); default also fields the accuracy-matrix-certified
       relaxations (tree dedup, bf16 wire).
@@ -366,8 +420,20 @@ def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
       so a proxy model suffices; pass the real one to rank on its
       true wall).
     num_probes / seed: calibration probe controls (calibrate.py).
+    budget_s: explicit wall-clock budget for the candidate A/Bs —
+      after the first candidate is scored, the remaining ladder is
+      truncated to what the budget affords at that measured
+      per-candidate wall, with a ``kind='budget'`` evidence record
+      naming what was dropped (docs/tuning.md 'Budgeted tuning').
     out_path: also save the artifact JSON there.
   """
+  if topology != 'local':
+    from .topology import tune_topology
+    return tune_topology(topology, dataset, loader_cfg, exact=exact,
+                         candidates=candidates,
+                         probe_steps=probe_steps, budget_s=budget_s,
+                         out_path=out_path)
+  _check_homo(dataset, 'tune()')
   cfg = _norm_cfg(loader_cfg)
   num_classes = _num_classes(dataset, cfg)
   evidence: List[dict] = []
@@ -396,6 +462,7 @@ def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
 
     cands = list(candidates) if candidates is not None \
         else default_candidates(caps, exact)
+    _refuse_padded_candidates(cands)
     if exact:
       dropped = [c.name for c in cands if not c.exact_semantics]
       cands = [c for c in cands if c.exact_semantics]
@@ -403,9 +470,21 @@ def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
         evidence.append(dict(
             kind='exact_pin', dropped_candidates=dropped,
             note='exact=True pins the accuracy-matrix exact set'))
-    records = [score_candidate(c, dataset, cfg, num_classes, chunk_k,
-                               probe_steps, model=model, tx=tx)
-               for c in cands]
+    records = []
+    pending = list(cands)
+    while pending:
+      cand = pending.pop(0)
+      records.append(score_candidate(cand, dataset, cfg, num_classes,
+                                     chunk_k, probe_steps, model=model,
+                                     tx=tx))
+      if budget_s is not None and len(records) == 1 and pending:
+        # tune-the-tuner: the first candidate's measured wall prices
+        # the ladder; keep what the explicit budget affords and say
+        # out loud what was never fielded (topology.py._budget_ladder)
+        from .topology import _budget_ladder
+        pending, ev = _budget_ladder(records, pending, budget_s,
+                                     records[0].get('wall_s') or 0.0)
+        evidence.append(ev)
     evidence.extend(records)
     best = _pick_winner(records)
     kern = dict(KERNEL_CHOICE_DEFAULTS)
@@ -432,7 +511,18 @@ def tune(dataset, loader_cfg: Dict, *, exact: bool = False,
         fanouts=list(cfg['fanouts']),
         exact=bool(exact))
     choices.update(kern)
-    art = TuneArtifact(choices, dataset_fingerprint(dataset), evidence)
+    fp = dataset_fingerprint(dataset)
+    if fp is None:
+      # structured fingerprint-gap record: a dataset with no
+      # computable identity is a recorded fact in the artifact, not a
+      # silent one — config= acceptors will warn instead of validating
+      evidence.append(dict(
+          kind='fingerprint_gap', topology='local',
+          dataset_type=type(dataset).__name__,
+          note='dataset has no computable fingerprint — config= '
+               'acceptors will warn instead of validating '
+               '(docs/tuning.md "Fingerprints")'))
+    art = TuneArtifact(choices, fp, evidence)
   metrics.inc('tune.artifacts')
   if out_path is not None:
     art.save(out_path)

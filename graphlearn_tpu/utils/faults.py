@@ -72,6 +72,7 @@ REGISTERED_SITES = frozenset({
     'tenant.admit',
     'tenant.throttle',
     'tenant.reap',
+    'tune.shadow_retune',
 })
 
 

@@ -1,6 +1,7 @@
 """Loader tests, mirroring the reference's loader coverage
 (test_link_loader.py, neighbor loader paths in test_neighbor_sampler.py)."""
 import numpy as np
+import pytest
 
 import graphlearn_tpu as glt
 
@@ -392,6 +393,8 @@ def test_frontier_caps_auto_link_loader():
   assert steps == len(loader)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): overlapped variant of the
+# overflow guard — test_scan_trainer_overflow_guard stays tier-1
 def test_overlapped_trainer_overflow_guard():
   """OverlappedTrainer enforces the calibrated-caps guard: the flag
   accumulates on device through the fused program and the loader's
@@ -511,6 +514,9 @@ def test_overflow_guard_edges():
   assert loader._ovf_accum is None
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): loader-layer hetero-caps
+# policies — the sampler-layer structure/overflow test and the dist
+# hetero-caps test stay tier-1 as the family reps
 def test_hetero_loader_calibrated_caps_policies():
   """Hetero NeighborLoader under dict-form calibrated caps: quiet epoch
   with calibrated caps under the default raise policy; tiny caps raise

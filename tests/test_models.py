@@ -342,6 +342,8 @@ def make_hetero_cluster():
   return ds, (CITES, WRITES), n_p
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): HGT training e2e — the HGT
+# equivalence tests (merge-dense, hierarchical) stay tier-1
 def test_hgt_end_to_end():
   import jax
   import jax.numpy as jnp

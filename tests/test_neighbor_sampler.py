@@ -530,6 +530,8 @@ def test_estimate_hetero_frontier_caps_shrinks_plan():
   assert sum(cal.values()) < 0.7 * sum(full.values())
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): worst-case-caps variant of
+# test_hetero_calibrated_caps_structure_and_overflow, which stays
 def test_hetero_caps_at_worst_case_are_byte_identical():
   """Caps set exactly to the worst-case widths make the clamped engine a
   structural no-op: byte-identical output to the uncapped sampler (same

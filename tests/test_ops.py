@@ -6,6 +6,7 @@ test_hash_table.cu): structure assertions (degree caps, membership, reindex
 consistency), not exact samples, since sampling is seeded-random.
 """
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -562,6 +563,8 @@ def test_sample_hop_fused_interpret_parity():
               np.asarray(a), np.asarray(b)), (window, k, what)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): counter-stream variant of
+# test_sample_hop_fused_interpret_parity, which stays tier-1
 def test_sample_hop_fused_stream_matches_sampler_counters():
   """Same fold_in counters -> identical edges: a NeighborSampler with
   use_fused_hop='interpret' (kernel exercised through the Pallas

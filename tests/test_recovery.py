@@ -381,6 +381,9 @@ def test_resume_config_mismatch_refuses(scan_ref, tmp_path):
 # ----------------------------------------------------- chaos: save/restore
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): save-fault variant of the
+# crash-resume family — scan/dist crash-resume + the failed-resume
+# double-crash test stay tier-1
 def test_save_fault_degrades_to_sync_bit_identical(scan_ref, tmp_path):
   """Tier-1 chaos rep: an armed recovery.save fault kills the FIRST
   async write — the checkpointer degrades to synchronous boundary

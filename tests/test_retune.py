@@ -1,0 +1,294 @@
+"""RetuneScheduler: drift-driven continuous retuning (tune/retune.py,
+docs/tuning.md 'Continuous retuning').
+
+The contracts under test:
+
+* **Drift-trigger matrix** — each stock probe (retrace-budget overrun,
+  feature-cache hit-rate decay, serving p99 creep) fires exactly ONCE
+  per sustained condition (edge latch), re-arms on the falling edge,
+  and a RAISING probe counts as not-drifted.
+* **Shadow-replica failure semantics** — a failed or chaos-crashed
+  shadow retune (the ``tune.shadow_retune`` fault) leaves the
+  previously published artifact pinned BIT-IDENTICALLY, re-arms the
+  firing trigger for retry, and never calls publish_fn.
+* **End-to-end under live traffic** — a daemon scheduler watching a
+  real drift signal publishes a fresh artifact without interrupting
+  the serving stream, and `stop()` join-semantics hold.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu import metrics as glt_metrics
+from graphlearn_tpu.tune import (RetuneScheduler, TuneArtifact,
+                                 hit_rate_decay_probe, p99_creep_probe,
+                                 retrace_overrun_probe)
+from graphlearn_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  faults.disarm()
+  # probes capture metric objects at creation: drop any state earlier
+  # tests left on the shared drift-signal names BEFORE building probes
+  reg = glt_metrics.default_registry()
+  for prefix in ('serving.total_ms', 'dist_feature.',
+                 'program.retrace_budget_exceeded'):
+    reg.reset(prefix)
+  yield
+  faults.disarm()
+
+
+def _artifact(chunk_k=4):
+  return TuneArtifact(dict(
+      mode='map', frontier_caps=None, padded_window=None,
+      wire_dtype=None, chunk_k=chunk_k, split_ratio=0.0,
+      bucket_frac=None, slab_cap=None, serving_buckets=None,
+      batch_size=4, fanouts=[2, 2], exact=False))
+
+
+def _scheduler(shadow=None, publish=None, triggers=None, **kw):
+  pubs = []
+  return RetuneScheduler(
+      shadow_tune_fn=shadow or (lambda: _artifact(chunk_k=8)),
+      publish_fn=publish or pubs.append,
+      triggers=triggers or {'t': lambda: False}, **kw), pubs
+
+
+# ------------------------------------------------------- trigger matrix
+
+
+def test_retrace_overrun_probe_fires_on_advance():
+  """Drifted exactly when the budget-overrun counter ADVANCED since
+  the last poll — steady counter reads are not drift."""
+  probe = retrace_overrun_probe()
+  assert probe() is False
+  glt_metrics.inc('program.retrace_budget_exceeded')
+  assert probe() is True
+  assert probe() is False          # no further advance
+  glt_metrics.inc('program.retrace_budget_exceeded')
+  glt_metrics.inc('program.retrace_budget_exceeded')
+  assert probe() is True
+
+
+def test_hit_rate_decay_probe_windows_since_last_poll():
+  """The hit rate is computed over the DELTA window, so an old healthy
+  epoch cannot mask a fresh decay (and an empty window is not drift)."""
+  probe = hit_rate_decay_probe(floor=0.5)
+  assert probe() is False          # empty delta window
+  for _ in range(9):
+    glt_metrics.inc('dist_feature.hits')
+  glt_metrics.inc('dist_feature.misses')
+  assert probe() is False          # 90% hits: healthy
+  for _ in range(9):
+    glt_metrics.inc('dist_feature.misses')
+  glt_metrics.inc('dist_feature.hits')
+  assert probe() is True           # 10% hits in THIS window
+  assert probe() is False          # empty window again
+
+
+def test_p99_creep_probe_needs_min_count():
+  probe = p99_creep_probe(limit_ms=50.0, min_count=4)
+  assert probe() is False          # empty histogram is not evidence
+  glt_metrics.observe('serving.total_ms', 500.0)
+  assert probe() is False          # an under-sampled histogram is not
+  for _ in range(3):               # evidence, whatever its p99 says
+    glt_metrics.observe('serving.total_ms', 500.0)
+  assert probe() is True
+  for _ in range(400):
+    glt_metrics.observe('serving.total_ms', 1.0)
+  assert probe() is False          # p99 recovered: falling edge
+
+
+def test_triggers_edge_latched_once_per_sustained_condition():
+  """The matrix contract: a condition held across many polls fires ONE
+  retune; a falling edge re-arms; the next rising edge fires again —
+  for every trigger independently."""
+  level = {'a': False, 'b': False}
+  sched, pubs = _scheduler(
+      triggers={'a': lambda: level['a'], 'b': lambda: level['b']})
+  assert sched._fired() is None
+  level['a'] = True
+  assert sched._fired() == 'a'                  # rising edge fires
+  for _ in range(5):
+    assert sched._fired() is None               # sustained: latched
+  level['b'] = True
+  assert sched._fired() == 'b'                  # independent latch
+  level['a'] = False
+  assert sched._fired() is None                 # falling edge re-arms a
+  level['a'] = True
+  assert sched._fired() == 'a'                  # fires again
+  assert sched._fired() is None
+
+
+def test_raising_probe_is_not_drifted():
+  """An observability hook must never take the path down: a probe that
+  raises is logged and treated as not-drifted, and a healthy sibling
+  trigger still fires."""
+  def broken():
+    raise RuntimeError('probe exploded')
+  level = [False]
+  sched, _ = _scheduler(triggers={'broken': broken,
+                                  'ok': lambda: level[0]})
+  assert sched._fired() is None
+  level[0] = True
+  assert sched._fired() == 'ok'
+
+
+# ------------------------------------------- failure + chaos semantics
+
+
+def test_failed_shadow_retune_keeps_previous_and_rearms():
+  """A shadow tune that raises: previous artifact stays current
+  BIT-IDENTICALLY, publish_fn is never called, the firing trigger
+  re-arms for retry, and the failure is counted."""
+  prev = _artifact()
+  prev_json = json.dumps(prev.to_json(), sort_keys=True)
+  calls = []
+
+  def failing_shadow():
+    calls.append(1)
+    raise RuntimeError('replica out of memory')
+
+  level = [True]
+  sched, pubs = _scheduler(shadow=failing_shadow,
+                           triggers={'d': lambda: level[0]},
+                           initial=prev)
+  c_trig = glt_metrics.counter('tune.drift_triggers').value
+  c_ret = glt_metrics.counter('tune.retunes').value
+  for _ in range(3):               # still-drifted condition retries
+    t = sched._fired()
+    if t is not None:
+      sched._attempt(t)
+  assert len(calls) == 3           # re-armed after each failure
+  assert pubs == []                # publish_fn never saw an artifact
+  assert sched.current is prev
+  assert json.dumps(sched.current.to_json(), sort_keys=True) == prev_json
+  assert sched.failures == 3 and sched.retunes == 0
+  assert 'replica out of memory' in sched.last_error
+  assert glt_metrics.counter('tune.drift_triggers').value == c_trig + 3
+  assert glt_metrics.counter('tune.retunes').value == c_ret
+
+
+def test_chaos_killed_shadow_retune_pins_previous_config():
+  """The chaos rep: the ``tune.shadow_retune`` fault crashes the
+  attempt BEFORE the shadow tune runs — the previously published
+  artifact keeps serving bit-identically and the shadow tune is never
+  even entered; disarming lets the retry publish."""
+  prev = _artifact()
+  prev_json = json.dumps(prev.to_json(), sort_keys=True)
+  fresh = _artifact(chunk_k=16)
+  shadow_calls = []
+
+  def shadow():
+    shadow_calls.append(1)
+    return fresh
+
+  level = [True]
+  sched, pubs = _scheduler(shadow=shadow,
+                           triggers={'d': lambda: level[0]},
+                           initial=prev)
+  with faults.injected('tune.shadow_retune'):
+    t = sched._fired()
+    assert t == 'd'
+    sched._attempt(t)
+    assert faults.stats('tune.shadow_retune')[1] == 1  # fault fired
+  assert shadow_calls == [] and pubs == []
+  assert sched.current is prev
+  assert json.dumps(sched.current.to_json(), sort_keys=True) == prev_json
+  assert sched.failures == 1
+  # disarmed + still drifted: the re-armed trigger retries and publishes
+  t = sched._fired()
+  assert t == 'd'
+  sched._attempt(t)
+  assert pubs == [fresh] and sched.current is fresh
+  assert sched.retunes == 1 and sched.last_trigger == 'd'
+
+
+def test_failed_publish_keeps_previous_config():
+  """publish_fn raising is the same contract as the build failing: the
+  fresh artifact is NOT adopted (current stays previous) — a
+  half-published config must not become the scheduler's truth."""
+  prev, fresh = _artifact(), _artifact(chunk_k=16)
+
+  def bad_publish(art):
+    raise IOError('config store unreachable')
+
+  sched, _ = _scheduler(shadow=lambda: fresh, publish=bad_publish,
+                        triggers={'d': lambda: True}, initial=prev)
+  sched._attempt(sched._fired())
+  assert sched.current is prev and sched.failures == 1
+
+
+def test_scheduler_requires_triggers_and_stop_is_idempotent():
+  with pytest.raises(ValueError, match='at least one drift trigger'):
+    RetuneScheduler(lambda: None, lambda a: None, triggers={})
+  sched, _ = _scheduler()
+  sched.stop()                     # never started: a no-op join
+  assert sched._thread is None
+
+
+# ----------------------------------------------- end-to-end, live daemon
+
+
+def test_retune_daemon_end_to_end_under_live_traffic():
+  """A live scheduler: induced drift on a REAL signal (the serving p99
+  histogram) -> shadow retune on the daemon thread -> publish through
+  the caller's config= path, while the 'serving' side keeps reading
+  the current config uninterrupted (zero failed reads). Then
+  retune_now() forces a second publish without any drift."""
+  prev = _artifact()
+  fresh = _artifact(chunk_k=16)
+  published = threading.Event()
+  serving_errors = []
+
+  class ConfigStore:
+    def __init__(self, art):
+      self.art = art
+    def publish(self, art):
+      # the fingerprint-validated path a real deployment rebuilds
+      # trainers through; here the swap itself is the contract
+      assert art.fingerprint == TuneArtifact.from_json(
+          art.to_json()).fingerprint
+      self.art = art
+      published.set()
+
+  store = ConfigStore(prev)
+  c_wall = glt_metrics.histogram('tune.shadow_wall_ms').count
+  sched = RetuneScheduler(
+      shadow_tune_fn=lambda: fresh, publish_fn=store.publish,
+      triggers={'p99': p99_creep_probe(limit_ms=50.0, min_count=1)},
+      initial=prev, poll_s=0.02)
+  sched.start()
+  try:
+    deadline = time.monotonic() + 30.0
+    induced = False
+    while not published.is_set() and time.monotonic() < deadline:
+      # live traffic: every tick serves a read off the current config
+      try:
+        assert store.art.choices['chunk_k'] in (4, 16)
+      except Exception as e:  # noqa: BLE001 - the zero-failed-reads gate
+        serving_errors.append(e)
+      if not induced:
+        glt_metrics.observe('serving.total_ms', 500.0)   # induce drift
+        induced = True
+      time.sleep(0.01)
+    assert published.is_set(), 'drift never published a retune'
+    assert store.art is fresh and sched.current is fresh
+    assert serving_errors == []
+    assert sched.retunes == 1 and sched.last_trigger == 'p99'
+    assert glt_metrics.histogram('tune.shadow_wall_ms').count > c_wall
+    # forced path: no drift needed, same publish machinery
+    published.clear()
+    sched.retune_now()
+    deadline = time.monotonic() + 30.0
+    while not published.is_set() and time.monotonic() < deadline:
+      time.sleep(0.01)
+    assert published.is_set() and sched.retunes == 2
+  finally:
+    sched.stop()
+  assert sched._thread is None

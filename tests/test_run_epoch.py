@@ -143,6 +143,8 @@ def test_run_trainer_early_stop_in_carry():
   assert not bool(jax.device_get(t2.last_run_report)['stopped'])
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): RunTrainer variant of the
+# crash-resume family — the scan and dist reps stay tier-1
 def test_run_trainer_crash_resume_across_epoch_boundary(tmp_path):
   """ChunkCheckpointer rides the inherited ack_hook seam unchanged: a
   crash after chunk 2 (global step 8 — INSIDE epoch 2) resumes in a

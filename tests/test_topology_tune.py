@@ -1,0 +1,544 @@
+"""Topology-wide tune(): dist / remote / tiered candidate fields
+(tune/topology.py, docs/tuning.md 'Topology candidates').
+
+The contracts under test, per topology:
+
+* the tune emits ONE fingerprint-validated per-topology artifact with
+  ZERO steady-state compiles across every qualified candidate (the
+  observatory scoring rule, unchanged from the local path);
+* the MATCHING trainer accepts the artifact via ``config=`` and its
+  epoch is bit-identical to hand-applying the winner's knobs; a
+  mismatched non-local topology is refused loudly, while a 'local'
+  artifact transfers generically;
+* the feasibility screen rejects quota-busting candidates WITH the
+  analytic volumes, before any device work;
+* the loud error paths: padded-window candidates (the RunTrainer
+  split), hetero datasets (no typed fingerprint), unknown knobs;
+* the budget ladder (tune-the-tuner) truncates loudly, and a v2
+  pre-topology artifact upgrades to topology='local'.
+"""
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+import graphlearn_tpu as glt
+from graphlearn_tpu import metrics as glt_metrics
+from graphlearn_tpu.models import train as train_lib
+from graphlearn_tpu.tune import (TopologyCandidate, TuneArtifact,
+                                 default_topology_candidates,
+                                 screen_candidate)
+from graphlearn_tpu.tune.topology import TOPOLOGY_KNOBS
+from graphlearn_tpu.typing import GraphPartitionData
+
+N = 40
+NUM_PARTS = 2
+BATCH = 2
+STEPS = 4
+FANOUTS = [2, 2]
+CLASSES = 3
+
+
+def ring_fixture():
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  eids = np.arange(2 * N)
+  node_pb = (np.arange(N) % NUM_PARTS).astype(np.int32)
+  edge_pb = node_pb[rows]
+  parts, feats = [], []
+  for p in range(NUM_PARTS):
+    m = edge_pb == p
+    parts.append(GraphPartitionData(
+        edge_index=np.stack([rows[m], cols[m]]), eids=eids[m]))
+    ids = np.nonzero(node_pb == p)[0]
+    feats.append((ids.astype(np.int64),
+                  ids[:, None].astype(np.float32) * np.ones((1, 4),
+                                                            np.float32)))
+  return parts, feats, node_pb, edge_pb
+
+
+def _mesh():
+  import jax
+  from jax.sharding import Mesh
+  return Mesh(np.array(jax.devices()[:NUM_PARTS]), ('g',))
+
+
+def make_model_tx():
+  import optax
+  return (glt.models.GraphSAGE(hidden_dim=8, out_dim=CLASSES,
+                               num_layers=2),
+          optax.adam(1e-2))
+
+
+def _seeds():
+  return np.arange(NUM_PARTS * BATCH * STEPS)
+
+
+def _dist_pieces(knobs, tiered=False):
+  """One freshly built dist scenario store for a candidate's knobs —
+  the make_scenario contract: the marquee dist knobs are
+  store-construction parameters, so every candidate rebuilds the
+  feature store."""
+  import jax.numpy as jnp
+  parts, feats, node_pb, edge_pb = ring_fixture()
+  mesh = _mesh()
+  dg = glt.distributed.DistGraph(NUM_PARTS, 0, parts, node_pb, edge_pb)
+  wire = jnp.bfloat16 if knobs.get('wire_dtype') == 'bf16' else None
+  if tiered:
+    from graphlearn_tpu.storage import TieredDistFeature
+    df = TieredDistFeature(
+        NUM_PARTS, feats, node_pb, mesh=mesh,
+        spill_dir=tempfile.mkdtemp(prefix='glt_topo_tune_'),
+        hot_prefix_rows=int(knobs['hot_prefix_rows']),
+        split_ratio=knobs.get('split_ratio') or 0.25)
+  else:
+    df = glt.distributed.DistFeature(
+        NUM_PARTS, feats, node_pb, mesh,
+        split_ratio=knobs.get('split_ratio') or 0.0,
+        wire_dtype=wire, bucket_frac=knobs.get('bucket_frac'))
+  ds = glt.distributed.DistDataset(NUM_PARTS, 0, dg, df,
+                                   node_labels=np.arange(N) % CLASSES)
+  loader = glt.distributed.DistNeighborLoader(
+      ds, FANOUTS, _seeds(), batch_size=BATCH, seed=0, mesh=mesh,
+      shuffle=False, drop_last=True)
+  return ds, loader
+
+
+def _dist_state(model, tx, loader):
+  """Fresh params + opt state from the loader's first (template)
+  batch. NOTE: consuming the template advances the loader's epoch
+  stream — every bit-identity arm must consume exactly one."""
+  import jax
+  import jax.numpy as jnp
+  first = next(iter(loader))
+  params = model.init(jax.random.PRNGKey(0), np.asarray(first.x)[0],
+                      np.asarray(first.edge_index)[0],
+                      np.asarray(first.edge_mask)[0])
+  return train_lib.TrainState(params, tx.init(params),
+                              jnp.zeros((), jnp.int32))
+
+
+def _dist_cfg(model, tx, **kw):
+  def make_scenario(knobs, chunk_k):
+    _, loader = _dist_pieces(knobs)
+    state = _dist_state(model, tx, loader)
+    trainer = glt.loader.DistScanTrainer(loader, model, tx, CLASSES,
+                                         chunk_size=chunk_k)
+    return trainer, state
+  cfg = dict(make_scenario=make_scenario, fanouts=FANOUTS,
+             batch_size=BATCH, feat_dim=4, num_partitions=NUM_PARTS,
+             epoch_steps=NUM_PARTS * STEPS)
+  cfg.update(kw)
+  return cfg
+
+
+def _base_dataset():
+  ds, _ = _dist_pieces(dict(split_ratio=0.25))
+  return ds
+
+
+# ------------------------------------------------------------- dist e2e
+
+
+def test_dist_topology_tune_end_to_end_and_config_accept(tmp_path):
+  """The dist acceptance gate: tune(topology='dist') fields the stock
+  candidates as freshly built scenarios, every qualified candidate's
+  steady epoch compiled NOTHING, the artifact roundtrips, and the
+  DistScanTrainer accepts it via config= with an epoch bit-identical
+  to hand-applying the winner's knobs."""
+  model, tx = make_model_tx()
+  base = _base_dataset()
+  path = str(tmp_path / 'dist.json')
+  art = glt.tune(base, _dist_cfg(model, tx), topology='dist',
+                 probe_steps=STEPS, out_path=path)
+  assert art.topology == 'dist'
+  assert art.choices['topology'] == 'dist'
+  assert art.dataset is not None          # stacked-partition fingerprint
+  assert art.dataset['num_partitions'] == NUM_PARTS
+  cands = [e for e in art.evidence if e.get('kind') == 'candidate']
+  assert len(cands) == 3                  # fullwidth, bucketed, bf16
+  for c in cands:
+    assert c['qualified'], c
+    assert sum(c['steady_epoch_compiles'].values()) == 0, c
+    assert set(c['steady_epoch_compiles']) == {
+        'dist_epoch_seeds', 'dist_scan_chunk', 'dist_metrics_concat'}
+  loaded = TuneArtifact.load(path)
+  assert loaded.fingerprint == art.fingerprint
+
+  # config= acceptance, bit-identical to the hand-applied winner (both
+  # arms consume ONE template batch and use fresh PRNGKey(0) params)
+  winner = [e for e in art.evidence if e.get('kind') == 'winner'][0]
+  k = int(art.choices['chunk_k'])
+  _, hand_loader = _dist_pieces(winner['knobs'])
+  hand_state = _dist_state(model, tx, hand_loader)
+  hand_tr = glt.loader.DistScanTrainer(hand_loader, model, tx, CLASSES,
+                                       chunk_size=k)
+  _, cfg_loader = _dist_pieces(winner['knobs'])
+  cfg_state = _dist_state(model, tx, cfg_loader)
+  cfg_tr = glt.loader.DistScanTrainer(cfg_loader, model, tx, CLASSES,
+                                      config=loaded)
+  assert cfg_tr.chunk_size == k           # chunk K rode the artifact
+  _, l_hand, _ = hand_tr.run_epoch(hand_state, max_steps=STEPS)
+  _, l_cfg, _ = cfg_tr.run_epoch(cfg_state, max_steps=STEPS)
+  np.testing.assert_array_equal(np.asarray(l_hand), np.asarray(l_cfg))
+
+
+def test_topology_compat_matrix():
+  """A non-local artifact is accepted ONLY by the matching trainer; a
+  'local' artifact transfers generically (chunk K + kernel routing are
+  topology-free)."""
+  from graphlearn_tpu.loader.scan_epoch import _resolve_tuned_config
+  base = dict(mode='map', chunk_k=4, batch_size=BATCH, fanouts=FANOUTS,
+              exact=False, frontier_caps=None, padded_window=None,
+              wire_dtype=None, split_ratio=None, bucket_frac=None,
+              slab_cap=None, serving_buckets=None)
+  dist_art = TuneArtifact(dict(base, topology='dist'))
+  local_art = TuneArtifact(dict(base))
+  remote_art = TuneArtifact(dict(base, topology='remote',
+                                 block_ahead=1))
+  # matching accepts; generic local accepts everywhere
+  assert _resolve_tuned_config('DistScanTrainer', None, None, dist_art,
+                               topology='dist') == 4
+  for topo in ('local', 'dist', 'tiered_dist'):
+    assert _resolve_tuned_config('T', None, None, local_art,
+                                 topology=topo) == 4
+  # mismatches refuse loudly, naming both topologies
+  with pytest.raises(ValueError, match="tuned for topology 'dist'"):
+    _resolve_tuned_config('ScanTrainer', None, None, dist_art,
+                          topology='local')
+  with pytest.raises(ValueError, match="tuned for topology 'remote'"):
+    _resolve_tuned_config('DistScanTrainer', None, None, remote_art,
+                          topology='dist')
+  # the remote resolver mirrors the matrix from the client side
+  from graphlearn_tpu.distributed.remote_scan import _resolve_remote_config
+  assert _resolve_remote_config('RemoteScanTrainer', remote_art,
+                                FANOUTS, BATCH) == {'block_ahead': 1}
+  assert _resolve_remote_config('RemoteScanTrainer', local_art,
+                                FANOUTS, BATCH) == {}
+  with pytest.raises(ValueError, match="tuned for topology 'dist'"):
+    _resolve_remote_config('RemoteScanTrainer', dist_art, FANOUTS,
+                           BATCH)
+  with pytest.raises(ValueError, match='pins fanouts'):
+    _resolve_remote_config('RemoteScanTrainer', remote_art, [5, 5],
+                           BATCH)
+  with pytest.raises(ValueError, match='pins batch_size'):
+    _resolve_remote_config('RemoteScanTrainer', remote_art, FANOUTS, 64)
+  # a fingerprinted artifact on a datasetless remote client: accepted
+  # with the documented RuntimeWarning, never silently
+  warn_art = TuneArtifact(dict(base, topology='remote', block_ahead=2),
+                          dict(num_partitions=1))
+  with pytest.warns(RuntimeWarning, match='no dataset'):
+    got = _resolve_remote_config('RemoteScanTrainer', warn_art, FANOUTS,
+                                 BATCH)
+  assert got == {'block_ahead': 2}
+
+
+# ------------------------------------------------- feasibility screen
+
+
+def test_feasibility_screen_rejects_with_analytics():
+  """The screen rejects quota-busting candidates BEFORE any device
+  work, with the analytic volumes in the evidence; quotas are opt-in
+  (no quota -> feasible, volumes still recorded)."""
+  cfg = dict(fanouts=FANOUTS, batch_size=BATCH, feat_dim=4,
+             num_partitions=NUM_PARTS)
+  cand = TopologyCandidate('d', dict(bucket_frac=None, split_ratio=0.0,
+                                     wire_dtype=None))
+  ok, ev = screen_candidate('dist', cand, 4, cfg)
+  assert ok and ev['exchange_mb'] > 0
+  c0 = glt_metrics.counter('tune.rejected').value
+  ok, ev = screen_candidate('dist', cand, 4,
+                            dict(cfg, max_exchange_mb=1e-9))
+  assert not ok and 'exceeds max_exchange_mb' in ev['rejected']
+  assert glt_metrics.counter('tune.rejected').value == c0 + 1
+  # remote: in-flight block MB = per-chunk MB x block_ahead
+  rc = TopologyCandidate('r', dict(block_ahead=2, block_wire_dtype=None))
+  ok, ev = screen_candidate('remote', rc, 4, cfg)
+  assert ok and ev['inflight_mb'] == pytest.approx(
+      2 * ev['block_mb_per_chunk'])
+  ok, ev = screen_candidate('remote', rc, 4,
+                            dict(cfg, max_block_mb=1e-9))
+  assert not ok and 'in-flight block' in ev['rejected']
+  # tiered: the caller's planner hook prices the slab plan exactly
+  tc = TopologyCandidate('t', dict(hot_prefix_rows=4))
+  ok, ev = screen_candidate(
+      'tiered_dist', tc, 4,
+      dict(cfg, plan_fn=lambda knobs, k: 100, max_slab_rows=64))
+  assert not ok and ev['planned_miss_rows'] == 100
+  assert ev['slab_cap'] == 128
+  assert 'overflows max_slab_rows' in ev['rejected']
+  # a knob outside the topology's field is a construction error
+  with pytest.raises(ValueError, match='outside the'):
+    screen_candidate('remote',
+                     TopologyCandidate('x', dict(bucket_frac=1.0)),
+                     4, cfg)
+  assert 'bucket_frac' not in TOPOLOGY_KNOBS['remote']
+
+
+def test_all_infeasible_field_refuses():
+  """Every candidate screened out -> a loud RuntimeError pointing at
+  the feasibility evidence, never a silent empty tune."""
+  model, tx = make_model_tx()
+  with pytest.raises(RuntimeError, match='screened infeasible'):
+    glt.tune(_base_dataset(),
+             _dist_cfg(model, tx, max_exchange_mb=1e-9),
+             topology='dist', probe_steps=STEPS)
+
+
+def test_budget_ladder_truncates_loudly():
+  """Tune-the-tuner: a wall-clock budget prices the ladder off the
+  first candidate's measured wall and records what it never fielded."""
+  model, tx = make_model_tx()
+  art = glt.tune(_base_dataset(), _dist_cfg(model, tx),
+                 topology='dist', probe_steps=STEPS, budget_s=1e-9)
+  cands = [e for e in art.evidence if e.get('kind') == 'candidate']
+  assert len(cands) == 1                   # first is always scored
+  buds = [e for e in art.evidence if e.get('kind') == 'budget']
+  assert len(buds) == 1
+  assert buds[0]['kept'] == []
+  assert set(buds[0]['dropped']) == {'dist_bucketed',
+                                     'dist_bucketed_bf16'}
+
+
+# ------------------------------------------------------ loud error paths
+
+
+def test_padded_window_candidates_refused():
+  """The RunTrainer split, documented as a refusal: a padded-window
+  candidate would sign an artifact the per-epoch trainers accept but
+  RunTrainer(config=) refuses."""
+  from graphlearn_tpu.tune.tuner import Candidate
+  n = 16
+  ds = glt.data.Dataset()
+  ds.init_graph(np.stack([np.arange(n), (np.arange(n) + 1) % n]),
+                graph_mode='CPU', num_nodes=n)
+  ds.init_node_features(np.ones((n, 4), np.float32))
+  ds.init_node_labels(np.arange(n) % CLASSES)
+  bad = Candidate('padded16', dict(dedup='tree', padded_window=16))
+  with pytest.raises(ValueError, match='RunTrainer'):
+    glt.tune(ds, dict(fanouts=FANOUTS, input_nodes=np.arange(8),
+                      batch_size=2),
+             candidates=[bad])
+
+
+def test_hetero_tune_refused_loudly():
+  """Hetero datasets have no typed fingerprint: tune() and the
+  topology path both refuse with the documented TypeError instead of
+  degrading to an unvalidatable artifact."""
+  class FakeHetero:
+    graph = {('p', 'to', 'a'): object()}
+  with pytest.raises(TypeError, match='homogeneous-only'):
+    glt.tune(FakeHetero(), dict(fanouts=FANOUTS,
+                                input_nodes=np.arange(8), batch_size=2))
+  with pytest.raises(TypeError, match='homogeneous-only'):
+    glt.tune(FakeHetero(),
+             dict(make_scenario=lambda kn, k: (None, None),
+                  fanouts=FANOUTS, batch_size=2, epoch_steps=4),
+             topology='dist')
+
+
+def test_fingerprint_gap_recorded_for_unfingerprintable_dataset():
+  """A homo dataset with no computable fingerprint tunes fine but the
+  artifact carries a structured fingerprint_gap record — the
+  unvalidated downstream acceptance is a recorded fact."""
+  model, tx = make_model_tx()
+
+  class Opaque:
+    pass
+  art = glt.tune(Opaque(), _dist_cfg(model, tx), topology='dist',
+                 probe_steps=STEPS,
+                 candidates=[TopologyCandidate(
+                     'only', dict(bucket_frac=None, split_ratio=0.0,
+                                  wire_dtype=None))])
+  assert art.dataset is None
+  gaps = [e for e in art.evidence if e.get('kind') == 'fingerprint_gap']
+  assert len(gaps) == 1 and gaps[0]['dataset_type'] == 'Opaque'
+
+
+def test_tiered_default_field_needs_hot_prefix_choices():
+  with pytest.raises(ValueError, match='hot_prefix_choices'):
+    default_topology_candidates('tiered_dist', {}, exact=False)
+  cands = default_topology_candidates('tiered_dist',
+                                      dict(hot_prefix_choices=[4, 8]),
+                                      exact=False)
+  assert [c.knobs['hot_prefix_rows'] for c in cands] == [4, 8]
+
+
+def test_make_scenario_required_for_topology_tune():
+  with pytest.raises(ValueError, match='make_scenario'):
+    glt.tune(None, dict(fanouts=FANOUTS, batch_size=BATCH,
+                        epoch_steps=4),
+             topology='dist')
+  with pytest.raises(ValueError, match='unknown tune topology'):
+    from graphlearn_tpu.tune import tune_topology
+    tune_topology('mesh9', None, {})
+
+
+# ----------------------------------------------------------- tiered e2e
+
+
+def test_tiered_topology_tune_and_store_pin(tmp_path):
+  """tiered_dist: the hot-prefix ladder tunes as freshly built tiered
+  stores; the artifact pins hot_prefix_rows, the matching store
+  accepts via config=, and a store built at a DIFFERENT hot prefix is
+  refused with the rebuild instruction."""
+  from graphlearn_tpu.storage import TieredDistScanTrainer
+  model, tx = make_model_tx()
+
+  def make_scenario(knobs, chunk_k):
+    _, loader = _dist_pieces(knobs, tiered=True)
+    state = _dist_state(model, tx, loader)
+    trainer = TieredDistScanTrainer(loader, model, tx, CLASSES,
+                                    chunk_size=chunk_k)
+    return trainer, state
+
+  cfg = dict(make_scenario=make_scenario, fanouts=FANOUTS,
+             batch_size=BATCH, feat_dim=4, num_partitions=NUM_PARTS,
+             rows_per_shard=N // NUM_PARTS,
+             epoch_steps=NUM_PARTS * STEPS,
+             hot_prefix_choices=[4, 8])
+  art = glt.tune(_base_dataset(), cfg, topology='tiered_dist',
+                 probe_steps=STEPS,
+                 out_path=str(tmp_path / 'tiered.json'))
+  assert art.topology == 'tiered_dist'
+  hot = art.choices['hot_prefix_rows']
+  assert hot in (4, 8)
+  cands = [e for e in art.evidence if e.get('kind') == 'candidate']
+  assert all(sum(c['steady_epoch_compiles'].values()) == 0
+             for c in cands if c.get('qualified'))
+  # config= against the MATCHING store: accepted, tuned chunk applied
+  _, loader = _dist_pieces(dict(hot_prefix_rows=hot), tiered=True)
+  state = _dist_state(model, tx, loader)
+  tr = TieredDistScanTrainer(loader, model, tx, CLASSES, config=art)
+  try:
+    assert tr.chunk_size == int(art.choices['chunk_k'])
+    _, losses, _ = tr.run_epoch(state, max_steps=STEPS)
+    assert np.asarray(losses).shape[0] == STEPS
+  finally:
+    tr.close()
+  # a store built at the other prefix: loud refusal, rebuild named
+  other = 8 if hot == 4 else 4
+  _, loader2 = _dist_pieces(dict(hot_prefix_rows=other), tiered=True)
+  with pytest.raises(ValueError, match='rebuild the store'):
+    TieredDistScanTrainer(loader2, model, tx, CLASSES, config=art)
+
+
+# ----------------------------------------------------------- remote e2e
+
+
+def test_remote_topology_tune_and_config_accept(tmp_path):
+  """remote: block-stream candidates tune as freshly built
+  server-client scenarios; the artifact pins block_ahead /
+  block_wire_dtype, and RemoteScanTrainer(config=) applies them over
+  the worker-options defaults (the artifact is the signed
+  assignment)."""
+  from tests.test_remote_scan import (_init_client, _model_and_state,
+                                      _start_block_server, _teardown,
+                                      make_dataset)
+  ds = make_dataset()
+  seeds = np.arange(16)   # 4 steps at bs 4: compile + steady fit fast
+  pairs = [_start_block_server(ds)]
+  try:
+    _init_client(pairs)
+
+    def make_scenario(knobs, chunk_k):
+      model, tx, state, _ = _model_and_state(ds, seeds)
+      opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+          server_rank=0, block_ahead=int(knobs.get('block_ahead') or 2),
+          block_wire_dtype=knobs.get('block_wire_dtype'))
+      trainer = glt.distributed.RemoteScanTrainer(
+          FANOUTS, seeds, model, tx, CLASSES, batch_size=4,
+          chunk_size=chunk_k, seed=0, worker_options=opts)
+      return trainer, state
+
+    cfg = dict(make_scenario=make_scenario, fanouts=FANOUTS,
+               batch_size=4, feat_dim=4, epoch_steps=4)
+    cands = [
+        TopologyCandidate('remote_ahead2',
+                          dict(block_ahead=2, block_wire_dtype=None)),
+        TopologyCandidate('remote_ahead1',
+                          dict(block_ahead=1, block_wire_dtype=None)),
+    ]
+    art = glt.tune(None, cfg, topology='remote', probe_steps=4,
+                   candidates=cands,
+                   out_path=str(tmp_path / 'remote.json'))
+    assert art.topology == 'remote'
+    assert art.choices['block_ahead'] in (1, 2)
+    # the remote client holds no dataset: the gap is a recorded fact
+    assert any(e.get('kind') == 'fingerprint_gap' for e in art.evidence)
+    crec = [e for e in art.evidence if e.get('kind') == 'candidate']
+    assert len(crec) == 2
+    for c in crec:
+      assert c['qualified'], c
+      assert sum(c['steady_epoch_compiles'].values()) == 0, c
+      assert set(c['steady_epoch_compiles']) == {
+          'remote_epoch_begin', 'remote_scan_chunk',
+          'remote_metrics_concat'}
+    # config= acceptance: the tuned block knobs override the
+    # worker-options defaults; chunk K rides trainer_kwargs
+    loaded = TuneArtifact.load(str(tmp_path / 'remote.json'))
+    model, tx, state, _ = _model_and_state(ds, seeds)
+    tr = glt.distributed.RemoteScanTrainer(
+        FANOUTS, seeds, model, tx, CLASSES, batch_size=4, seed=0,
+        worker_options=glt.distributed.RemoteDistSamplingWorkerOptions(
+            server_rank=0),
+        config=loaded)
+    try:
+      assert tr._max_ahead == int(loaded.choices['block_ahead'])
+      assert tr.chunk_size == int(loaded.choices['chunk_k'])
+      _, losses, _ = tr.run_epoch(state, max_steps=4)
+      assert np.asarray(losses).shape[0] == 4
+    finally:
+      tr.shutdown()
+  finally:
+    _teardown(pairs)
+
+
+# ----------------------------------------------------- schema upgrades
+
+
+def test_artifact_v2_loads_with_local_topology(tmp_path):
+  """Backward compat: a pre-topology version-2 artifact validates its
+  OWN v2 fingerprint and knob set, then upgrades with
+  topology='local' + a schema_upgrade evidence record; a smuggled v3
+  key or a hand-edit stays refused."""
+  from graphlearn_tpu.tune.artifact import (ARTIFACT_VERSION,
+                                            TOPOLOGY_CHOICE_DEFAULTS,
+                                            compute_fingerprint)
+  choices = dict(mode='map', frontier_caps=None, padded_window=None,
+                 wire_dtype=None, chunk_k=8, split_ratio=0.1,
+                 bucket_frac=2.0, slab_cap=None, serving_buckets=None,
+                 batch_size=4, fanouts=FANOUTS, exact=False,
+                 use_pallas_v2=True, gather2_block_rows=128,
+                 gather2_run_span=4, use_fused_hop=False,
+                 fused_hop_window=512)
+  obj = dict(version=2, dataset=None, choices=choices,
+             evidence=[dict(kind='winner', name='v2_winner')],
+             fingerprint=compute_fingerprint(2, None, choices))
+  path = str(tmp_path / 'v2.json')
+  with open(path, 'w') as f:
+    json.dump(obj, f)
+  art = TuneArtifact.load(path)
+  assert art.version == ARTIFACT_VERSION
+  assert art.topology == 'local'
+  for key, default in TOPOLOGY_CHOICE_DEFAULTS.items():
+    assert art.choices[key] == default, key
+  assert art.topology_kwargs() == {}
+  # the v2 knobs (kernel routing included) survive untouched
+  for key, val in choices.items():
+    assert art.choices[key] == val, key
+  ups = [e for e in art.evidence if e.get('kind') == 'schema_upgrade']
+  assert len(ups) == 1 and ups[0]['from_version'] == 2
+  assert 'topology' in ups[0]['note']
+  # a v3-only key smuggled into a v2 file is refused (closed v2 set)
+  bad = dict(obj, choices=dict(choices, topology='dist'))
+  with pytest.raises(ValueError, match='unknown choice keys'):
+    TuneArtifact.from_json(bad)
+  # a hand-edited v2 file fails ITS OWN version-2 fingerprint
+  tampered = dict(obj, choices=dict(choices, chunk_k=999))
+  with pytest.raises(ValueError, match='edited'):
+    TuneArtifact.from_json(tampered)
+  # v3 constructor refuses an off-menu topology
+  with pytest.raises(ValueError, match='unknown topology'):
+    TuneArtifact(dict(choices, topology='mesh9'))
