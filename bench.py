@@ -577,6 +577,39 @@ BENCH_KEY_REGISTRY = {
     'remote_vs_collocated_ratio': 'remote / collocated scanned epoch '
                                   'wall (gate: ~1.3x)',
     'remote_scan_config': 'graph/block/server shape of the figures',
+    # hetero at scanned speed (ISSUE 19, sampler/capacity.py,
+    # docs/capacity_plans.md): typed CapacityPlans thread per-ntype
+    # closed shapes through the marquee fast paths — the chunk-staged
+    # remote epoch on TYPED block streams vs the per-batch remote
+    # hetero path (bit-identical arms), and the per-ntype tiered
+    # exchange vs the all-HBM hetero DistScanTrainer epoch
+    'hetero_scan_epoch_wall_s': 'hetero chunk-staged remote epoch '
+                                'wall s (typed block streams)',
+    'hetero_scan_per_batch_wall_s': 'per-batch remote hetero epoch '
+                                    'wall s (the path hetero was '
+                                    'stuck on pre-CapacityPlan)',
+    'hetero_scan_vs_per_batch_ratio': 'hetero scanned / per-batch '
+                                      'epoch wall (gate: <= 1.0 on '
+                                      'the CPU replica)',
+    'hetero_scan_epoch_dispatches': 'client dispatches for the hetero '
+                                    'scanned epoch (pin: '
+                                    'ceil(steps/K) + 2)',
+    'hetero_scan_bit_identical': 'hetero scanned losses == per-batch '
+                                 'remote hetero losses',
+    'hetero_scan_config': 'graph/etype/block shape of the '
+                          'hetero_scan figures',
+    'hetero_tiered_epoch_wall_s': 'hetero tiered dist epoch wall s '
+                                  '(per-ntype hot prefixes + staged '
+                                  'slabs)',
+    'hetero_tiered_hbm_epoch_wall_s': 'all-HBM hetero DistScanTrainer '
+                                      'reference epoch wall s',
+    'hetero_tiered_ratio': 'hetero tiered / all-HBM epoch wall '
+                           '(gate: ~1.5x, the dist_oversub contract '
+                           'on typed stores)',
+    'hetero_tiered_bit_identical': 'hetero tiered epoch losses == '
+                                   'all-HBM hetero losses',
+    'hetero_tiered_config': 'graph/mesh/prefix shape of the '
+                            'hetero_tiered figures',
     # multi-tenant service fabric (distributed/tenancy.py,
     # docs/multi_tenancy.md): weighted-fair shares and interactive
     # latency under a contended sampling cluster, plus the visible-
@@ -621,6 +654,7 @@ BENCH_ERROR_SECTIONS = (
     'serving', 'oversub', 'dist_oversub', 'rotation', 'recovery',
     'remote_scan', 'gather2', 'fused_hop', 'fused_multihop',
     'oversub_per_step', 'tune', 'topology_tune', 'run_scan', 'tenancy',
+    'hetero_scan', 'hetero_tiered',
 )
 
 # The LOWER-IS-BETTER subset of BENCH_KEY_REGISTRY — the keys
@@ -671,6 +705,10 @@ BENCH_LOWER_IS_BETTER = frozenset({
     # the chunk-staged remote gate pair: the remote/collocated wall
     # ratio and the block staging latency ahead of the scan
     'remote_vs_collocated_ratio', 'remote_block_stage_ms_p99',
+    # the typed-fast-path gate pair (ISSUE 19): hetero scanned epochs
+    # must stay at-or-under the per-batch hetero wall, and the
+    # per-ntype tiered exchange must hold the dist_oversub contract
+    'hetero_scan_vs_per_batch_ratio', 'hetero_tiered_ratio',
     # the multi-tenant gate pair: weight-share fidelity of the fair
     # scheduler and the interactive tenant's latency cost under a
     # saturating training load (both drift silently otherwise)
@@ -2230,6 +2268,263 @@ def main():
   except Exception as e:
     result['remote_scan_epoch_wall_s'] = None
     result['remote_scan_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- hetero at scanned speed: typed remote block streams ----
+  # The ISSUE 19 gate (docs/capacity_plans.md): the chunk-staged remote
+  # epoch on TYPED block streams vs the per-batch remote hetero path —
+  # the path hetero workloads were stuck on before CapacityPlans. Both
+  # arms are bit-identical by contract (asserted below), both time a
+  # WARMED second epoch, and the scanned arm must hold the homo
+  # dispatch budget (ceil(steps/K) + 2). CPU replica of the sampling
+  # cluster; the on-chip figures land with the TPU relay.
+  try:
+    import optax
+    from graphlearn_tpu.distributed import dist_client
+    from graphlearn_tpu.distributed.dist_server import DistServer
+    from graphlearn_tpu.distributed.rpc import RpcServer
+    from graphlearn_tpu.models import RGNN as _HRGNN
+    from graphlearn_tpu.models import train as _htrain
+    from graphlearn_tpu.typing import reverse_edge_type as _rev_et
+    hs_ub = ('user', 'buys', 'item')
+    hs_bu = ('item', 'rev_buys', 'user')
+    hs_nu, hs_ni, hs_deg, hs_f = 20_000, 10_000, 8, 16
+    hs_batch, hs_steps, hs_k, hs_classes = 128, 8, 4, 8
+    hs_fanouts = {hs_ub: [4, 3], hs_bu: [4, 3]}
+    hs_rng = np.random.default_rng(31)
+    hs_rows = hs_rng.integers(0, hs_nu, hs_nu * hs_deg)
+    hs_cols = hs_rng.integers(0, hs_ni, hs_nu * hs_deg)
+    hs_ub_ei = np.stack([hs_rows, hs_cols])
+    hs_seeds = hs_rng.integers(0, hs_nu, hs_batch * hs_steps)
+
+    hs_ds = glt.data.Dataset(edge_dir='out')
+    hs_ds.init_graph({hs_ub: hs_ub_ei, hs_bu: hs_ub_ei[::-1].copy()},
+                     graph_mode='CPU',
+                     num_nodes={hs_ub: hs_nu, hs_bu: hs_ni})
+    hs_ds.init_node_features(
+        {'user': hs_rng.standard_normal((hs_nu, hs_f)).astype(
+            np.float32),
+         'item': hs_rng.standard_normal((hs_ni, hs_f)).astype(
+             np.float32)})
+    hs_ds.init_node_labels(
+        {'user': hs_rng.integers(0, hs_classes, hs_nu)})
+
+    def _hs_to_dict(b):
+      nsn = np.asarray(b.num_sampled_nodes['user']).reshape(-1)
+      return dict(x=dict(b.x), edge_index=dict(b.edge_index),
+                  edge_mask=dict(b.edge_mask), y=b.y['user'],
+                  num_seed_nodes=nsn[0])
+
+    hs_srv = DistServer(hs_ds)
+    hs_rpc = RpcServer(handlers={
+        'create_sampling_producer': hs_srv.create_sampling_producer,
+        'producer_num_expected': hs_srv.producer_num_expected,
+        'start_new_epoch_sampling': hs_srv.start_new_epoch_sampling,
+        'fetch_one_sampled_message': hs_srv.fetch_one_sampled_message,
+        'destroy_sampling_producer': hs_srv.destroy_sampling_producer,
+        'create_block_producer': hs_srv.create_block_producer,
+        'block_producer_num_batches':
+            hs_srv.block_producer_num_batches,
+        'block_produce': hs_srv.block_produce,
+        'block_fetch': hs_srv.block_fetch,
+        'destroy_block_producer': hs_srv.destroy_block_producer,
+        'get_dataset_meta': hs_srv.get_dataset_meta,
+        'heartbeat': hs_srv.heartbeat,
+        'get_metrics': hs_srv.get_metrics,
+        'exit': hs_srv.exit})
+    dist_client.init_client(1, 1, 0, [(hs_rpc.host, hs_rpc.port)])
+    hs_trainer = hs_loader = None
+    try:
+      hs_model = _HRGNN(etypes=(_rev_et(hs_ub), _rev_et(hs_bu)),
+                        hidden_dim=32, out_dim=hs_classes,
+                        num_layers=2, out_ntype='user')
+      hs_tx = optax.adam(1e-3)
+      hs_local = glt.loader.NeighborLoader(
+          hs_ds, hs_fanouts, ('user', hs_seeds), batch_size=hs_batch,
+          shuffle=False)
+      hs_template = _hs_to_dict(next(iter(hs_local)))
+      hs_state_pb, _ = _htrain.create_train_state(
+          hs_model, jax.random.PRNGKey(0), hs_template,
+          optimizer=hs_tx)
+
+      # per-batch remote hetero arm (1 worker / prefetch 1: the only
+      # deterministically-ordered per-batch configuration)
+      hs_opts = glt.distributed.RemoteDistSamplingWorkerOptions(
+          server_rank=0, num_workers=1, prefetch_size=1)
+      hs_loader = glt.distributed.RemoteDistNeighborLoader(
+          hs_fanouts, ('user', hs_seeds), batch_size=hs_batch,
+          collect_features=True, worker_options=hs_opts, seed=0)
+      hs_step, _ = _htrain.make_train_step(hs_model, hs_tx,
+                                           hs_classes)
+      for b in hs_loader:                                # warm epoch
+        hs_state_pb, _, _ = hs_step(hs_state_pb, _hs_to_dict(b))
+      hs_pb_losses = []
+      hs_t0 = time.perf_counter()
+      for b in hs_loader:
+        hs_state_pb, loss, _ = hs_step(hs_state_pb, _hs_to_dict(b))
+        hs_pb_losses.append(np.asarray(loss))
+      hs_pb_wall = time.perf_counter() - hs_t0
+      hs_loader.shutdown()
+      hs_loader = None
+
+      # typed chunk-staged arm from an identically initialized state
+      hs_state_sc, _ = _htrain.create_train_state(
+          hs_model, jax.random.PRNGKey(0), hs_template,
+          optimizer=hs_tx)
+      hs_trainer = glt.distributed.RemoteScanTrainer(
+          hs_fanouts, ('user', hs_seeds), hs_model, hs_tx, hs_classes,
+          batch_size=hs_batch, chunk_size=hs_k, seed=0,
+          worker_options=glt.distributed
+          .RemoteDistSamplingWorkerOptions(server_rank=0))
+      hs_state_sc, _, _ = hs_trainer.run_epoch(hs_state_sc)  # warm
+      with glt.utils.count_dispatches() as hs_dc:
+        hs_t0 = time.perf_counter()
+        hs_state_sc, hs_sc_losses, _ = hs_trainer.run_epoch(
+            hs_state_sc)
+        hs_sc_losses = np.asarray(hs_sc_losses)           # drain
+        hs_sc_wall = time.perf_counter() - hs_t0
+    finally:
+      if hs_loader is not None:
+        hs_loader.shutdown()
+      if hs_trainer is not None:
+        hs_trainer.shutdown()
+      dist_client._client.close()
+      dist_client._client = None
+      hs_srv.exit()
+      hs_rpc.shutdown()
+    result['hetero_scan_epoch_wall_s'] = round(hs_sc_wall, 3)
+    result['hetero_scan_per_batch_wall_s'] = round(hs_pb_wall, 3)
+    result['hetero_scan_vs_per_batch_ratio'] = round(
+        hs_sc_wall / max(hs_pb_wall, 1e-9), 3)
+    result['hetero_scan_epoch_dispatches'] = sum(
+        v for s, v in hs_dc.counts.items() if s.startswith('remote_'))
+    result['hetero_scan_bit_identical'] = bool(np.array_equal(
+        hs_sc_losses, np.asarray(hs_pb_losses).reshape(-1)))
+    result['hetero_scan_config'] = (
+        f'bipartite {hs_nu}u x {hs_ni}i, deg={hs_deg}, F={hs_f}, '
+        f'2 etypes, fanouts [4,3]/[4,3], batch {hs_batch} x '
+        f'{hs_steps} steps, K={hs_k}; 1 in-proc server (CPU replica), '
+        'typed block streams vs per-batch remote hetero')
+  except Exception as e:
+    result['hetero_scan_epoch_wall_s'] = None
+    result['hetero_scan_error'] = f'{type(e).__name__}: {e}'[:200]
+
+  # ---- hetero per-ntype tiered exchange (storage/dist_scan.py) ----
+  # The typed dist_oversub contract: TieredDistScanTrainer over
+  # per-ntype TieredDistFeature stores (per-ntype hot prefixes +
+  # staged exchange slabs, one spill dir per ntype) vs the identical
+  # all-HBM hetero DistScanTrainer epoch — bit-identical losses, wall
+  # ratio gated at the homo dist_oversub bar (~1.5x).
+  try:
+    import tempfile as _ht_tempfile
+
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh as _HTMesh
+
+    from graphlearn_tpu.models import RGNN as _HRGNN
+    from graphlearn_tpu.models import train as _htrain
+    from graphlearn_tpu.storage import (TieredDistFeature,
+                                        TieredDistScanTrainer)
+    from graphlearn_tpu.typing import GraphPartitionData as _HTGPD
+    from graphlearn_tpu.typing import reverse_edge_type as _rev_et
+    ht_e1, ht_e2 = ('u', 'to', 'v'), ('v', 'back', 'u')
+    ht_n, ht_p, ht_f, ht_hot = 4_000, 2, 16, 256
+    ht_batch, ht_steps, ht_k, ht_classes = 32, 8, 4, 8
+    ht_fanouts = {ht_e1: [4, 3], ht_e2: [3, 2]}
+    ht_rng = np.random.default_rng(37)
+    ht_r1 = ht_rng.integers(0, ht_n, ht_n * 6)
+    ht_c1 = ht_rng.integers(0, ht_n, ht_n * 6)
+    ht_r2 = ht_rng.integers(0, ht_n, ht_n * 4)
+    ht_c2 = ht_rng.integers(0, ht_n, ht_n * 4)
+    ht_pb = {'u': (np.arange(ht_n) % ht_p).astype(np.int32),
+             'v': ((np.arange(ht_n) + 1) % ht_p).astype(np.int32)}
+    ht_parts = []
+    for p in range(ht_p):
+      m1 = ht_pb['u'][ht_r1] == p
+      m2 = ht_pb['v'][ht_r2] == p
+      ht_parts.append({
+          ht_e1: _HTGPD(
+              edge_index=np.stack([ht_r1[m1], ht_c1[m1]]),
+              eids=np.arange(ht_r1.shape[0])[m1]),
+          ht_e2: _HTGPD(
+              edge_index=np.stack([ht_r2[m2], ht_c2[m2]]),
+              eids=np.arange(ht_r2.shape[0])[m2])})
+    ht_feat = {t: ht_rng.standard_normal((ht_n, ht_f)).astype(
+        np.float32) for t in ('u', 'v')}
+    ht_stores = {t: [(np.nonzero(ht_pb[t] == p)[0],
+                      ht_feat[t][ht_pb[t] == p])
+                     for p in range(ht_p)] for t in ('u', 'v')}
+    ht_labels = {t: ht_rng.integers(0, ht_classes, ht_n)
+                 for t in ('u', 'v')}
+    ht_seeds = ht_rng.integers(0, ht_n, ht_p * ht_batch * ht_steps)
+    ht_mesh = _HTMesh(np.array(jax.devices()[:ht_p]), ('g',))
+
+    def _ht_loader(tiered):
+      dg = glt.distributed.DistHeteroGraph(ht_p, 0, ht_parts, ht_pb)
+      if tiered:
+        base = _ht_tempfile.mkdtemp(prefix='glt_bench_htiered_')
+        df = {t: TieredDistFeature(
+            ht_p, ht_stores[t], ht_pb[t], mesh=ht_mesh,
+            spill_dir=os.path.join(base, t), hot_prefix_rows=ht_hot,
+            split_ratio=0.25) for t in ('u', 'v')}
+      else:
+        df = {t: glt.distributed.DistFeature(
+            ht_p, ht_stores[t], ht_pb[t], ht_mesh, split_ratio=0.25)
+            for t in ('u', 'v')}
+      ds = glt.distributed.DistDataset(ht_p, 0, dg, df,
+                                       node_labels=ht_labels)
+      return glt.distributed.DistNeighborLoader(
+          ds, ht_fanouts, ('u', ht_seeds), batch_size=ht_batch,
+          shuffle=False, drop_last=False, seed=0, mesh=ht_mesh)
+
+    ht_model = _HRGNN(etypes=(_rev_et(ht_e1), _rev_et(ht_e2)),
+                      hidden_dim=32, out_dim=ht_classes, num_layers=2,
+                      out_ntype='u')
+    ht_tx = optax.adam(1e-3)
+
+    def _ht_state():
+      first = next(iter(_ht_loader(False)))
+      one = lambda d: {k: np.asarray(v)[0] for k, v in d.items()}
+      params = ht_model.init(jax.random.PRNGKey(0), one(first.x),
+                             one(first.edge_index),
+                             one(first.edge_mask))
+      return _htrain.TrainState(params, ht_tx.init(params),
+                                jnp.int32(0))
+
+    ht_ref = glt.loader.DistScanTrainer(_ht_loader(False), ht_model,
+                                        ht_tx, ht_classes,
+                                        chunk_size=ht_k)
+    ht_rstate = _ht_state()
+    ht_rstate, _, _ = ht_ref.run_epoch(ht_rstate)         # warm epoch
+    ht_t0 = time.perf_counter()
+    ht_rstate, ht_rlosses, _ = ht_ref.run_epoch(ht_rstate)
+    ht_rlosses = np.asarray(ht_rlosses)                   # drain
+    ht_hbm_wall = time.perf_counter() - ht_t0
+
+    ht_tr = TieredDistScanTrainer(_ht_loader(True), ht_model, ht_tx,
+                                  ht_classes, chunk_size=ht_k)
+    ht_tstate = _ht_state()
+    ht_tstate, _, _ = ht_tr.run_epoch(ht_tstate)          # warm epoch
+    ht_t0 = time.perf_counter()
+    ht_tstate, ht_tlosses, _ = ht_tr.run_epoch(ht_tstate)
+    ht_tlosses = np.asarray(ht_tlosses)                   # drain
+    ht_tiered_wall = time.perf_counter() - ht_t0
+    ht_tr.close()
+
+    result['hetero_tiered_epoch_wall_s'] = round(ht_tiered_wall, 3)
+    result['hetero_tiered_hbm_epoch_wall_s'] = round(ht_hbm_wall, 3)
+    result['hetero_tiered_ratio'] = round(
+        ht_tiered_wall / max(ht_hbm_wall, 1e-9), 3)
+    result['hetero_tiered_bit_identical'] = bool(
+        np.array_equal(ht_tlosses, ht_rlosses))
+    result['hetero_tiered_config'] = (
+        f'2 ntypes x {ht_n} nodes, 2 etypes, F={ht_f}, mesh P={ht_p}, '
+        f'hot prefix {ht_hot} rows/ntype + per-ntype spill dirs, '
+        f'fanouts [4,3]/[3,2], batch {ht_batch}/shard x {ht_steps} '
+        f'steps, K={ht_k}')
+  except Exception as e:
+    result['hetero_tiered_epoch_wall_s'] = None
+    result['hetero_tiered_error'] = f'{type(e).__name__}: {e}'[:200]
 
   # ---- multi-tenant fairness (distributed/tenancy.py) ----
   # The service-fabric gate (docs/multi_tenancy.md): one in-process

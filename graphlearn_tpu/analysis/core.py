@@ -127,7 +127,7 @@ def in_scope(relpath: str, patterns: Sequence[str]) -> bool:
 
 PRAGMA_RULES = ('host-sync', 'prng-discipline', 'dispatch-instrumentation',
                 'compat-shard-map', 'fault-point-coverage',
-                'metric-registry', 'span-registry')
+                'metric-registry', 'span-registry', 'hetero-gate')
 _PRAGMA_MARK = 'graftlint:'
 
 
@@ -294,10 +294,10 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 # ------------------------------------------------------------------- runner
 
 def _checkers():
-  from . import (compat_import, dispatch, fault_points, host_sync,
-                 metric_names, prng, span_names)
+  from . import (compat_import, dispatch, fault_points, hetero_gates,
+                 host_sync, metric_names, prng, span_names)
   return (host_sync, prng, dispatch, compat_import, fault_points,
-          metric_names, span_names)
+          metric_names, span_names, hetero_gates)
 
 
 def run_lint(paths: Sequence[str], config: Optional[Config] = None,

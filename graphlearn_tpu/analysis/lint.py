@@ -45,6 +45,11 @@ _RULE_DOCS = {
         'span names (spans.span/begin/emit) must be string literals '
         'registered in metrics/registry_names.py REGISTERED_SPANS and '
         'documented in the docs/observability.md span table',
+    'hetero-gate':
+        'is_hetero-gated raise/warn outside sampler/capacity.py must '
+        'raise CapacityPlanError (the typed refusal naming the missing '
+        'plan input, docs/capacity_plans.md) or carry an allow pragma '
+        'for a real semantic boundary',
 }
 
 

@@ -12,7 +12,10 @@ one fixed-shape frame the client uploads once and trains as one scanned
 chunk program.
 
 Counter addressing is the whole design: batch ``j`` of epoch ``e`` uses
-sampler call index ``e * num_batches + j``, so block ``b`` of any epoch
+sampler call index ``(e * num_batches + j) * stride`` where ``stride``
+is the stream CapacityPlan's per-batch key-draw count (1 on homo
+streams; one draw per (hop, edge type) touch on hetero streams — see
+docs/capacity_plans.md), so block ``b`` of any epoch
 is a PURE FUNCTION of (seed share, sampling config, epoch, block index)
 — any server holding the share can produce it, which is what makes
 chunk-granular failover exact (a survivor re-replays a dead server's
@@ -40,10 +43,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..metrics import spans
-from ..sampler import NodeSamplerInput, SamplingConfig, SamplingType
+from ..sampler import (CapacityPlan, CapacityPlanError, EdgeSamplerInput,
+                       NegativeSampling, NodeSamplerInput, SamplingConfig,
+                       SamplingType)
 from ..storage.staging import INT32_MAX, pow2_slab_cap
 from ..utils.faults import fault_point
-from .message import output_to_message
+from .message import hetero_output_to_message, output_to_message
 
 #: wire-dtype spellings accepted over the RPC (strings travel cleanly;
 #: the jnp dtype object itself never crosses the wire)
@@ -119,14 +124,21 @@ def block_mb_per_chunk(k: int, node_cap: int, edge_cap: int,
 class BlockSampleProducer:
   """One server-side block stream: the chunk-staged path's producer.
 
-  Scope: homogeneous supervised NODE sampling (the fused-trainer scope
-  — loader/pipeline.py): typed/hetero seeds and link inputs are
-  rejected at construction, mirroring the chunk program's client-side
-  contract.
+  Scope: supervised NODE and LINK sampling, homogeneous or hetero —
+  typed shapes come from the stream's :class:`~..sampler.CapacityPlan`
+  (docs/capacity_plans.md): hetero batches draw one PRNG key per
+  (hop, edge type) touch, so counter addressing positions the stream
+  at ``batch_index * plan-derived stride`` instead of the homo paths'
+  implicit stride of 1 (the homo stream is the single-ntype degenerate
+  plan — stride 1 falls out, nothing special-cased).
 
   Args:
     dataset: the server's Dataset (graph + features + labels).
-    sampler_input: seed share (array or NodeSamplerInput, untyped).
+    sampler_input: seed share — an array / NodeSamplerInput (typed via
+      ``(ntype, seeds)`` or ``input_type`` on hetero graphs), or for
+      LINK configs the mp producers' dict payload
+      (``{'rows', 'cols', 'label', 'neg_mode', 'neg_amount'}``, plus
+      ``'input_type'`` for hetero link) or an EdgeSamplerInput.
     sampling_config: the client's SamplingConfig — ``seed`` must
       already carry the per-server fold (``(seed or 0) * 7919 + i``,
       exactly the per-batch remote loaders' convention) so the block
@@ -139,25 +151,67 @@ class BlockSampleProducer:
                wire_dtype: Optional[str] = None):
     import graphlearn_tpu as glt
     cfg = sampling_config
-    if cfg.sampling_type != SamplingType.NODE:
-      raise ValueError('block producers cover NODE sampling only — '
-                       'link streams keep the per-batch path '
+    if cfg.sampling_type not in (SamplingType.NODE, SamplingType.LINK):
+      raise ValueError('block producers cover NODE and LINK sampling — '
+                       'subgraph/walk streams keep the per-batch path '
                        '(docs/remote_scan.md)')
-    if isinstance(dataset.graph, dict):
-      raise ValueError('block producers are homogeneous-only (the '
-                       'chunk-staged trainer scope); hetero graphs '
-                       'keep the per-batch mp producers')
-    inp = NodeSamplerInput.cast(sampler_input)
-    if inp.input_type is not None:
-      raise ValueError('block producers take untyped seeds '
-                       '(homogeneous scope)')
+    hetero = isinstance(dataset.graph, dict)
+    self._link = cfg.sampling_type == SamplingType.LINK
+    self._input_type = None
+    self._etype = None
+    self._neg: Optional[NegativeSampling] = None
+    self._rows = self._cols = self._label = None
+    if self._link:
+      if isinstance(sampler_input, dict):
+        self._rows = np.asarray(sampler_input['rows']).reshape(-1)
+        self._cols = np.asarray(sampler_input['cols']).reshape(-1)
+        lab = sampler_input.get('label')
+        self._label = np.asarray(lab) if lab is not None else None
+        self._neg = (NegativeSampling(sampler_input['neg_mode'],
+                                      sampler_input['neg_amount'])
+                     if sampler_input.get('neg_mode') else None)
+        self._etype = (tuple(sampler_input['input_type'])
+                       if sampler_input.get('input_type') else None)
+      else:
+        einp = EdgeSamplerInput.cast(sampler_input)
+        self._rows = np.asarray(einp.row).reshape(-1)
+        self._cols = np.asarray(einp.col).reshape(-1)
+        self._label = (np.asarray(einp.label)
+                       if einp.label is not None else None)
+        self._neg = einp.neg_sampling
+        self._etype = (tuple(einp.input_type)
+                       if einp.input_type is not None else None)
+      if hetero and self._etype is None:
+        raise CapacityPlanError(
+            'BlockSampleProducer', 'hetero link seeds carry no edge '
+            'type (no CapacityPlan without one)',
+            "pass input_type=(src, rel, dst) on the seed share")
+      self.seeds = self._rows   # epoch order indexes seed EDGES
+    else:
+      if isinstance(sampler_input, (tuple, list)) and \
+          len(sampler_input) == 2 and isinstance(sampler_input[0], str):
+        inp = NodeSamplerInput(np.asarray(sampler_input[1]),
+                               input_type=sampler_input[0])
+      else:
+        inp = NodeSamplerInput.cast(sampler_input)
+      if inp.input_type is not None and not hetero:
+        raise CapacityPlanError(
+            'BlockSampleProducer', f'seed type {inp.input_type!r} was '
+            'given for a homogeneous graph (no typed CapacityPlan '
+            'exists)', 'pass untyped seeds')
+      if hetero and inp.input_type is None:
+        raise CapacityPlanError(
+            'BlockSampleProducer', 'hetero graphs need typed seeds to '
+            'derive the per-ntype CapacityPlan',
+            "pass (ntype, seeds) or NodeSamplerInput(..., input_type=)")
+      self._input_type = inp.input_type
+      self.seeds = np.asarray(inp.node).reshape(-1)
     if wire_dtype is not None and \
         str(wire_dtype).lower() not in _BF16_NAMES:
       raise ValueError(f'unknown wire_dtype {wire_dtype!r}; pass None '
                        "or 'bf16'")
     self.dataset = dataset
     self.config = cfg
-    self.seeds = np.asarray(inp.node).reshape(-1)
     self.wire_dtype = (str(wire_dtype).lower()
                        if wire_dtype is not None else None)
     # the mp worker-0 stream, exactly (_sampling_worker_loop): the
@@ -168,6 +222,13 @@ class BlockSampleProducer:
         dataset.graph, cfg.num_neighbors, with_edge=cfg.with_edge,
         with_weight=cfg.with_weight, edge_dir=cfg.edge_dir,
         seed=worker_seed)
+    self.plan = self._capacity_plan()
+    # counter stride: the per-batch stream advances _call_count by this
+    # much per batch (homo: 1; hetero: one draw per (hop, etype) touch,
+    # +1 for the link negative draw), so random block addressing must
+    # scale batch indices by it to land on the same stream positions
+    self._key_stride = ((1 if self._neg is not None else 0) +
+                        self.plan.key_draws_per_batch) if hetero else 1
     self._order_cache: Optional[tuple] = None   # (epoch, order)
     self._frames: Dict[Tuple[int, int, int], dict] = {}
     # tenancy accounting seams (dist_server.create_block_producer):
@@ -218,24 +279,101 @@ class BlockSampleProducer:
 
   # --------------------------------------------------------- production
 
+  def _capacity_plan(self) -> CapacityPlan:
+    """This stream's CapacityPlan: the typed closed shapes every frame
+    of the stream obeys, and the source of the counter stride. Link
+    streams derive their seed widths exactly as the engines pad them
+    (cyclic tail pad keeps every batch at full width)."""
+    cfg = self.config
+    bs = cfg.batch_size
+    s = self._sampler
+    if not self._link:
+      return CapacityPlan.from_sampler(s, bs,
+                                       input_type=self._input_type,
+                                       wire_dtype=self.wire_dtype)
+    from ..sampler.calibrate import link_seed_width
+    from ..sampler.neighbor_sampler import _round_up
+    if not s.is_hetero:
+      return CapacityPlan.homo(_round_up(link_seed_width(bs, self._neg)),
+                               tuple(cfg.num_neighbors),
+                               wire_dtype=self.wire_dtype)
+    src_t, _, dst_t = self._etype
+    nn = self._neg.num_negatives(bs) if self._neg is not None else 0
+    if self._neg is None:
+      src_w, dst_w = bs, bs
+    elif self._neg.is_binary():
+      src_w, dst_w = bs + nn, bs + nn
+    else:  # triplet: negatives are dst candidates only
+      src_w, dst_w = bs, bs + nn
+    if src_t == dst_t:
+      seed_caps = {src_t: _round_up(src_w + dst_w)}
+    else:
+      seed_caps = {src_t: _round_up(src_w), dst_t: _round_up(dst_w)}
+    return CapacityPlan.hetero(list(s.graph.keys()), s._etype_fanouts,
+                               seed_caps, s.edge_dir,
+                               wire_dtype=self.wire_dtype)
+
+  def _collect_message(self, out) -> dict:
+    """Features + labels + flatten — the `_sampling_worker_loop` gather,
+    verbatim, so block frames bit-match the per-batch stream."""
+    ds = self.dataset
+    if getattr(out, 'node', None) is not None and isinstance(out.node,
+                                                             dict):
+      x_d = y_d = None
+      if self.config.collect_features and \
+          isinstance(ds.node_features, dict):
+        x_d = {t: ds.node_features[t].cpu_get(
+            np.maximum(np.asarray(out.node[t]), 0))
+            for t in out.node if t in ds.node_features}
+      if isinstance(ds.node_labels, dict):
+        y_d = {}
+        for t, lab in ds.node_labels.items():
+          if t not in out.node:
+            continue
+          lab = np.asarray(lab)
+          y_d[t] = lab[np.clip(np.asarray(out.node[t]), 0,
+                               len(lab) - 1)]
+      return hetero_output_to_message(out, x_d, y_d)
+    x = y = None
+    if self.config.collect_features and ds.node_features is not None:
+      x = ds.node_features.cpu_get(np.maximum(np.asarray(out.node), 0))
+    if ds.node_labels is not None:
+      labels = np.asarray(ds.node_labels)
+      y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
+    return output_to_message(out, x, y)
+
   def _batch_message(self, order: np.ndarray, epoch: int, j: int) -> dict:
     """Batch ``j`` of epoch ``epoch``: position the counter stream and
     draw — ``_call_count`` is SET (not advanced) so any (epoch, batch)
-    is random-access, the property failover and resume rely on."""
+    is random-access, the property failover and resume rely on. The
+    stream position is ``batch index * key stride`` (the CapacityPlan's
+    per-batch draw count), matching the sequential per-batch stream."""
     bs = self.config.batch_size
     idx = order[j * bs:(j + 1) * bs]
-    self._sampler._call_count = epoch * self.num_batches() + j
+    self._sampler._call_count = \
+        (epoch * self.num_batches() + j) * self._key_stride
+    if self._link:
+      true_n = int(idx.shape[0])
+      if true_n < bs:
+        # the mp worker convention: pad the final short batch cyclically
+        # so every batch keeps the compiled (full-width) shape
+        idx = np.resize(idx, bs)
+      out = self._sampler.sample_from_edges(EdgeSamplerInput(
+          self._rows[idx], self._cols[idx],
+          label=(self._label[idx] if self._label is not None else None),
+          input_type=self._etype, neg_sampling=self._neg))
+      # chunk-granular ack provenance (docs/capacity_plans.md): the seed
+      # EDGE endpoints this batch covered, with the true (pre-pad) count
+      # — the link counterpart of the node frames' 'batch' key, read by
+      # sampler.capacity.ack_edge_ids
+      out.metadata['edge_batch'] = np.stack(
+          [self._rows[idx], self._cols[idx]]).astype(np.int32)
+      out.metadata['edge_batch_size'] = np.asarray(true_n, np.int32)
+      return self._collect_message(out)
     out = self._sampler.sample_from_nodes(
-        NodeSamplerInput(self.seeds[idx]), batch_cap=bs)
-    x = y = None
-    if self.config.collect_features and \
-        self.dataset.node_features is not None:
-      x = self.dataset.node_features.cpu_get(
-          np.maximum(np.asarray(out.node), 0))
-    if self.dataset.node_labels is not None:
-      labels = np.asarray(self.dataset.node_labels)
-      y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
-    return output_to_message(out, x, y)
+        NodeSamplerInput(self.seeds[idx], input_type=self._input_type),
+        batch_cap=bs)
+    return self._collect_message(out)
 
   def build_frame(self, epoch: int, start: int, k: int) -> dict:
     """The block frame covering batches ``[start, start + k)`` of the
@@ -256,11 +394,13 @@ class BlockSampleProducer:
       msgs = [self._batch_message(order, epoch, j)
               for j in range(start, start + k)]
       frame = stack_block_frames(msgs)
-    if 'y' in frame:
-      frame['y'] = frame['y'].astype(np.int32)
-    if self.wire_dtype is not None and 'x' in frame:
-      import ml_dtypes
-      frame['x'] = frame['x'].astype(ml_dtypes.bfloat16)
+    for key in list(frame):
+      if key == 'y' or key.startswith('y.'):
+        frame[key] = frame[key].astype(np.int32)
+      elif self.wire_dtype is not None and \
+          (key == 'x' or key.startswith('x.')):
+        import ml_dtypes
+        frame[key] = frame[key].astype(ml_dtypes.bfloat16)
     frame['#META.num_batches'] = np.asarray(len(msgs), np.int32)
     return frame
 

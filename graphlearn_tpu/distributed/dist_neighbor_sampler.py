@@ -1328,6 +1328,9 @@ class DistNeighborSampler:
     """
     import jax.numpy as jnp
     if self.is_hetero:
+      # reference-parity boundary: the upstream engine raises
+      # NotImplementedError here too — a feature neither side has
+      # graftlint: allow[hetero-gate] reference-parity, not unmigrated
       raise NotImplementedError(
           'hetero distributed subgraph sampling (reference parity: '
           'dist_neighbor_sampler.py:505 raises NotImplementedError)')

@@ -266,6 +266,30 @@ class RemoteBlockStager:
     self._submit_next()
 
 
+def _norm_fans(f):
+  """Canonical comparison form of a fanout spec: per-etype dict
+  fanouts (hetero plans) normalize to sorted string keys — tuned
+  artifacts round-trip etype keys through JSON as strings
+  (docs/capacity_plans.md)."""
+  if isinstance(f, dict):
+    from ..typing import as_str
+    return {as_str(tuple(et)) if isinstance(et, (tuple, list))
+            else str(et): [int(k) for k in v]
+            for et, v in sorted(f.items(), key=lambda kv: str(kv[0]))}
+  return [int(k) for k in f]
+
+
+def _group_frame(frame: dict, prefix: str, et_keyed: bool = False):
+  """Host-side regroup of a typed block frame's dotted keys
+  (``x.paper`` / ``row.paper__cites__paper`` — docs/capacity_plans.md)
+  into a per-type dict for one device_put."""
+  from ..typing import to_edge_type
+  p = prefix + '.'
+  return {(to_edge_type(kk[len(p):]) if et_keyed else kk[len(p):]):
+          np.asarray(v) for kk, v in frame.items()
+          if kk.startswith(p)}
+
+
 def _resolve_remote_config(name: str, config, fanouts,
                            batch_size: int) -> dict:
   """Validate a tune-artifact ``config=`` against the remote scenario
@@ -288,10 +312,10 @@ def _resolve_remote_config(name: str, config, fanouts,
   choices = getattr(config, 'choices', None) or {}
   tuned_fans = choices.get('fanouts')
   if tuned_fans is not None and \
-      [int(k) for k in tuned_fans] != [int(k) for k in fanouts]:
+      _norm_fans(tuned_fans) != _norm_fans(fanouts):
     raise ValueError(
-        f'{name}: tune artifact pins fanouts {list(tuned_fans)} but '
-        f'this trainer streams at {[int(k) for k in fanouts]} — the '
+        f'{name}: tune artifact pins fanouts {tuned_fans} but '
+        f'this trainer streams at {_norm_fans(fanouts)} — the '
         'block frames were sized for a different sampling shape '
         '(docs/tuning.md)')
   tuned_bs = choices.get('batch_size')
@@ -315,14 +339,17 @@ def _resolve_remote_config(name: str, config, fanouts,
 
 class RemoteScanTrainer:
   """Scanned epochs over sampling-server block streams (module
-  docstring). Scope: homogeneous supervised node classification with
-  collected features and labels — the fused-trainer scope
-  (loader/pipeline.py), now reachable from the server-client topology.
+  docstring). Scope: supervised node classification with collected
+  features and labels, homogeneous or heterogeneous — typed seeds
+  select typed block streams whose closed shapes come from the
+  stream's CapacityPlan (docs/capacity_plans.md); the homo path is the
+  single-ntype degenerate plan of the same machinery.
 
   Args:
-    num_neighbors: fanouts (list).
-    input_nodes: untyped seed ids (split across the servers in rank
-      order — the per-batch remote loaders' share convention).
+    num_neighbors: fanouts (list, or per-etype dict for hetero).
+    input_nodes: seed ids — untyped array, or ``('ntype', ids)`` for
+      hetero graphs (split across the servers in rank order — the
+      per-batch remote loaders' share convention).
     model, tx, num_classes: the supervised training triple
     batch_size: per optimizer step.
     chunk_size: K, batches per block/chunk (the tail block compiles
@@ -383,10 +410,11 @@ class RemoteScanTrainer:
     if chunk_size < 1:
       raise ValueError(f'chunk_size must be >= 1, got {chunk_size}')
     input_type, input_nodes = _split_input_type(input_nodes)
-    if input_type is not None:
-      raise ValueError(f'{self._NAME} is homogeneous-only (the fused '
-                       'chunk program scope); typed seeds keep the '
-                       'per-batch remote loaders')
+    # typed seeds select the hetero block streams: the server derives
+    # the stream's CapacityPlan (docs/capacity_plans.md) from the typed
+    # share and the chunk program scans typed frames — the homo path is
+    # the single-ntype degenerate case of the same machinery
+    self._input_type = input_type
     if not collect_features:
       raise ValueError(f'{self._NAME} needs collect_features=True — '
                        'the chunk program trains on the block frames\' '
@@ -441,7 +469,10 @@ class RemoteScanTrainer:
     # server and num_workers=1 the streams are bit-identical
     splits = np.array_split(self.input_seeds, len(self.server_ranks))
     self._streams = []
-    for i, (rank, share) in enumerate(zip(self.server_ranks, splits)):
+    for i, (rank, split) in enumerate(zip(self.server_ranks, splits)):
+      from ..sampler import NodeSamplerInput
+      share = (NodeSamplerInput(split, input_type=self._input_type)
+               if self._input_type is not None else split)
       cfg_i = dataclasses.replace(self._config,
                                   seed=(seed or 0) * 7919 + i)
       pid = with_backpressure(
@@ -520,9 +551,18 @@ class RemoteScanTrainer:
       def body(carry, xs):
         state, ovf = carry
         x_s, r_s, c_s, em_s, y_s, ns_s, o_s = xs
-        batch = dict(x=(x_s.astype(jnp.float32) if upcast else x_s),
-                     edge_index=jnp.stack([r_s, c_s]),
-                     edge_mask=em_s, y=y_s, num_seed_nodes=ns_s)
+        up = (lambda a: a.astype(jnp.float32)) if upcast else (lambda a: a)
+        if isinstance(x_s, dict):
+          # typed block frame (docs/capacity_plans.md): per-ntype
+          # feature dicts, per-etype edge dicts — the same batch dict
+          # the collocated hetero collate builds (loader/pipeline.py)
+          batch = dict(x={t: up(v) for t, v in x_s.items()},
+                       edge_index={et: jnp.stack([r_s[et], c_s[et]])
+                                   for et in r_s},
+                       edge_mask=em_s, y=y_s, num_seed_nodes=ns_s)
+        else:
+          batch = dict(x=up(x_s), edge_index=jnp.stack([r_s, c_s]),
+                       edge_mask=em_s, y=y_s, num_seed_nodes=ns_s)
         state, loss, acc = train_step(state, batch)
         return (state, ovf | o_s), (loss, acc)
 
@@ -944,8 +984,22 @@ class RemoteScanTrainer:
   def _upload(self, frame: dict):
     """One explicit device upload of the block's training payload —
     the epoch region runs under strict_guards, so nothing may arrive
-    implicitly."""
+    implicitly. Typed frames (dotted keys, docs/capacity_plans.md)
+    upload as per-ntype / per-etype dicts in one device_put."""
     import jax
+    if self._input_type is not None:
+      t_in = self._input_type
+      x = _group_frame(frame, 'x')
+      row = _group_frame(frame, 'row', True)
+      col = _group_frame(frame, 'col', True)
+      em = _group_frame(frame, 'edge_mask', True)
+      y = np.asarray(frame[f'y.{t_in}'])
+      nseed = np.asarray(
+          frame[f'num_sampled_nodes.{t_in}'])[:, 0].astype(np.int32)
+      k = int(y.shape[0])
+      ovf_steps = np.asarray(frame.get(
+          '#META.overflow', np.zeros((k,), bool))).astype(bool)
+      return jax.device_put((x, row, col, em, y, nseed, ovf_steps))
     k = int(np.asarray(frame['row']).shape[0])
     ovf_steps = np.asarray(frame.get('#META.overflow',
                                      np.zeros((k,), bool))).astype(bool)
@@ -959,8 +1013,10 @@ class RemoteScanTrainer:
     """Host-side seed ack at CHUNK granularity: record the seed ids
     this block delivered (the per-batch ack protocol's provenance,
     lifted to the block) — chaos tests assert exact coverage from
-    this."""
+    this. Typed frames ack from the seed type's 'batch.<t>' key."""
     ids = frame.get('batch')
+    if ids is None and self._input_type is not None:
+      ids = frame.get(f'batch.{self._input_type}')
     if ids is None:
       return
     ids = np.asarray(ids)
@@ -976,9 +1032,12 @@ class RemoteScanTrainer:
   # -------------------------------------------------------------- config
 
   def _flight_config(self) -> dict:
+    fans = self._config.num_neighbors
     return dict(trainer=self._NAME, batch_size=self.batch_size,
                 chunk_size=self.chunk_size,
-                fanouts=list(self._config.num_neighbors),
+                input_type=self._input_type,
+                fanouts=(dict(fans) if isinstance(fans, dict)
+                         else list(fans)),
                 shuffle=self._shuffle, drop_last=self._drop_last,
                 num_classes=self.num_classes, seed=self.seed,
                 servers=list(self.server_ranks),

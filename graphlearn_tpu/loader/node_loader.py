@@ -132,6 +132,7 @@ class OverflowGuardMixin:
       # hetero sampling draws (hop, etype) keys from the sampler's
       # internal stream — no replayable per-batch key exists, so a
       # full-caps recompute could not reproduce the truncated draw
+      # graftlint: allow[hetero-gate] no replayable hetero batch key
       raise ValueError(
           "overflow_policy='recompute' is homogeneous-only (hetero "
           'batches have no replayable per-batch key); use '

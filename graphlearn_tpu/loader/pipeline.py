@@ -71,6 +71,10 @@ class FusedEpochTrainer:
                seed_labels_only: Optional[bool] = None):
     sampler = loader.sampler
     if getattr(sampler, 'is_hetero', False):
+      # the LOCAL fused trainer is the homo degenerate by design —
+      # typed datasets ride the dist/remote/tiered scan trainers whose
+      # CapacityPlans close the per-ntype shapes
+      # graftlint: allow[hetero-gate] local trainer is homo by design
       raise ValueError(f'{self._NAME} is homogeneous-only')
     if not sampler.fused:
       raise ValueError(f'{self._NAME} needs the fused sampler path')

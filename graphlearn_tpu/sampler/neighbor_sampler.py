@@ -728,6 +728,9 @@ class NeighborSampler(BaseSampler):
     under calibrated frontier_caps (fetch once per epoch, not per
     batch)."""
     if self.is_hetero:
+      # homo accessor by contract: the typed engine's capacities
+      # live in its per-etype CapacityPlan
+      # graftlint: allow[hetero-gate] homo accessor by contract
       raise ValueError('hop_caps is homogeneous-only (the typed engine '
                        'plans capacities per edge type)')
     return self._homo_capacities(batch_cap, tuple(self.num_neighbors))

@@ -43,9 +43,14 @@ TPU instance):
   bit-identically with a ``storage.dist_stage`` fault armed
   (docs/failure_model.md).
 
-Scope: homogeneous collocated meshes (flat or 2-axis hierarchical —
-the slab-backed lookup rides both exchange forms). Hetero dist stores
-keep the all-HBM ``DistScanTrainer``. Labels stay a full (small)
+Scope: collocated meshes (flat or 2-axis hierarchical — the
+slab-backed lookup rides both exchange forms), homogeneous or
+heterogeneous. Hetero stores are a ``{ntype: TieredDistFeature}``
+dict whose per-ntype closed shapes come from the stream's CapacityPlan
+(docs/capacity_plans.md): the prologue replays the typed engine
+id-only, plans ONE exchange per feature-bearing ntype, and each chunk
+stages one slab per ntype — the homo path is the single-ntype
+degenerate case of the same machinery. Labels stay a full (small)
 DistFeature. Single-process meshes: the prologue fetch and the stager
 read the whole [P, ...] request matrix / tier set locally.
 """
@@ -55,6 +60,7 @@ import numpy as np
 
 from .. import metrics
 from ..loader.scan_epoch import DistScanTrainer
+from ..sampler import CapacityPlanError
 from ..utils.faults import fault_point
 from ..utils.trace import record_dispatch
 from . import planner
@@ -116,22 +122,54 @@ class TieredDistScanTrainer(DistScanTrainer):
                perm_seed: Optional[int] = None, max_ahead: int = 2,
                stage_timeout_s: float = 30.0, config=None):
     sampler = getattr(loader, 'sampler', None)
-    if sampler is not None and getattr(sampler, 'is_hetero', False):
-      raise ValueError(
-          f'{self._NAME} is homogeneous-only — hetero dist stores keep '
-          'the all-HBM loader.DistScanTrainer (per-ntype slab staging '
-          'is tracked in ROADMAP)')
     store = getattr(sampler, 'dist_feature', None)
-    if not isinstance(store, TieredDistFeature):
-      raise ValueError(
-          f'{self._NAME} drives a storage.TieredDistFeature store '
-          f'(got {type(store).__name__}); use loader.DistScanTrainer '
-          'for all-HBM DistFeature partitions')
-    if store.hot_prefix_rows < 1:
-      raise ValueError(
-          f'{self._NAME} needs TieredDistFeature(hot_prefix_rows >= 1) '
-          '— the chunk program clamps pad positions into the hot '
-          'prefix')
+    # homo or hetero, ONE store contract: every feature store the chunk
+    # program reads must be a TieredDistFeature with a hot prefix — the
+    # per-ntype slab capacities of the hetero exchange (and the single
+    # slab of the homo degenerate plan) come from these stores' sorted
+    # row tables (docs/capacity_plans.md)
+    stores = store if isinstance(store, dict) else \
+        ({None: store} if store is not None else {})
+    bad = sorted(f'{t}:{type(s).__name__}' for t, s in stores.items()
+                 if not isinstance(s, TieredDistFeature))
+    if not stores or bad:
+      raise CapacityPlanError(
+          self._NAME,
+          'the feature store set carries no per-ntype slab capacities '
+          f'(non-tiered stores: {bad or "<empty>"})',
+          hint='build every feature store as storage.TieredDistFeature('
+               'hot_prefix_rows >= 1) so the exchange planner can close '
+               "each ntype's slab shapes; all-HBM DistFeature "
+               'partitions keep loader.DistScanTrainer')
+    low = sorted(str(t) for t, s in stores.items()
+                 if s.hot_prefix_rows < 1)
+    if low:
+      raise CapacityPlanError(
+          self._NAME,
+          f'stores {low} declare no hot prefix (hot_prefix_rows < 1)',
+          hint='the chunk program clamps pad positions into the hot '
+               'prefix — pass hot_prefix_rows >= 1 at store '
+               'construction')
+    # spilled partitions are named part_NNN inside spill_dir: two
+    # per-ntype stores sharing a directory overwrite each other's rows
+    # at construction and every later gather silently reads the LAST
+    # writer's features — a corruption, not a crash, so refuse loudly
+    import os as _os
+    dirs = {}
+    for t, s in stores.items():
+      d = getattr(s, '_spill_dir', None)
+      if d is not None:
+        dirs.setdefault(_os.path.realpath(d), []).append(str(t))
+    clash = sorted((d, sorted(ts)) for d, ts in dirs.items()
+                   if len(ts) > 1)
+    if clash:
+      raise CapacityPlanError(
+          self._NAME,
+          'per-ntype stores share a spill_dir — their part_NNN spill '
+          f'files overwrite each other ({clash})',
+          hint='give every ntype its own spill_dir (e.g. '
+               'os.path.join(root, ntype)) so each store keeps its own '
+               'sorted-row tables')
     if config is not None:
       # config= takes a tune artifact (docs/tuning.md 'Topology
       # candidates'). hot_prefix_rows is a STORE-construction knob —
@@ -140,30 +178,46 @@ class TieredDistScanTrainer(DistScanTrainer):
       # not a silent acceptance of untuned capacity
       tuned_hot = (config.choices or {}).get('hot_prefix_rows') \
           if hasattr(config, 'choices') else None
-      if tuned_hot is not None and \
-          int(tuned_hot) != int(store.hot_prefix_rows):
-        raise ValueError(
-            f'{self._NAME}: tune artifact pins hot_prefix_rows='
-            f'{int(tuned_hot)} but the TieredDistFeature store was '
-            f'built with hot_prefix_rows={int(store.hot_prefix_rows)} '
-            '— rebuild the store with the tuned value (the knob is '
-            'storage layout, not a trainer kwarg; docs/tuning.md)')
+      for t, s in stores.items():
+        want = (tuned_hot.get(t) if isinstance(tuned_hot, dict)
+                else tuned_hot)
+        if want is not None and int(want) != int(s.hot_prefix_rows):
+          raise ValueError(
+              f'{self._NAME}: tune artifact pins hot_prefix_rows='
+              f'{int(want)} but the TieredDistFeature store'
+              f'{"" if t is None else f" for ntype {t!r}"} was '
+              f'built with hot_prefix_rows={int(s.hot_prefix_rows)} '
+              '— rebuild the store with the tuned value (the knob is '
+              'storage layout, not a trainer kwarg; docs/tuning.md)')
     self._store = store
     super().__init__(loader, model, tx, num_classes, chunk_size,
                      seed_labels_only, perm_seed, config=config)
-    self._stager = DistChunkStager(store, max_ahead=max_ahead,
-                                   timeout_s=stage_timeout_s)
-    self.last_plan = None   # ExchangePlan of the most recent epoch
+    if self.is_hetero:
+      # one staging pipeline per sampled feature-bearing ntype — the
+      # CapacityPlan's node_caps pick the set; each ntype's slab caps
+      # close independently over its own plan
+      self._stagers = {t: DistChunkStager(self._feat[t],
+                                          max_ahead=max_ahead,
+                                          timeout_s=stage_timeout_s)
+                       for t in self._feat_types}
+      self._stager = None
+    else:
+      self._stager = DistChunkStager(store, max_ahead=max_ahead,
+                                     timeout_s=stage_timeout_s)
+      self._stagers = None
+    self.last_plan = None   # ExchangePlan(s) of the most recent epoch
 
   # ------------------------------------------------------------- programs
 
   def _make_sample_collate(self):
-    """The base homo sample+collate body with the SLAB-BACKED feature
+    """The base sample+collate body with the SLAB-BACKED feature
     lookup: ``views['f']`` carries (feat_ids, hot) instead of the full
     partition, and the body takes the chunk's per-shard slab views as
-    two extra arguments. The label store stays a full (small)
-    DistFeature."""
+    two extra trailing arguments (per-ntype dicts on hetero meshes).
+    The label store stays a full (small) DistFeature."""
     import jax.numpy as jnp
+    if self.is_hetero:
+      return self._make_hetero_sample_collate()
     sampler = self._sampler
     b = self._batch_size
     label_cap = self._label_cap
@@ -225,6 +279,80 @@ class TieredDistScanTrainer(DistScanTrainer):
 
     return shard_tree, repl_tree, body
 
+  def _make_hetero_sample_collate(self):
+    """Typed slab-backed collate: the base hetero body
+    (loader/pipeline.py _make_hetero_sample_collate) with every
+    per-ntype feature lookup resolved against (hot prefix + that
+    ntype's staged slab) instead of the full partition table. The
+    CapacityPlan's per-ntype ``node_caps`` size both the lookup bodies
+    and the prologue's replayed request matrices, so planned and
+    served can never disagree per type."""
+    import jax.numpy as jnp
+    sampler = self._sampler
+    b = self._batch_size
+    label_cap = self._label_cap
+    t_in = self._input_type
+    plan = sampler._hetero_plan({t_in: b})
+    _, _, node_caps = plan
+    feat_types = [t for t in sampler.graph.ntypes
+                  if node_caps.get(t, 0) > 0 and t in self._feat]
+    self._feat_types = feat_types
+    self._h_plan = plan     # the typed engine plan the seed fn replays
+    feat_bodies = {t: self._feat[t]._shard_body(node_caps[t], slab=True)
+                   for t in feat_types}
+    lab_body = self._label_store._shard_body(
+        label_cap if label_cap is not None else node_caps[t_in])
+    d = sampler._dev
+    gsh = {}
+    for et in sampler.graph.etypes:
+      ga = d[et]
+      gsh[et] = {k: ga[k] for k in ('row_ids', 'indptr', 'indices',
+                                    'eids')}
+      if sampler._weighted_for(et):
+        gsh[et]['wcum'] = ga['wcum']
+    # hot-prefix tables only, per ntype — no full [P, n_max, F] uploads
+    fdevs = {t: self._feat[t].dist_scan_tables() for t in feat_types}
+    ldev = self._label_store.device_arrays()
+    shard_tree = dict(
+        g=gsh,
+        f={t: {k: fdevs[t][k] for k in ('feat_ids', 'hot')}
+           for t in feat_types},
+        l={k: ldev[k] for k in ('feat_ids', 'feats')})
+    repl_tree = dict(
+        pb=dict(d['#pb']),
+        f={t: {k: fdevs[t][k] for k in ('feature_pb', 'cache_ids',
+                                        'cache_feats')}
+           for t in feat_types},
+        l={k: ldev[k] for k in ('feature_pb', 'cache_ids',
+                                'cache_feats')})
+
+    def body(views, repl, stats_rows, seeds, smask, key, slab_pos,
+             slab_rows):
+      res, _ = sampler._hetero_engine(views['g'], repl['pb'],
+                                      {t_in: (seeds, smask)}, key, plan)
+      x, new_rows = {}, {}
+      for t in feat_types:
+        ids = res['node'][t]
+        fv, frep = views['f'][t], repl['f'][t]
+        x[t], new_rows[t] = feat_bodies[t](
+            fv['feat_ids'], (fv['hot'], slab_pos[t], slab_rows[t]),
+            frep['feature_pb'], frep['cache_ids'], frep['cache_feats'],
+            stats_rows[t], ids, ids >= 0)
+      ids = res['node'][t_in]
+      lab_ids = ids[:label_cap] if label_cap is not None else ids
+      lv, lrep = views['l'], repl['l']
+      y, _ = lab_body(lv['feat_ids'], lv['feats'], lrep['feature_pb'],
+                      lrep['cache_ids'], lrep['cache_feats'],
+                      jnp.zeros((4,), jnp.int32), lab_ids, lab_ids >= 0)
+      ei = {et: jnp.stack([res['row'][et], res['col'][et]])
+            for et in res['row']}
+      batch = dict(x=x, edge_index=ei, edge_mask=res['edge_mask'],
+                   y=y[:, 0],
+                   num_seed_nodes=res['num_sampled_nodes'][t_in][0])
+      return batch, res['overflow'], new_rows
+
+    return shard_tree, repl_tree, body
+
   def _build_seed_fn(self):
     """The prologue PLAN program: the base seed/permutation math PLUS
     an id-only replay of the distributed sampler over every step inside
@@ -233,6 +361,8 @@ class TieredDistScanTrainer(DistScanTrainer):
     the keys are exactly the chunk programs'
     ``split(fold_in(base_key, count), P)[shard]`` stream, so the
     replayed requests ARE the chunk requests, bit for bit."""
+    if self.is_hetero:
+      return self._build_hetero_seed_fn()
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -301,6 +431,76 @@ class TieredDistScanTrainer(DistScanTrainer):
 
     return jax.jit(plan, static_argnums=(6,))
 
+  def _build_hetero_seed_fn(self):
+    """Typed prologue PLAN program: the same permutation math plus an
+    id-only replay of ``_hetero_engine`` over every step, emitting ONE
+    per-ntype request matrix dict ``{ntype: [P, steps, node_caps[t]]}``
+    — the CapacityPlan's per-ntype shapes, closed at trace time. Still
+    one ``dist_epoch_seeds`` dispatch; the keys are exactly the typed
+    chunk programs' ``split(fold_in(base_key, count), P)[shard]``
+    stream."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+    sampler = self._sampler
+    batch = self._batch_size
+    nparts = self._nparts
+    shuffle = self.loader.shuffle
+    t_in = self._input_type
+    eplan = self._h_plan          # set by _make_hetero_sample_collate
+    feat_types = list(self._feat_types)
+    ax = self._axes
+    mesh = self.mesh
+    gspec = jax.tree.map(lambda _: P(ax), self._shard_tree['g'])
+
+    def plan(gsh, pb, seeds, key, base_key, count0, steps):
+      def body(gsh_s, pb_s, seeds_s, key_s, base_key_s, count0_s):
+        gviews = jax.tree.map(lambda a: a[0], gsh_s)
+        my = jnp.int32(0)
+        for a in ax:
+          my = my * mesh.shape[a] + lax.axis_index(a)
+        n = seeds_s.shape[0]
+        order = (jax.random.permutation(key_s, n) if shuffle
+                 else jnp.arange(n, dtype=jnp.int32))
+        total = steps * nparts * batch
+        if total <= n:
+          ext = order[:total]
+          maskf = jnp.ones((total,), bool)
+        else:
+          pad = order[jnp.arange(total - n, dtype=jnp.int32) % n]
+          ext = jnp.concatenate([order, pad])
+          maskf = jnp.arange(total) < n
+        seed_all = seeds_s[ext].reshape(steps, nparts, batch)
+        mask_all = maskf.reshape(steps, nparts, batch)
+        seeds_my = jnp.take(seed_all, my, axis=1)    # [steps, B]
+        mask_my = jnp.take(mask_all, my, axis=1)
+        counts = count0_s + lax.iota(jnp.int32, steps)
+
+        def step(carry, xs):
+          s, m, cnt = xs
+          keys = jax.random.split(
+              jax.random.fold_in(base_key_s, cnt), nparts)
+          res, _ = sampler._hetero_engine(gviews, pb_s,
+                                          {t_in: (s, m)}, keys[my],
+                                          eplan)
+          return carry, {t: res['node'][t] for t in feat_types}
+
+        _, rows = lax.scan(step, 0, (seeds_my, mask_my, counts))
+        return (seeds_my[None], mask_my[None],
+                {t: rows[t][None] for t in feat_types})
+
+      fn = shard_map(body, mesh=mesh,
+                     in_specs=(gspec, P(), P(), P(), P(), P()),
+                     out_specs=(P(ax), P(ax),
+                                {t: P(ax) for t in feat_types}),
+                     check_replication=False)
+      return fn(gsh, pb, seeds, key, base_key, count0)
+
+    return jax.jit(plan, static_argnums=(6,))
+
   def _chunk_fn_for(self, k: int, cap: Optional[int] = None):
     """The slab-aware scanned K-step shard_map program, keyed by
     (chunk length, slab cap) — pow2 caps keep the executable set
@@ -330,8 +530,9 @@ class TieredDistScanTrainer(DistScanTrainer):
              ovf, seed_mat, mask_mat, base_key, count0, start, slab_pos,
              slab_rows):
       views = jax.tree.map(lambda a: a[0], shard_tree)
-      stats_rows = stats[0]
-      sp_v, sr_v = slab_pos[0], slab_rows[0]
+      stats_rows = jax.tree.map(lambda a: a[0], stats)
+      sp_v = jax.tree.map(lambda a: a[0], slab_pos)
+      sr_v = jax.tree.map(lambda a: a[0], slab_rows)
       seeds_k = lax.dynamic_slice_in_dim(seed_mat[0], start, k, 0)
       masks_k = lax.dynamic_slice_in_dim(mask_mat[0], start, k, 0)
       counts_k = count0 + start + lax.iota(jnp.int32, k)
@@ -354,15 +555,20 @@ class TieredDistScanTrainer(DistScanTrainer):
       (params, opt_state, stepc, ovf, srows), (losses, accs) = lax.scan(
           step, (params, opt_state, stepc, ovf, stats_rows),
           (seeds_k, masks_k, counts_k))
-      return (params, opt_state, stepc, ovf, srows[None], losses, accs)
+      return (params, opt_state, stepc, ovf,
+              jax.tree.map(lambda a: a[None], srows), losses, accs)
 
     sh = jax.tree.map(lambda _: P(ax), self._shard_tree)
     rp = jax.tree.map(lambda _: P(), self._repl_tree)
+    stats_spec = (P(ax) if not self.is_hetero
+                  else {t: P(ax) for t in self._feat_types})
+    slab_spec = (P(ax) if not self.is_hetero
+                 else {t: P(ax) for t in self._feat_types})
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(sh, rp, P(ax), P(), P(), P(), P(), P(ax), P(ax),
-                  P(), P(), P(), P(ax), P(ax)),
-        out_specs=(P(), P(), P(), P(), P(ax), P(), P()),
+        in_specs=(sh, rp, stats_spec, P(), P(), P(), P(), P(ax), P(ax),
+                  P(), P(), P(), slab_spec, slab_spec),
+        out_specs=(P(), P(), P(), P(), stats_spec, P(), P()),
         check_replication=False)
     jfn = programs.instrument(
         jax.jit(fn, donate_argnums=(2, 3, 4, 5, 6)), 'dist_scan_chunk')
@@ -374,62 +580,103 @@ class TieredDistScanTrainer(DistScanTrainer):
   def _epoch_prologue(self, perm_key, full_steps, steps, start_step,
                       base_key, count0):
     """One plan dispatch + the prologue's ONE explicit fetch: the
-    replayed request matrix becomes the per-chunk miss-exchange
-    program, and staging starts at the resume chunk (consumed chunks
-    never stage again)."""
+    replayed request matrix (per-ntype matrices on hetero meshes)
+    becomes the per-chunk miss-exchange program — one ExchangePlan per
+    feature-bearing ntype — and staging starts at the resume chunk
+    (consumed chunks never stage again)."""
     import jax
     record_dispatch('dist_epoch_seeds')
     seed_mat, mask_mat, rows_mat = self._seed_fn(
         self._shard_tree['g'], self._repl_tree['pb'], self._seeds_dev,
         perm_key, base_key, count0, full_steps)
     # explicit device_get — strict_guards rejects implicit transfers only
-    rows_host = np.asarray(jax.device_get(rows_mat))[:, :steps]
-    plan = planner.plan_exchange(
-        rows_host, self.chunk_size, self._store.feature_pb,
-        self._store.feat_ids, self._store.hot_prefix_rows,
-        cache_ids=self._store.cache_ids)
-    self.last_plan = plan
-    self._stager.begin_epoch(plan.chunk_rows,
-                             start_chunk=start_step // self.chunk_size)
+    rows_host = jax.device_get(rows_mat)
+    start_chunk = start_step // self.chunk_size
+    if self.is_hetero:
+      plans = {}
+      for t in self._feat_types:
+        st = self._feat[t]
+        plans[t] = planner.plan_exchange(
+            np.asarray(rows_host[t])[:, :steps], self.chunk_size,
+            st.feature_pb, st.feat_ids, st.hot_prefix_rows,
+            cache_ids=st.cache_ids)
+        self._stagers[t].begin_epoch(plans[t].chunk_rows,
+                                     start_chunk=start_chunk)
+      self.last_plan = plans
+    else:
+      plan = planner.plan_exchange(
+          np.asarray(rows_host)[:, :steps], self.chunk_size,
+          self._store.feature_pb, self._store.feat_ids,
+          self._store.hot_prefix_rows, cache_ids=self._store.cache_ids)
+      self.last_plan = plan
+      self._stager.begin_epoch(plan.chunk_rows, start_chunk=start_chunk)
     return seed_mat, mask_mat
 
   def _dispatch_chunk(self, c, k, stats, params, opt_state, stepc, ovf,
                       seed_mat, mask_mat, base_key, count0, start_dev):
-    """Take chunk ``c``'s staged slab (or degrade to a synchronous
-    gather of the same planned positions), upload it sharded over the
+    """Take chunk ``c``'s staged slab(s) (or degrade to a synchronous
+    gather of the same planned positions), upload them sharded over the
     mesh (explicit device_puts — the strict region stays clean), and
-    dispatch the (k, cap) program. The ack frees the host ring slot;
-    the device copies belong to the in-flight program."""
+    dispatch the (k, caps) program. Hetero chunks stage one slab per
+    feature-bearing ntype; the executable is keyed by the per-ntype
+    pow2 cap tuple so the compiled set stays closed. The ack frees the
+    host ring slots; the device copies belong to the in-flight
+    program."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..utils import global_device_put
-    slab_pos_np, slab_rows_np = self._stager.take(c)
     sharded = NamedSharding(self.mesh, P(self._axes))
-    slab_pos = global_device_put(slab_pos_np, sharded)
-    slab_rows = global_device_put(slab_rows_np, sharded)
+    if self.is_hetero:
+      slab_np = {t: self._stagers[t].take(c) for t in self._feat_types}
+      slab_pos = {t: global_device_put(v[0], sharded)
+                  for t, v in slab_np.items()}
+      slab_rows = {t: global_device_put(v[1], sharded)
+                   for t, v in slab_np.items()}
+      cap = tuple(int(slab_np[t][0].shape[1]) for t in self._feat_types)
+    else:
+      slab_pos_np, slab_rows_np = self._stager.take(c)
+      slab_pos = global_device_put(slab_pos_np, sharded)
+      slab_rows = global_device_put(slab_rows_np, sharded)
+      cap = int(slab_pos_np.shape[1])
     record_dispatch('dist_scan_chunk')
-    out = self._chunk_fn_for(k, int(slab_pos_np.shape[1]))(
+    out = self._chunk_fn_for(k, cap)(
         self._shard_tree, self._repl_tree, stats, params, opt_state,
         stepc, ovf, seed_mat, mask_mat, base_key, count0, start_dev,
         slab_pos, slab_rows)
-    self._stager.ack(c)
+    if self.is_hetero:
+      for t in self._feat_types:
+        self._stagers[t].ack(c)
+    else:
+      self._stager.ack(c)
     return out
 
   # ---------------------------------------------------------- lifecycle
 
   def _flight_config(self) -> dict:
     cfg = super()._flight_config()
-    cfg.update(hot_prefix_rows=self._store.hot_prefix_rows,
-               n_max=self._store.n_max)
+    if self.is_hetero:
+      cfg.update(
+          hot_prefix_rows={t: self._feat[t].hot_prefix_rows
+                           for t in self._feat_types},
+          n_max={t: self._feat[t].n_max for t in self._feat_types})
+    else:
+      cfg.update(hot_prefix_rows=self._store.hot_prefix_rows,
+                 n_max=self._store.n_max)
     return cfg
 
   def _recovery_capture(self, carry):
     """DistScanTrainer's capture plus the staging-ring watermarks
     (diagnostic — a resume re-plans and re-stages)."""
     meta, dev = super()._recovery_capture(carry)
-    meta['staging'] = self._stager.watermarks()
+    meta['staging'] = ({t: self._stagers[t].watermarks()
+                        for t in self._feat_types}
+                       if self.is_hetero else self._stager.watermarks())
     return meta, dev
 
   def close(self):
-    """Stop the staging worker thread."""
-    self._stager.close()
+    """Stop the staging worker thread(s)."""
+    if self._stagers is not None:
+      for st in self._stagers.values():
+        st.close()
+    if self._stager is not None:
+      self._stager.close()

@@ -99,17 +99,10 @@ _VERSION_CHOICE_KEYS = {
 }
 
 
-def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
-  """Identity of the graph a config was tuned FOR: shape counts plus a
-  sha1 of the degree sequence (the host-side Topology CSR — never a
-  device fetch, the calibrate.py convention). Returns None when the
-  dataset carries no homogeneous graph to fingerprint (hetero dicts,
-  partition-only dist datasets) — validation then degrades to a
-  warning, never a spurious refusal."""
-  graph = getattr(dataset, 'graph', dataset)
-  if graph is None or isinstance(graph, dict) or \
-      getattr(graph, 'is_hetero', False):
-    return None
+def _csr_fingerprint(graph) -> Optional[Dict[str, Any]]:
+  """Identity of ONE CSR (local Graph/Topology or stacked DistGraph):
+  shape counts plus a sha1 of the degree sequence — host-side arrays
+  only, never a device fetch (the calibrate.py convention)."""
   src = getattr(graph, 'topo', graph)
   indptr = getattr(src, 'indptr', None)
   if indptr is None:
@@ -127,15 +120,11 @@ def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
         degree_sha1=hashlib.sha1(
             np.ascontiguousarray(deg).tobytes()).hexdigest()[:16])
     node_pb = getattr(graph, 'node_pb', None)
-    if node_pb is not None:
+    if node_pb is not None and not isinstance(node_pb, dict):
       node_pb = np.asarray(node_pb, np.int64)
       fp['num_nodes'] = int(node_pb.shape[0])
       fp['node_pb_sha1'] = hashlib.sha1(
           np.ascontiguousarray(node_pb).tobytes()).hexdigest()[:16]
-    feats = getattr(dataset, 'node_features', None)
-    fdim = getattr(feats, 'feature_dim', None)
-    if fdim is not None:
-      fp['feature_dim'] = int(fdim)
     return fp
   deg = np.diff(indptr)
   fp = dict(
@@ -154,11 +143,78 @@ def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
     fp['edges_sha1'] = hashlib.sha1(
         np.ascontiguousarray(idx[::stride].astype(np.int64))
         .tobytes()).hexdigest()[:16]
+  return fp
+
+
+def _feature_dim(store) -> Optional[int]:
+  fdim = getattr(store, 'feature_dim', None)
+  if fdim is not None:
+    return int(fdim)
+  shape = getattr(store, 'shape', None)
+  if shape is not None and len(shape) > 1:
+    return int(shape[1])
+  return None
+
+
+def _hetero_fingerprint(dataset, graph) -> Optional[Dict[str, Any]]:
+  """Typed dataset identity: one per-etype CSR fingerprint (local dict
+  graphs and DistHeteroGraph sub-CSRs alike) plus per-ntype partition
+  books and feature dims — the identity a hetero CapacityPlan's closed
+  shapes are derived from (docs/capacity_plans.md)."""
+  from ..typing import as_str
+  subs = graph if isinstance(graph, dict) else \
+      getattr(graph, 'sub', None)
+  if not subs:
+    return None
+  etypes = {}
+  for et in sorted(subs, key=str):
+    sub_fp = _csr_fingerprint(subs[et])
+    if sub_fp is not None:
+      etypes[as_str(et) if isinstance(et, tuple) else str(et)] = sub_fp
+  if not etypes:
+    return None
+  fp: Dict[str, Any] = dict(hetero=True, etypes=etypes)
+  node_pb = getattr(graph, 'node_pb', None)
+  if isinstance(node_pb, dict):
+    fp['num_partitions'] = int(getattr(graph, 'num_partitions', 0))
+    fp['num_nodes'] = {str(t): int(np.asarray(pb).shape[0])
+                       for t, pb in sorted(node_pb.items())}
+    fp['node_pb_sha1'] = {
+        str(t): hashlib.sha1(
+            np.ascontiguousarray(np.asarray(pb, np.int64))
+            .tobytes()).hexdigest()[:16]
+        for t, pb in sorted(node_pb.items())}
+  feats = getattr(dataset, 'node_features', None)
+  if isinstance(feats, dict):
+    dims = {str(t): _feature_dim(s) for t, s in sorted(feats.items())}
+    dims = {t: d for t, d in dims.items() if d is not None}
+    if dims:
+      fp['feature_dim'] = dims
+  return fp
+
+
+def dataset_fingerprint(dataset) -> Optional[Dict[str, Any]]:
+  """Identity of the graph a config was tuned FOR: shape counts plus a
+  sha1 of the degree sequence per CSR (the host-side Topology arrays —
+  never a device fetch, the calibrate.py convention). Hetero datasets
+  (dict graphs, DistHeteroGraph) fingerprint TYPED: one record per
+  edge type plus per-ntype partition books and feature dims, so a
+  hetero artifact validates on load exactly like a homo one. Returns
+  None only when the dataset carries no graph structure at all —
+  validation then degrades to a warning, never a spurious refusal."""
+  graph = getattr(dataset, 'graph', dataset)
+  if graph is None:
+    return None
+  if isinstance(graph, dict) or getattr(graph, 'is_hetero', False):
+    return _hetero_fingerprint(dataset, graph)
+  fp = _csr_fingerprint(graph)
+  if fp is None:
+    return None
   feats = getattr(dataset, 'node_features', None)
   if feats is not None and not isinstance(feats, dict):
-    shape = getattr(feats, 'shape', None)
-    if shape is not None and len(shape) > 1:
-      fp['feature_dim'] = int(shape[1])
+    fdim = _feature_dim(feats)
+    if fdim is not None:
+      fp['feature_dim'] = int(fdim)
   return fp
 
 
@@ -286,9 +342,10 @@ class TuneArtifact:
   def validate_dataset(self, dataset, where: str = 'config'):
     """Refuse a dataset that drifted from the one this config was
     tuned for — a tuned cap/cache/chunk assignment on a different
-    graph silently loses the evidence behind every choice. Degrades to
-    a no-op when either side has no computable fingerprint (hetero /
-    partitioned datasets)."""
+    graph silently loses the evidence behind every choice. Hetero
+    datasets validate TYPED (per-etype CSR records, per-ntype books);
+    degrades to a warning only when the dataset has no computable
+    fingerprint at all (e.g. a remote client holding no graph)."""
     if self.dataset is None:
       return
     fp = dataset_fingerprint(dataset)

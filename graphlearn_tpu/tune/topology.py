@@ -142,12 +142,39 @@ def default_topology_candidates(topology: str, cfg: Dict,
 # ------------------------------------------------------------ analytics
 
 
-def _node_budget(fanouts: Sequence[int], batch_size: int) -> int:
+def _choice_fanouts(fanouts):
+  """Artifact-choice form of a fanout spec: typed dicts serialize with
+  canonical string etype keys (typing.as_str) so the JSON round-trip
+  is loss-free; flat lists stay flat."""
+  if isinstance(fanouts, dict):
+    from ..typing import as_str
+    return {(as_str(et) if isinstance(et, (list, tuple)) else str(et)):
+            [int(k) for k in f]
+            for et, f in sorted(fanouts.items(), key=lambda kv:
+                                str(kv[0]))}
+  return [int(k) for k in fanouts]
+
+
+def _flatten_fanouts(fanouts) -> List[int]:
+  """Per-hop effective fan-out of a fanout spec. A typed dict sums the
+  per-etype counts hop-wise — a frontier node can fan out along every
+  relation at once, so the analytic budget is the hop-wise SUM, the
+  same worst case the hetero CapacityPlan closes its shapes over
+  (docs/capacity_plans.md)."""
+  if isinstance(fanouts, dict):
+    hops = max(len(f) for f in fanouts.values())
+    return [sum(int(f[h]) for f in fanouts.values() if h < len(f))
+            for h in range(hops)]
+  return [int(k) for k in fanouts]
+
+
+def _node_budget(fanouts, batch_size: int) -> int:
   """Worst-case per-step frontier node budget (seeds + every hop's
   full fan-out) — the static plan the feasibility analytics size
-  against when the caller supplies no calibrated caps."""
+  against when the caller supplies no calibrated caps. Accepts a flat
+  per-hop list or a typed per-etype dict."""
   total, width = batch_size, batch_size
-  for k in fanouts:
+  for k in _flatten_fanouts(fanouts):
     width *= int(k)
     total += width
   return int(total)
@@ -168,7 +195,8 @@ def screen_candidate(topology: str, cand: TopologyCandidate,
         f'candidate {cand.name!r} names knobs {sorted(unknown)} '
         f'outside the {topology!r} field {sorted(TOPOLOGY_KNOBS[topology])} '
         '(docs/tuning.md "Topology candidates")')
-  fanouts = [int(k) for k in cfg['fanouts']]
+  fanouts = cfg['fanouts'] if isinstance(cfg['fanouts'], dict) \
+      else [int(k) for k in cfg['fanouts']]
   batch = int(cfg['batch_size'])
   feat_dim = cfg.get('feat_dim')
   width = int(cfg.get('request_width') or _node_budget(fanouts, batch))
@@ -342,7 +370,7 @@ def tune_topology(topology: str, dataset, loader_cfg: Dict, *,
   ``edge_cap``) and quotas (``max_exchange_mb``, ``max_block_mb``,
   ``max_slab_rows``). ``epoch_steps`` (or ``input_nodes``) sizes the
   chunk-K probe."""
-  from .tuner import _check_homo, _pick_winner
+  from .tuner import _pick_winner
   if topology not in TOPOLOGY_SITES or topology == 'local':
     raise ValueError(
         f'unknown tune topology {topology!r} — the scenario set is '
@@ -361,14 +389,17 @@ def tune_topology(topology: str, dataset, loader_cfg: Dict, *,
     raise ValueError("loader_cfg needs 'fanouts' and 'batch_size' — "
                      'they pin the artifact choices and size the '
                      'feasibility analytics')
-  _check_homo(dataset, f'tune(topology={topology!r})')
   evidence: List[dict] = []
   with spans.span('tune.run', topology=topology, exact=exact):
     if 'epoch_steps' in cfg:
       steps = int(cfg['epoch_steps'])
     elif 'input_nodes' in cfg:
+      inp = cfg['input_nodes']
+      if isinstance(inp, tuple) and len(inp) == 2 and \
+          isinstance(inp[0], str):
+        inp = inp[1]  # typed seeds: ('ntype', ids)
       steps = probes.epoch_steps(
-          np.asarray(cfg['input_nodes']).reshape(-1).shape[0],
+          np.asarray(inp).reshape(-1).shape[0],
           int(cfg['batch_size']), bool(cfg.get('drop_last', False)))
     else:
       steps = 2 * probes.CHUNK_K_LADDER[-1]
@@ -440,7 +471,7 @@ def tune_topology(topology: str, dataset, loader_cfg: Dict, *,
         slab_cap=knobs.get('slab_cap'),
         serving_buckets=None,
         batch_size=int(cfg['batch_size']),
-        fanouts=[int(k) for k in cfg['fanouts']],
+        fanouts=_choice_fanouts(cfg['fanouts']),
         exact=bool(exact),
         topology=topology,
         hot_prefix_rows=knobs.get('hot_prefix_rows'),

@@ -173,22 +173,33 @@ def default_candidates(caps: List[int], exact: bool,
   return cands
 
 
-def _check_homo(dataset, where: str):
-  """The documented hetero error path (docs/tuning.md 'Scope and
-  hetero datasets'): a
-  hetero dataset has no homogeneous fingerprint and no homo scan
-  trainer to A/B, so tuning one must refuse LOUDLY — the silent
-  degrade-to-warning path let a hetero artifact ship without a typed
-  identity (ROADMAP item 3 edge)."""
+def _is_hetero_dataset(dataset) -> bool:
+  """Typed-dataset dispatch for tune(): hetero datasets route to the
+  typed candidate field (per-etype fanouts, RGNN proxy, hetero
+  fingerprint — docs/capacity_plans.md) instead of the homo probe
+  chain."""
   graph = getattr(dataset, 'graph', dataset)
-  if isinstance(graph, dict) or getattr(graph, 'is_hetero', False) or \
-      isinstance(getattr(dataset, 'node_features', None), dict):
-    raise TypeError(
-        f'{where} is homogeneous-only: hetero datasets have no typed '
-        'dataset fingerprint, so a hetero artifact could never be '
-        'validated on load. Tune the homo projection of each edge '
-        'type, or keep hand-picked knobs for hetero scenarios '
-        '(docs/tuning.md "Scope and hetero datasets")')
+  return isinstance(graph, dict) or \
+      bool(getattr(graph, 'is_hetero', False)) or \
+      isinstance(getattr(dataset, 'node_features', None), dict)
+
+
+def hetero_fanout_candidates(fanouts: Dict) -> List:
+  """The typed candidate field: the requested per-etype fanout dict as
+  the base, plus one per-etype trimmed variant (that edge type's
+  per-hop fanouts halved). Each variant changes exactly ONE type's
+  closed shapes, so the A/B isolates which relation's frontier the
+  wall is actually paying for (docs/tuning.md 'Hetero datasets')."""
+  from ..typing import as_str
+  base = {et: [int(k) for k in f] for et, f in fanouts.items()}
+  out = [Candidate('typed_base', dict(fanouts=base))]
+  for et in sorted(base, key=str):
+    if max(base[et]) <= 1:
+      continue  # nothing left to trim on this relation
+    trimmed = {e: list(f) for e, f in base.items()}
+    trimmed[et] = [max(1, k // 2) for k in base[et]]
+    out.append(Candidate(f'trim_{as_str(et)}', dict(fanouts=trimmed)))
+  return out
 
 
 def _refuse_padded_candidates(cands: Sequence[Candidate]):
@@ -223,8 +234,19 @@ def _norm_cfg(loader_cfg: Dict) -> Dict:
                        'fanout list)')
   if 'input_nodes' not in cfg:
     raise ValueError("loader_cfg needs 'input_nodes' (the seed pool)")
-  cfg['fanouts'] = [int(k) for k in cfg['fanouts']]
-  cfg['input_nodes'] = np.asarray(cfg['input_nodes']).reshape(-1)
+  if isinstance(cfg['fanouts'], dict):
+    # typed fanouts: {edge_type: [per-hop counts]} — the hetero
+    # CapacityPlan inputs (docs/capacity_plans.md)
+    cfg['fanouts'] = {et: [int(k) for k in f]
+                     for et, f in cfg['fanouts'].items()}
+  else:
+    cfg['fanouts'] = [int(k) for k in cfg['fanouts']]
+  inp = cfg['input_nodes']
+  if isinstance(inp, tuple) and len(inp) == 2 and isinstance(inp[0], str):
+    # typed seeds: ('ntype', ids) — the hetero loader convention
+    cfg['input_nodes'] = (inp[0], np.asarray(inp[1]).reshape(-1))
+  else:
+    cfg['input_nodes'] = np.asarray(inp).reshape(-1)
   cfg.setdefault('batch_size', 64)
   cfg.setdefault('shuffle', False)
   cfg.setdefault('drop_last', False)
@@ -236,10 +258,14 @@ def _num_classes(dataset, cfg: Dict) -> int:
   if cfg.get('num_classes'):
     return int(cfg['num_classes'])
   labels = getattr(dataset, 'node_labels', None)
+  if isinstance(labels, dict) and isinstance(cfg['input_nodes'], tuple):
+    seed_t = cfg['input_nodes'][0]
+    if seed_t in labels and labels[seed_t] is not None:
+      return int(np.asarray(labels[seed_t]).max()) + 1
   if labels is None or isinstance(labels, dict):
     raise ValueError("pass loader_cfg['num_classes'] — the dataset "
-                     'carries no homogeneous label array to infer it '
-                     'from')
+                     'carries no label array for the seed pool to '
+                     'infer it from')
   return int(np.asarray(labels).max()) + 1
 
 
@@ -247,6 +273,20 @@ def _default_model(cfg: Dict, num_classes: int):
   from ..models import GraphSAGE
   return GraphSAGE(hidden_dim=16, out_dim=num_classes,
                    num_layers=len(cfg['fanouts']))
+
+
+def _default_hetero_model(fanouts: Dict, seed_type: str,
+                          num_classes: int):
+  # proxy model for typed ranking: same shape family the hetero
+  # trainers run (RGNN over reversed relations, logits on the seed
+  # type) — candidate RANKING is program-shape-driven, so a small
+  # proxy suffices exactly as in the homo path
+  from ..models import RGNN
+  from ..typing import reverse_edge_type
+  etypes = tuple(reverse_edge_type(et) for et in sorted(fanouts))
+  layers = max(len(f) for f in fanouts.values())
+  return RGNN(etypes=etypes, hidden_dim=16, out_dim=num_classes,
+              num_layers=layers, out_ntype=seed_type)
 
 
 def _site_compiles() -> Dict[str, int]:
@@ -349,6 +389,108 @@ def score_candidate(cand: Candidate, dataset, cfg: Dict, num_classes:
   return rec
 
 
+def score_hetero_candidate(cand: Candidate, dataset, cfg: Dict,
+                           num_classes: int, chunk_k: int,
+                           probe_steps: Optional[int], model=None,
+                           tx=None) -> dict:
+  """Run one typed fanout candidate's compile + steady epochs over the
+  per-batch hetero NeighborLoader and return its evidence record. The
+  observatory sites only see scanned programs, so the retrace check
+  here counts TRACES of the jitted train step directly: a steady epoch
+  that traces anything means the candidate's typed shapes are not
+  closed — disqualified by the same rule as the homo path."""
+  import jax
+  import jax.numpy as jnp
+  import optax
+
+  from .. import loader as loader_mod
+  from ..typing import as_str
+  fans = cand.loader_kwargs['fanouts']
+  rec = dict(kind='candidate', name=cand.name,
+             fanouts={as_str(et): list(f)
+                      for et, f in sorted(fans.items(), key=str)},
+             chunk_k=int(cand.chunk_k or chunk_k),
+             exact_semantics=True, kernel=dict(cand.kernel))
+  metrics.inc('tune.candidates')
+  t_start = time.perf_counter()
+  try:
+    with spans.span('tune.candidate', candidate=cand.name,
+                    chunk_k=int(cand.chunk_k or chunk_k)):
+      apply_kernel_routing(dataset, cand.kernel)
+      seed_t, seeds = cfg['input_nodes']
+      make_loader = lambda: loader_mod.NeighborLoader(
+          dataset, fans, (seed_t, seeds),
+          batch_size=cfg['batch_size'], shuffle=cfg['shuffle'],
+          drop_last=cfg['drop_last'], seed=cfg['seed'])
+      mdl = model or _default_hetero_model(fans, seed_t, num_classes)
+      if tx is None:
+        tx = optax.adam(1e-3)
+      b0 = next(iter(make_loader()))
+      params = mdl.init(jax.random.PRNGKey(0), b0.x, b0.edge_index,
+                        b0.edge_mask)
+      opt_state = tx.init(params)
+      traces = dict(n=0)
+
+      def _step(params, opt_state, x, ei, em, y, num_seed):
+        traces['n'] += 1  # python body runs once per TRACE only
+
+        def loss_fn(p):
+          logits = mdl.apply(p, x, ei, em)
+          seed_mask = jnp.arange(logits.shape[0]) < num_seed
+          ce = optax.softmax_cross_entropy(
+              logits, jax.nn.one_hot(y, num_classes))
+          return jnp.where(seed_mask, ce, 0.0).sum() / \
+              jnp.maximum(seed_mask.sum(), 1)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+      step = jax.jit(_step)
+      steps = probes.epoch_steps(seeds.shape[0], cfg['batch_size'],
+                                 cfg['drop_last'])
+      k = int(cand.chunk_k or chunk_k)
+      if probe_steps is None:
+        probe_steps = min(steps, 2 * k)
+      probe_steps = max(1, min(steps, probe_steps))
+
+      def run_epoch(params, opt_state):
+        loss = None
+        for n, b in enumerate(make_loader()):
+          if n >= probe_steps:
+            break
+          params, opt_state, loss = step(
+              params, opt_state, b.x, b.edge_index, b.edge_mask,
+              b.y[seed_t], b.num_sampled_nodes[seed_t][0])
+        if loss is not None:
+          jax.block_until_ready(loss)
+        return params, opt_state
+
+      params, opt_state = run_epoch(params, opt_state)  # compile epoch
+      after_compile = traces['n']
+      t0 = time.perf_counter()
+      params, opt_state = run_epoch(params, opt_state)  # steady epoch
+      wall = time.perf_counter() - t0
+      steady = traces['n'] - after_compile
+      rec['probe_steps'] = int(probe_steps)
+      rec['compile_epoch_compiles'] = dict(hetero_step=after_compile)
+      rec['steady_epoch_compiles'] = dict(hetero_step=steady)
+      rec['wall_s'] = round(wall, 6)
+      rec['qualified'] = steady == 0
+      if steady:
+        rec['rejected'] = (
+            f'steady-state epoch traced {steady} program(s) — a tuned '
+            'typed config must dispatch a CLOSED executable set')
+        metrics.inc('tune.rejected')
+  except Exception as e:  # a broken candidate is evidence, not a crash
+    rec['qualified'] = False
+    rec['rejected'] = f'{type(e).__name__}: {e}'[:300]
+    metrics.inc('tune.rejected')
+  metrics.observe('tune.probe_ms',
+                  (time.perf_counter() - t_start) * 1e3)
+  return rec
+
+
 def _per_step_wall(rec: dict) -> float:
   # candidates with different chunk_k run different probe_steps (each
   # epoch rounds to its own chunk boundary) — raw wall_s would compare
@@ -433,8 +575,16 @@ def tune(dataset, loader_cfg: Dict, *, topology: str = 'local',
                          candidates=candidates,
                          probe_steps=probe_steps, budget_s=budget_s,
                          out_path=out_path)
-  _check_homo(dataset, 'tune()')
   cfg = _norm_cfg(loader_cfg)
+  if _is_hetero_dataset(dataset):
+    # typed datasets field the per-etype fanout candidates and sign a
+    # TYPED fingerprint — one artifact, validated on load by every
+    # config= acceptor exactly like a homo one (docs/capacity_plans.md)
+    return _tune_hetero_local(dataset, cfg, exact=exact,
+                              candidates=candidates,
+                              probe_steps=probe_steps, model=model,
+                              tx=tx, budget_s=budget_s,
+                              out_path=out_path)
   num_classes = _num_classes(dataset, cfg)
   evidence: List[dict] = []
   with spans.span('tune.run', exact=exact):
@@ -522,6 +672,78 @@ def tune(dataset, loader_cfg: Dict, *, topology: str = 'local',
           note='dataset has no computable fingerprint — config= '
                'acceptors will warn instead of validating '
                '(docs/tuning.md "Fingerprints")'))
+    art = TuneArtifact(choices, fp, evidence)
+  metrics.inc('tune.artifacts')
+  if out_path is not None:
+    art.save(out_path)
+  return art
+
+
+def _tune_hetero_local(dataset, cfg: Dict, *, exact: bool,
+                       candidates: Optional[Sequence[Candidate]],
+                       probe_steps: Optional[int], model, tx,
+                       budget_s: Optional[float],
+                       out_path: Optional[str]) -> TuneArtifact:
+  """tune() over a typed dataset: field the per-etype fanout candidate
+  ladder (hetero_fanout_candidates), score each by compile + steady
+  per-batch epochs with the RGNN proxy, and sign the winner into a v3
+  artifact with the TYPED dataset fingerprint — per-etype CSR records
+  the config= acceptors validate on load (docs/capacity_plans.md,
+  docs/tuning.md 'Hetero datasets')."""
+  if not isinstance(cfg['fanouts'], dict):
+    raise ValueError(
+        "tune() on a typed dataset needs loader_cfg['fanouts'] as an "
+        '{edge_type: [per-hop counts]} dict — the per-etype closed '
+        'shapes are the thing being tuned (docs/capacity_plans.md)')
+  if not isinstance(cfg['input_nodes'], tuple):
+    raise ValueError(
+        "tune() on a typed dataset needs loader_cfg['input_nodes'] as "
+        "('ntype', ids) — the seed type picks the label store and the "
+        'proxy head (docs/tuning.md "Hetero datasets")')
+  num_classes = _num_classes(dataset, cfg)
+  evidence: List[dict] = []
+  with spans.span('tune.run', exact=exact, hetero=True):
+    seed_t, seeds = cfg['input_nodes']
+    steps = probes.epoch_steps(seeds.shape[0], cfg['batch_size'],
+                               cfg['drop_last'])
+    chunk_k, ev = probes.probe_chunk_k(steps)
+    evidence.append(ev)
+    buckets, ev = probes.probe_serving_buckets(cfg['batch_size'])
+    evidence.append(ev)
+    wire, ev = probes.wire_dtype_choice(exact)
+    evidence.append(ev)
+
+    cands = list(candidates) if candidates is not None \
+        else hetero_fanout_candidates(cfg['fanouts'])
+    records: List[dict] = []
+    pending = list(cands)
+    while pending:
+      cand = pending.pop(0)
+      records.append(score_hetero_candidate(
+          cand, dataset, cfg, num_classes, chunk_k, probe_steps,
+          model=model, tx=tx))
+      if budget_s is not None and len(records) == 1 and pending:
+        from .topology import _budget_ladder
+        pending, ev = _budget_ladder(records, pending, budget_s,
+                                     records[0].get('wall_s') or 0.0)
+        evidence.append(ev)
+    evidence.extend(records)
+    best = _pick_winner(records)
+    evidence.append(dict(kind='winner', name=best['name'],
+                         wall_s=best['wall_s'],
+                         tie_break=best.get('tie_break', 'wall'),
+                         fanouts=dict(best['fanouts'])))
+    choices = dict(
+        mode='map',  # the hetero engine runs the exact-dedup path
+        frontier_caps=None,  # typed caps live in the CapacityPlan
+        padded_window=None,
+        wire_dtype=wire,
+        chunk_k=int(chunk_k),
+        serving_buckets=list(buckets),
+        batch_size=int(cfg['batch_size']),
+        fanouts={k: list(v) for k, v in best['fanouts'].items()},
+        exact=bool(exact))
+    fp = dataset_fingerprint(dataset)
     art = TuneArtifact(choices, fp, evidence)
   metrics.inc('tune.artifacts')
   if out_path is not None:

@@ -130,6 +130,10 @@ def rng():
 _STRICT_MODULES = ('test_scan_epoch', 'test_dist_scan_epoch',
                    'test_serving', 'test_storage', 'test_recovery',
                    'test_remote_scan', 'test_dist_oversub',
+                   # round 19: the typed (hetero) fast paths must hold
+                   # their bit-identity + dispatch budgets with the
+                   # guard rails armed, same as their homo counterparts
+                   'test_capacity_plans',
                    # round 15: the tuned-config A/Bs and the run
                    # program must hold their zero-retrace / budget
                    # contracts with the guard rails armed

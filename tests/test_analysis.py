@@ -411,6 +411,85 @@ class TestFaultPointCoverage:
     assert any('string literal' in f.message for f in findings)
 
 
+# -------------------------------------------------------------- hetero-gate
+
+class TestHeteroGate:
+
+  def test_bare_raise_and_warn_flagged(self, tmp_path):
+    p = _write(tmp_path, 'fix.py', '''
+        import warnings
+
+        def check(self):
+            if self.is_hetero:
+                raise ValueError('homogeneous-only')
+
+        def check_soft(ds):
+            if getattr(ds, 'is_hetero', False):
+                warnings.warn('hetero path unvalidated')
+        ''')
+    findings, _, _, _ = _lint(p)
+    assert _rules(findings) == ['hetero-gate', 'hetero-gate']
+    assert 'CapacityPlanError' in findings[0].message
+    assert 'docs/capacity_plans.md' in findings[0].message
+
+  def test_else_branch_raise_flagged(self, tmp_path):
+    p = _write(tmp_path, 'fix.py', '''
+        def check(ds):
+            if not ds.is_hetero:
+                pass
+            else:
+                raise NotImplementedError('typed stores unsupported')
+        ''')
+    findings, _, _, _ = _lint(p)
+    assert _rules(findings) == ['hetero-gate']
+
+  def test_capacity_plan_error_ok(self, tmp_path):
+    p = _write(tmp_path, 'fix.py', '''
+        from graphlearn_tpu.sampler import CapacityPlanError
+
+        def check(self):
+            if self.is_hetero:
+                raise CapacityPlanError(
+                    'Trainer', 'per-ntype feature stores')
+        ''')
+    findings, _, _, _ = _lint(p)
+    assert findings == []
+
+  def test_nested_raise_not_flagged(self, tmp_path):
+    p = _write(tmp_path, 'fix.py', '''
+        def deep(self, parts):
+            if self.is_hetero:
+                for part in parts:
+                    if part is None:
+                        raise ValueError('bad partition input')
+        ''')
+    findings, _, _, _ = _lint(p)
+    assert findings == []
+
+  def test_bare_reraise_not_flagged(self, tmp_path):
+    p = _write(tmp_path, 'fix.py', '''
+        def fwd(self, exc):
+            try:
+                self._run()
+            except Exception:
+                if self.is_hetero:
+                    raise
+        ''')
+    findings, _, _, _ = _lint(p)
+    assert findings == []
+
+  def test_pragma_suppresses(self, tmp_path):
+    p = _write(tmp_path, 'fix.py', '''
+        def check(self):
+            if self.is_hetero:
+                # graftlint: allow[hetero-gate] homo accessor by contract
+                raise ValueError('homo-only accessor')
+        ''')
+    findings, n_pragma, _, _ = _lint(p)
+    assert findings == []
+    assert n_pragma == 1
+
+
 # ------------------------------------------------------------------ pragmas
 
 class TestPragmas:
@@ -600,6 +679,9 @@ class TestPackageClean:
         f.render() for f in findings)
     assert len(modules) > 50   # really walked the package
 
+  @pytest.mark.slow  # tier-1 budget (PR 19): CLI-surface variant
+  # of the same package walk — test_graftlint_clean_over_package
+  # stays the tier-1 zero-findings rep
   def test_cli_entrypoint_clean(self):
     proc = subprocess.run(
         [sys.executable, '-m', 'graphlearn_tpu.analysis.lint',

@@ -179,14 +179,18 @@ def test_tiered_dist_scan_chaos_degrades_to_sync_bit_identical():
 
 
 def test_tiered_dist_scan_validation_errors():
-  """Clear construction errors: an all-HBM DistFeature store, a tiered
-  store without a hot prefix, and hetero loaders are all rejected with
-  messages naming the supported path."""
+  """Clear construction errors: an all-HBM DistFeature store and a
+  tiered store without a hot prefix are rejected with a typed
+  CapacityPlanError naming the missing per-ntype slab capacities and
+  the doc anchor (docs/capacity_plans.md) — the satellite contract for
+  the old bare homo-only ValueError."""
+  from graphlearn_tpu.sampler import CapacityPlanError
   model, tx = make_model_tx()
-  with pytest.raises(ValueError, match='TieredDistFeature'):
+  with pytest.raises(CapacityPlanError, match='TieredDistFeature') as ei:
     TieredDistScanTrainer(make_loader(False), model, tx, 3)
+  assert 'docs/capacity_plans.md' in str(ei.value)
   tmp = tempfile.mkdtemp(prefix='glt_dist_val_')
-  with pytest.raises(ValueError, match='hot_prefix_rows'):
+  with pytest.raises(CapacityPlanError, match='hot_prefix_rows'):
     TieredDistScanTrainer(
         make_loader(True, spill_dir=tmp, hot_prefix=0), model, tx, 3)
   # dist_scan_tables itself refuses a prefixless store too
@@ -196,10 +200,13 @@ def test_tiered_dist_scan_validation_errors():
   with pytest.raises(ValueError, match='hot_prefix_rows'):
     df.dist_scan_tables()
 
+  # hetero stores that are NOT tiered name the typed path too — hetero
+  # meshes with {ntype: TieredDistFeature} stores are fully supported
   class FakeHetero:
     class sampler:
       is_hetero = True
-  with pytest.raises(ValueError, match='homogeneous'):
+      dist_feature = {'u': object()}
+  with pytest.raises(CapacityPlanError, match='TieredDistFeature'):
     TieredDistScanTrainer(FakeHetero(), model, tx, 3)
 
 

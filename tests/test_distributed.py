@@ -1120,6 +1120,8 @@ def worst_caps_from_plan(hop_caps):
   return worst
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): dist variant — the local
+# hetero calibrated-caps structure test stays the tier-1 rep
 def test_dist_hetero_calibrated_caps():
   """Dict-form calibrated caps on the DISTRIBUTED typed engine
   (round-5 parity with the local hetero clamps): caps at the plan's own

@@ -50,6 +50,8 @@ def test_bench_backend_failure_is_structured_json():
   assert parsed['config']['batch'] == 1024
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): staged-npz example variant
+# — the sub-second example tests stay tier-1, full run already slow
 def test_products_staged_npz_path(tmp_path):
   rng = np.random.default_rng(0)
   n, e, ncls, f = 400, 4000, 5, 16

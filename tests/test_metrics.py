@@ -703,6 +703,8 @@ def test_span_rule_flags_unregistered_computed_and_undocumented(tmp_path):
   assert sum('missing from' in m for m in msgs) == 1
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): span-rule package walk —
+# the metric-rule package-clean test stays the tier-1 registry rep
 def test_span_rule_pragma_and_package_clean(tmp_path):
   out = _run_span_rule(tmp_path, (
       'from graphlearn_tpu.metrics import spans\n'

@@ -282,6 +282,9 @@ def _run_cadence_case(scan_ref, tmp_path, every, shuffle, kill_chunk):
   assert_params_equal(state.params, ref_state.params)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): failure-mode variant — the
+# bit-identical crash-resume reps stay tier-1 (save-fault variant
+# already slow, PR 18)
 def test_failed_resume_flight_and_double_crash(scan_ref, tmp_path,
                                                monkeypatch):
   """A resume that fails mid-replay must still write its

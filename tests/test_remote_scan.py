@@ -662,8 +662,9 @@ def test_remote_scan_bf16_wire():
 def test_scope_validation_messages_name_chunk_staged_path():
   """DistFusedEpochTrainer's remote rejection now points at the
   chunk-staged path (whose failover is exact even under shuffle=True
-  — round 15) instead of flatly rejecting; RemoteScanTrainer rejects
-  what it cannot train (typed seeds, collect_features=False)."""
+  — round 15) instead of flatly rejecting; RemoteScanTrainer accepts
+  typed seeds (the hetero block streams) and rejects only what it
+  cannot train (collect_features=False)."""
   with pytest.raises(ValueError) as ei:
     glt.loader.DistFusedEpochTrainer(object(), None, None, 3)
   msg = str(ei.value)
@@ -671,9 +672,6 @@ def test_scope_validation_messages_name_chunk_staged_path():
   assert 'shuffle=True' in msg
   assert 'remote_scan' in msg
 
-  with pytest.raises(ValueError, match='homogeneous-only'):
-    glt.distributed.RemoteScanTrainer(
-        FANOUTS, ('paper', np.arange(4)), None, None, 3)
   with pytest.raises(ValueError, match='collect_features'):
     glt.distributed.RemoteScanTrainer(
         FANOUTS, np.arange(4), None, None, 3, collect_features=False)

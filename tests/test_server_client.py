@@ -137,6 +137,8 @@ def test_rpc_mutual_handshake_rejects_imposter_server():
     t.join(5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): mp-loader variant — the
+# server-client end-to-end test stays the tier-1 rep
 def test_mp_dist_neighbor_loader():
   ds = make_dataset()
   loader = glt.distributed.MpDistNeighborLoader(

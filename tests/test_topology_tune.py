@@ -286,6 +286,8 @@ def test_all_infeasible_field_refuses():
              topology='dist', probe_steps=STEPS)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): tune-the-tuner variant —
+# the dist e2e rep exercises the same candidate machinery tier-1
 def test_budget_ladder_truncates_loudly():
   """Tune-the-tuner: a wall-clock budget prices the ladder off the
   first candidate's measured wall and records what it never fielded."""
@@ -322,22 +324,23 @@ def test_padded_window_candidates_refused():
              candidates=[bad])
 
 
-def test_hetero_tune_refused_loudly():
-  """Hetero datasets have no typed fingerprint: tune() and the
-  topology path both refuse with the documented TypeError instead of
-  degrading to an unvalidatable artifact."""
+def test_hetero_tune_typed_requirements():
+  """Hetero tune() is live (typed CapacityPlans): it refuses flat
+  fanouts / untyped seeds with errors naming the typed forms instead
+  of the old blanket homogeneous-only TypeError."""
   class FakeHetero:
     graph = {('p', 'to', 'a'): object()}
-  with pytest.raises(TypeError, match='homogeneous-only'):
+  with pytest.raises(ValueError, match='edge_type'):
     glt.tune(FakeHetero(), dict(fanouts=FANOUTS,
                                 input_nodes=np.arange(8), batch_size=2))
-  with pytest.raises(TypeError, match='homogeneous-only'):
-    glt.tune(FakeHetero(),
-             dict(make_scenario=lambda kn, k: (None, None),
-                  fanouts=FANOUTS, batch_size=2, epoch_steps=4),
-             topology='dist')
+  with pytest.raises(ValueError, match='ntype'):
+    glt.tune(FakeHetero(), dict(fanouts={('p', 'to', 'a'): [2, 2]},
+                                input_nodes=np.arange(8), batch_size=2))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): evidence-record variant —
+# fingerprint refusal/acceptance reps stay tier-1 (test_tune +
+# test_capacity_plans v3 acceptance)
 def test_fingerprint_gap_recorded_for_unfingerprintable_dataset():
   """A homo dataset with no computable fingerprint tunes fine but the
   artifact carries a structured fingerprint_gap record — the
@@ -378,6 +381,8 @@ def test_make_scenario_required_for_topology_tune():
 # ----------------------------------------------------------- tiered e2e
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): tiered scenario variant —
+# the dist topology e2e + config-accept test stays the tier-1 rep
 def test_tiered_topology_tune_and_store_pin(tmp_path):
   """tiered_dist: the hot-prefix ladder tunes as freshly built tiered
   stores; the artifact pins hot_prefix_rows, the matching store

@@ -145,6 +145,8 @@ def test_tune_rejects_retracing_candidate_with_diff():
   assert not winner['name'].endswith('retrace_probe')
 
 
+@pytest.mark.slow  # tier-1 budget (PR 19): exact-mode variant — the
+# roundtrip/zero-retrace and retrace-rejection reps stay tier-1
 def test_tune_exact_pins_exact_set():
   """exact=True pins the accuracy-matrix exact set: exact dedup mode,
   f32 wire, and relaxed candidates dropped from the field."""
