@@ -95,6 +95,21 @@ class Config:
   metrics_registry_module: str = 'metrics/registry_names.py'
   observability_doc: str = 'docs/observability.md'
   metrics_exempt_modules: Tuple[str, ...] = ('metrics/',)
+  # flow-aware rules (donation-safety / bracket-discipline /
+  # retrace-hazard / lock-discipline): scoped package-wide by default —
+  # they key on idioms (donating handles, bracket openers, static jit
+  # slots, shared[] annotations) rather than on module lists
+  donation_modules: Tuple[str, ...] = ('*',)
+  bracket_modules: Tuple[str, ...] = ('*',)
+  retrace_modules: Tuple[str, ...] = ('*',)
+  lock_modules: Tuple[str, ...] = ('*',)
+  # rule retrace-hazard: the registered closure functions — a dynamic
+  # size that passes through one of these lands in the closed static
+  # set (docs/capacity_plans.md) and stops being a hazard
+  retrace_closure_fns: Tuple[str, ...] = (
+      'pow2_cap', 'pow2_slab_cap', 'round8', 'exchange_capacity',
+      'miss_capacity', 'capacity_plan', 'hetero_capacity_plan',
+      'probe_chunk_k', 'probe_slab_cap', 'clamp_etype_cap')
   # resolved at run time from the linted paths unless set explicitly
   repo_root: Optional[str] = None
 
@@ -110,6 +125,10 @@ class ParsedModule:
   # line-above expansion); '' entries mean a malformed pragma finding
   pragmas: Dict[int, set] = field(default_factory=dict)
   pragma_findings: List[Finding] = field(default_factory=list)
+  # line -> [(kind, arg)] for the non-allow annotation forms the lock
+  # rule consumes: '# graftlint: shared[<lock>]' on a field's defining
+  # assignment, '# graftlint: locked[<lock>]' on a def
+  annotations: Dict[int, list] = field(default_factory=dict)
 
 
 def in_scope(relpath: str, patterns: Sequence[str]) -> bool:
@@ -127,7 +146,9 @@ def in_scope(relpath: str, patterns: Sequence[str]) -> bool:
 
 PRAGMA_RULES = ('host-sync', 'prng-discipline', 'dispatch-instrumentation',
                 'compat-shard-map', 'fault-point-coverage',
-                'metric-registry', 'span-registry', 'hetero-gate')
+                'metric-registry', 'span-registry', 'hetero-gate',
+                'donation-safety', 'bracket-discipline', 'retrace-hazard',
+                'lock-discipline')
 _PRAGMA_MARK = 'graftlint:'
 
 
@@ -148,19 +169,38 @@ def _pragma_comments(mod: ParsedModule):
 
 
 def _parse_pragmas(mod: ParsedModule):
-  """Collect allow-pragmas per line; malformed ones become findings."""
+  """Collect allow-pragmas and shared[]/locked[] annotations per line;
+  malformed ones become findings."""
   import re
-  rx = re.compile(r'#\s*graftlint:\s*allow\[([^\]]*)\]\s*(.*)$')
+  rx = re.compile(r'#\s*graftlint:\s*(allow|shared|locked)'
+                  r'\[([^\]]*)\]\s*(.*)$')
   for i, text, own_line in _pragma_comments(mod):
     m = rx.search(text)
     if not m:
       mod.pragma_findings.append(Finding(
           'pragma', mod.path, mod.relpath, i, 1,
           "malformed graftlint pragma — expected '# graftlint: "
-          "allow[<rule>] <reason>'"))
+          "allow[<rule>] <reason>', '# graftlint: shared[<lock>]' or "
+          "'# graftlint: locked[<lock>]'"))
       continue
-    rules = {r.strip() for r in m.group(1).split(',') if r.strip()}
-    reason = m.group(2).strip()
+    kind = m.group(1)
+    targets = [i]
+    # a pragma on a comment-only line covers the next line
+    if own_line:
+      targets.append(i + 1)
+    if kind in ('shared', 'locked'):
+      arg = m.group(2).strip()
+      if not arg or ',' in arg:
+        mod.pragma_findings.append(Finding(
+            'pragma', mod.path, mod.relpath, i, 1,
+            f'graftlint {kind}[...] annotation needs exactly one lock '
+            'name inside the brackets'))
+        continue
+      for t in targets:
+        mod.annotations.setdefault(t, []).append((kind, arg))
+      continue
+    rules = {r.strip() for r in m.group(2).split(',') if r.strip()}
+    reason = m.group(3).strip()
     bad = rules - set(PRAGMA_RULES)
     if bad or not rules:
       mod.pragma_findings.append(Finding(
@@ -174,10 +214,6 @@ def _parse_pragmas(mod: ParsedModule):
           'graftlint pragma needs a reason after the closing bracket '
           '(unexplained exceptions rot)'))
       continue
-    targets = [i]
-    # a pragma on a comment-only line covers the next line
-    if own_line:
-      targets.append(i + 1)
     for t in targets:
       mod.pragmas.setdefault(t, set()).update(rules)
 
@@ -294,17 +330,36 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 # ------------------------------------------------------------------- runner
 
 def _checkers():
-  from . import (compat_import, dispatch, fault_points, hetero_gates,
-                 host_sync, metric_names, prng, span_names)
+  from . import (brackets, compat_import, dispatch, donation, fault_points,
+                 hetero_gates, host_sync, locks, metric_names, prng,
+                 retrace, span_names)
   return (host_sync, prng, dispatch, compat_import, fault_points,
-          metric_names, span_names, hetero_gates)
+          metric_names, span_names, hetero_gates, donation, brackets,
+          retrace, locks)
+
+
+@dataclass
+class LintResult:
+  """``run_lint``'s result. Unpacks as the historical 4-tuple
+  ``(findings, n_pragma, n_base, modules)``; ``timings`` adds per-rule
+  wall seconds for the CLI summary / JSON output."""
+  findings: List[Finding]
+  n_pragma: int
+  n_base: int
+  modules: Dict[str, ParsedModule]
+  timings: Dict[str, float] = field(default_factory=dict)
+
+  def __iter__(self):
+    return iter((self.findings, self.n_pragma, self.n_base, self.modules))
 
 
 def run_lint(paths: Sequence[str], config: Optional[Config] = None,
-             baseline: Optional[set] = None):
-  """Lint ``paths`` (files/dirs). Returns ``(findings, suppressed_count,
-  baselined_count, modules)`` where ``findings`` are the live (neither
-  pragma- nor baseline-suppressed) findings, sorted by location."""
+             baseline: Optional[set] = None) -> LintResult:
+  """Lint ``paths`` (files/dirs). Returns a :class:`LintResult` (which
+  unpacks as ``(findings, suppressed_count, baselined_count, modules)``)
+  where ``findings`` are the live (neither pragma- nor baseline-
+  suppressed) findings, sorted by location."""
+  import time
   config = config or Config()
   files = collect_files(paths)
   modules: Dict[str, ParsedModule] = {}
@@ -324,8 +379,12 @@ def run_lint(paths: Sequence[str], config: Optional[Config] = None,
   raw: List[Finding] = []
   for mod in mods:
     raw.extend(mod.pragma_findings)
+  timings: Dict[str, float] = {}
   for checker in _checkers():
+    t0 = time.monotonic()
     raw.extend(checker.check_package(mods, config))
+    rule = getattr(checker, 'RULE', checker.__name__)
+    timings[rule] = timings.get(rule, 0.0) + (time.monotonic() - t0)
 
   live, n_pragma = [], 0
   for f in raw:
@@ -347,4 +406,4 @@ def run_lint(paths: Sequence[str], config: Optional[Config] = None,
     live = kept
 
   live.sort(key=lambda f: (f.relpath, f.line, f.col, f.rule))
-  return live, n_pragma, n_base, modules
+  return LintResult(live, n_pragma, n_base, modules, timings)

@@ -1,0 +1,277 @@
+"""Rule donation-safety: a donated buffer must not be read afterwards.
+
+``jax.jit(fn, donate_argnums=...)`` invalidates the donated operand at
+DISPATCH time — the caller's array becomes garbage whether or not the
+call completes. This repo leans on donation everywhere the update loop
+is hot (the serving store's scatter, the scanned-epoch chunk programs,
+the demand-paged gather), always in the rebind idiom::
+
+    self._emb = self._scatter(self._emb, idx, vals)   # donate (0,)
+
+which is safe because the donated name is rebound by the very statement
+that donates it. PR 7 fixed the same bug twice: a path (the empty-batch
+early return, the failed-refresh re-mark) that read ``_embeddings``
+after a donating dispatch without the rebind in between. This rule
+makes that a lint error: after a call through a donating handle, the
+names passed in donated positions are DEAD on every path until rebound;
+any read of a dead name is a finding. Exception edges stay dead even
+through the rebind statement — if the donating statement raised, the
+buffer was still donated but the rebind never happened, which is
+exactly the failed-refresh shape.
+
+Handles are found the same way dispatch-instrumentation finds them:
+``jax.jit``/donating-factory results propagating through local names,
+``self.attr`` stores, container stores and returns, seen through
+``programs.instrument(...)``/``wrap_dispatch(...)`` wrappers, plus
+``@functools.partial(jax.jit, donate_argnums=...)`` decorated defs.
+Only HOST (untraced) functions are checked — inside a traced body a
+nested donating call composes into the outer program.
+"""
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import astutil, flow
+from .core import Config, Finding, ParsedModule, in_scope
+
+RULE = 'donation-safety'
+
+_WRAPPERS = ('instrument', 'wrap_dispatch')
+
+
+def check_package(modules: List[ParsedModule], config: Config):
+  findings = []
+  for mod in modules:
+    if not in_scope(mod.relpath, config.donation_modules):
+      continue
+    try:
+      findings.extend(_check_module(mod, config))
+    except RecursionError:   # pathological nesting: err quiet
+      pass
+  return findings
+
+
+class _ModuleState:
+  def __init__(self, mod: ParsedModule, config: Config):
+    self.mod = mod
+    self.index = astutil.FuncIndex(mod.tree)
+    self.aliases = astutil.import_aliases(mod.tree)
+    self.traced = astutil.traced_functions(self.index, mod.tree,
+                                           self.aliases)
+    self.parents = astutil.parent_map(mod.tree)
+    # handle identity -> donated positional indices
+    self.attr_don: Dict[str, Tuple[int, ...]] = {}
+    self.local_don: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+    self.container_don: Dict[str, Tuple[int, ...]] = {}
+    self.factory_don: Dict[str, Tuple[int, ...]] = {}
+
+  def scope_of(self, node) -> str:
+    fi = astutil.enclosing_function(self.index, node, self.parents)
+    return fi.qualname if fi else '<module>'
+
+
+def _jit_donation(st: _ModuleState,
+                  call: ast.Call) -> Optional[Tuple[int, ...]]:
+  """Donated positions of a jax.jit(...) call, or None."""
+  pos = set()
+  argnames = []
+  for kw in call.keywords:
+    if kw.arg == 'donate_argnums':
+      vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+          else [kw.value]
+      for e in vals:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+          pos.add(e.value)
+    elif kw.arg == 'donate_argnames':
+      vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+          else [kw.value]
+      for e in vals:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+          argnames.append(e.value)
+  if argnames and call.args and isinstance(call.args[0], ast.Name):
+    for fi in st.index.by_name.get(call.args[0].id, []):
+      a = fi.node.args
+      params = [x.arg for x in a.posonlyargs + a.args]
+      for name in argnames:
+        if name in params:
+          pos.add(params.index(name))
+      break
+  return tuple(sorted(pos)) or None
+
+
+def _donating_expr(st: _ModuleState, node: ast.AST,
+                   scope: str) -> Optional[Tuple[int, ...]]:
+  """Donated positions if this expression evaluates to a donating
+  jitted callable, else None."""
+  if isinstance(node, ast.Call):
+    seg = astutil.last_segment(astutil.call_name(node))
+    if seg in _WRAPPERS and node.args:
+      return _donating_expr(st, node.args[0], scope)
+    if seg == 'jit':
+      return _jit_donation(st, node)
+    if seg in st.factory_don:
+      return st.factory_don[seg]
+    return None
+  if isinstance(node, ast.Name):
+    return st.local_don.get((scope, node.id)) or \
+        st.local_don.get(('<module>', node.id))
+  if isinstance(node, ast.Attribute):
+    return st.attr_don.get(node.attr)
+  if isinstance(node, ast.Subscript):
+    base = node.value
+    if isinstance(base, ast.Attribute):
+      return st.container_don.get(base.attr)
+    if isinstance(base, ast.Name):
+      return st.local_don.get((scope, base.id))
+  return None
+
+
+def _bind_target(st: _ModuleState, t: ast.AST, scope: str,
+                 pos: Tuple[int, ...]) -> bool:
+  if isinstance(t, ast.Name):
+    key = (scope, t.id)
+    if st.local_don.get(key) != pos:
+      st.local_don[key] = pos
+      return True
+  elif isinstance(t, ast.Attribute):
+    if st.attr_don.get(t.attr) != pos:
+      st.attr_don[t.attr] = pos
+      return True
+  elif isinstance(t, ast.Subscript):
+    base = t.value
+    if isinstance(base, ast.Attribute) and \
+        st.container_don.get(base.attr) != pos:
+      st.container_don[base.attr] = pos
+      return True
+  return False
+
+
+def _seed_handles(st: _ModuleState):
+  """Fixpoint: donating jit results into names/attrs/containers, defs
+  returning them into factories, decorated defs into handles."""
+  for fi in st.index.by_qual.values():
+    for dec in fi.node.decorator_list:
+      if isinstance(dec, ast.Call) and \
+          astutil.matches(astutil.canonical(astutil.call_name(dec),
+                                            st.aliases),
+                          {'functools.partial', 'partial'}) and dec.args:
+        inner = astutil.canonical(astutil.dotted_name(dec.args[0]),
+                                  st.aliases)
+        if astutil.last_segment(inner) == 'jit':
+          pos = _jit_donation(st, dec)
+          if pos:
+            name = fi.node.name
+            st.attr_don.setdefault(name, pos)
+            st.local_don.setdefault(('<module>', name), pos)
+  changed = True
+  while changed:
+    changed = False
+    for node in ast.walk(st.mod.tree):
+      if isinstance(node, ast.Assign):
+        scope = st.scope_of(node)
+        pos = _donating_expr(st, node.value, scope)
+        if pos:
+          for t in node.targets:
+            changed |= _bind_target(st, t, scope, pos)
+      elif isinstance(node, ast.Return) and node.value is not None:
+        scope = st.scope_of(node)
+        if scope != '<module>':
+          pos = _donating_expr(st, node.value, scope)
+          fn_name = scope.rsplit('.', 1)[-1]
+          if pos and st.factory_don.get(fn_name) != pos:
+            st.factory_don[fn_name] = pos
+            changed = True
+
+
+def _check_module(mod: ParsedModule, config: Config) -> List[Finding]:
+  st = _ModuleState(mod, config)
+  _seed_handles(st)
+  if not (st.attr_don or st.local_don or st.container_don or
+          st.factory_don):
+    return []
+  out: List[Finding] = []
+  for fi in st.index.by_qual.values():
+    if fi.qualname in st.traced:
+      continue
+    out.extend(_check_function(st, fi))
+  return out
+
+
+def _donated_names(st: _ModuleState, fi: astutil.FuncInfo,
+                   stmt: ast.stmt):
+  """[(name, line)] donated by calls in this statement."""
+  killed = []
+  for call in flow.stmt_calls(stmt):
+    pos = _donating_expr(st, call.func, fi.qualname)
+    if not pos:
+      continue
+    for p in pos:
+      if p < len(call.args):
+        d = flow.dotted(call.args[p])
+        if d:
+          killed.append((d, call.lineno))
+  return killed
+
+
+def _check_function(st: _ModuleState,
+                    fi: astutil.FuncInfo) -> List[Finding]:
+  # cheap pre-pass: skip functions with no donating call at all
+  gen: Dict[int, List[Tuple[str, int]]] = {}
+  any_don = False
+  for node in st.index.own_nodes(fi):
+    if isinstance(node, ast.stmt):
+      killed = _donated_names(st, fi, node)
+      if killed:
+        gen[id(node)] = killed
+        any_don = True
+  if not any_don:
+    return []
+
+  cfg = flow.build_cfg(fi.node)
+
+  # state elements are 'name|donate_line' so the finding can say where
+  # the donation happened
+  def transfer(n, stmt, state):
+    if stmt is None:
+      return state
+    # donation happens at dispatch, the rebind only after the call
+    # returns — so gen precedes the write-kill, and the rebind idiom
+    # (self._emb = self._scatter(self._emb, ...)) comes out clean
+    for name, line in gen.get(id(stmt), ()):
+      state = state | {f'{name}|{line}'}
+    writes = flow.stmt_writes(stmt)
+    return frozenset(e for e in state
+                     if e.split('|', 1)[0] not in writes)
+
+  def exc_transfer(n, stmt, state):
+    # if the statement raised, its rebind never happened but any
+    # donation in it already did (donation invalidates at dispatch)
+    if stmt is None:
+      return state
+    for name, line in gen.get(id(stmt), ()):
+      state = state | {f'{name}|{line}'}
+    return state
+
+  in_s = flow.forward(cfg, frozenset(), transfer, exc_transfer)
+  out: List[Finding] = []
+  seen = set()
+  for n in cfg.nodes():
+    stmt = cfg.stmt_of.get(n)
+    if stmt is None or not in_s[n]:
+      continue
+    reads = flow.stmt_reads(stmt)
+    for e in sorted(in_s[n]):
+      name, don_line = e.split('|', 1)
+      if name in reads:
+        key = (name, stmt.lineno)
+        if key in seen:
+          continue
+        seen.add(key)
+        out.append(Finding(
+            RULE, st.mod.path, st.mod.relpath, stmt.lineno,
+            stmt.col_offset + 1,
+            f"'{name}' may be read here after being donated to the "
+            f'jitted call at line {don_line} — a donated buffer is '
+            'invalidated at dispatch; rebind the name before reading '
+            'it (or drop it from donate_argnums)',
+            symbol=fi.qualname))
+  return out

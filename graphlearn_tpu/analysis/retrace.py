@@ -1,0 +1,350 @@
+"""Rule retrace-hazard: dynamic sizes must not feed static jit args raw.
+
+The one-executable-per-shape contract (PAPER.md L0, docs/
+capacity_plans.md) holds because every static argument a jitted program
+sees is drawn from a CLOSED set: pow2 ladders, calibrated caps,
+CapacityPlan fields, the chunk-K ladder. The moment a host value
+derived from runtime data — ``len(batch)``, ``table.shape[0]``, a dict
+size — reaches a ``static_argnames``/``static_argnums`` slot directly,
+every distinct value mints a fresh trace and the epoch dissolves into a
+retrace storm. ``retrace_budget`` catches that at RUN time, per
+executable, after the damage; this rule is its lint-time twin: it
+flags the flow at the call site, before it ships.
+
+Per host function, a forward taint analysis over the CFG: ``len(...)``
+and ``.shape``/``.size``/``.nbytes`` reads are sources; assignment
+propagates; a call to a registered closure function
+(``Config.retrace_closure_fns`` — the pow2/capacity ladder) SANITIZES
+its result. A sink is a static slot of (a) any package-wide function
+decorated ``@functools.partial(jax.jit, static_argnames=...)`` (the
+ops/ surface), or (b) a module-local handle built with
+``jax.jit(fn, static_argnums=...)``, matched by the same name-based
+binding the other rules use. A static argument that still carries raw
+taint at the sink is a finding.
+
+Traced functions are skipped — inside a trace, shapes are static per
+executable by construction; the hazard is purely a host-side flow.
+"""
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil, flow
+from .core import Config, Finding, ParsedModule, in_scope
+
+RULE = 'retrace-hazard'
+
+_SOURCE_ATTRS = ('shape', 'size', 'nbytes')
+_WRAPPERS = ('instrument', 'wrap_dispatch')
+
+
+def check_package(modules: List[ParsedModule], config: Config):
+  registry = _static_registry(modules)
+  findings = []
+  for mod in modules:
+    if not in_scope(mod.relpath, config.retrace_modules):
+      continue
+    try:
+      findings.extend(_check_module(mod, config, registry))
+    except RecursionError:
+      pass
+  return findings
+
+
+# ------------------------------------------------- package-wide static sinks
+
+def _decorated_static(fi: astutil.FuncInfo,
+                      aliases) -> Optional[Tuple[Tuple[str, ...],
+                                                 Tuple[int, ...]]]:
+  """(static names, static positions) for a def decorated
+  ``@functools.partial(jax.jit, static_argnames=...)`` (or plain
+  ``@jax.jit`` with the kwarg), else None."""
+  for dec in fi.node.decorator_list:
+    if not isinstance(dec, ast.Call):
+      continue
+    name = astutil.canonical(astutil.call_name(dec), aliases)
+    if astutil.matches(name, {'functools.partial', 'partial'}) and \
+        dec.args:
+      inner = astutil.canonical(astutil.dotted_name(dec.args[0]), aliases)
+      if astutil.last_segment(inner) != 'jit':
+        continue
+    elif astutil.last_segment(name) != 'jit':
+      continue
+    names = _str_tuple_kw(dec, 'static_argnames')
+    nums = _int_tuple_kw(dec, 'static_argnums')
+    if not names and not nums:
+      continue
+    a = fi.node.args
+    params = [x.arg for x in a.posonlyargs + a.args]
+    pos = set(nums)
+    for s in names:
+      if s in params:
+        pos.add(params.index(s))
+    return tuple(names), tuple(sorted(pos))
+  return None
+
+
+def _str_tuple_kw(call: ast.Call, kwname: str) -> Tuple[str, ...]:
+  for kw in call.keywords:
+    if kw.arg == kwname:
+      vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+          else [kw.value]
+      return tuple(e.value for e in vals
+                   if isinstance(e, ast.Constant) and
+                   isinstance(e.value, str))
+  return ()
+
+
+def _int_tuple_kw(call: ast.Call, kwname: str) -> Tuple[int, ...]:
+  for kw in call.keywords:
+    if kw.arg == kwname:
+      vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+          else [kw.value]
+      return tuple(e.value for e in vals
+                   if isinstance(e, ast.Constant) and
+                   isinstance(e.value, int))
+  return ()
+
+
+def _static_registry(modules: List[ParsedModule]):
+  """fn name -> (static names, static positions) across the package.
+  Name collisions keep the first entry — the ops/ surface this exists
+  for has unique public names."""
+  reg: Dict[str, Tuple[Tuple[str, ...], Tuple[int, ...]]] = {}
+  for mod in modules:
+    index = astutil.FuncIndex(mod.tree)
+    aliases = astutil.import_aliases(mod.tree)
+    for fi in index.by_qual.values():
+      info = _decorated_static(fi, aliases)
+      if info is not None:
+        reg.setdefault(fi.node.name, info)
+  return reg
+
+
+# --------------------------------------------------- module-local jit handles
+
+class _ModuleState:
+  def __init__(self, mod: ParsedModule, config: Config, registry):
+    self.mod = mod
+    self.config = config
+    self.registry = registry
+    self.index = astutil.FuncIndex(mod.tree)
+    self.aliases = astutil.import_aliases(mod.tree)
+    self.traced = astutil.traced_functions(self.index, mod.tree,
+                                           self.aliases)
+    self.parents = astutil.parent_map(mod.tree)
+    # handle identity -> (static names, static positions)
+    self.attr_h: Dict[str, Tuple] = {}
+    self.local_h: Dict[Tuple[str, str], Tuple] = {}
+    self.container_h: Dict[str, Tuple] = {}
+    self.factory_h: Dict[str, Tuple] = {}
+
+  def scope_of(self, node) -> str:
+    fi = astutil.enclosing_function(self.index, node, self.parents)
+    return fi.qualname if fi else '<module>'
+
+
+def _static_of_jit(st: _ModuleState, call: ast.Call) -> Optional[Tuple]:
+  names = _str_tuple_kw(call, 'static_argnames')
+  nums = set(_int_tuple_kw(call, 'static_argnums'))
+  if names and call.args and isinstance(call.args[0], ast.Name):
+    for fi in st.index.by_name.get(call.args[0].id, []):
+      a = fi.node.args
+      params = [x.arg for x in a.posonlyargs + a.args]
+      for s in names:
+        if s in params:
+          nums.add(params.index(s))
+      break
+  if not names and not nums:
+    return None
+  return (tuple(names), tuple(sorted(nums)))
+
+
+def _static_expr(st: _ModuleState, node: ast.AST,
+                 scope: str) -> Optional[Tuple]:
+  if isinstance(node, ast.Call):
+    seg = astutil.last_segment(astutil.call_name(node))
+    if seg in _WRAPPERS and node.args:
+      return _static_expr(st, node.args[0], scope)
+    if seg == 'jit':
+      return _static_of_jit(st, node)
+    if seg in st.factory_h:
+      return st.factory_h[seg]
+    return None
+  if isinstance(node, ast.Name):
+    return st.local_h.get((scope, node.id)) or \
+        st.local_h.get(('<module>', node.id))
+  if isinstance(node, ast.Attribute):
+    return st.attr_h.get(node.attr)
+  if isinstance(node, ast.Subscript):
+    base = node.value
+    if isinstance(base, ast.Attribute):
+      return st.container_h.get(base.attr)
+    if isinstance(base, ast.Name):
+      return st.local_h.get((scope, base.id))
+  return None
+
+
+def _seed_handles(st: _ModuleState):
+  changed = True
+  while changed:
+    changed = False
+    for node in ast.walk(st.mod.tree):
+      if isinstance(node, ast.Assign):
+        scope = st.scope_of(node)
+        info = _static_expr(st, node.value, scope)
+        if info:
+          for t in node.targets:
+            if isinstance(t, ast.Name):
+              key = (scope, t.id)
+              if st.local_h.get(key) != info:
+                st.local_h[key] = info
+                changed = True
+            elif isinstance(t, ast.Attribute):
+              if st.attr_h.get(t.attr) != info:
+                st.attr_h[t.attr] = info
+                changed = True
+            elif isinstance(t, ast.Subscript) and \
+                isinstance(t.value, ast.Attribute):
+              if st.container_h.get(t.value.attr) != info:
+                st.container_h[t.value.attr] = info
+                changed = True
+      elif isinstance(node, ast.Return) and node.value is not None:
+        scope = st.scope_of(node)
+        if scope != '<module>':
+          info = _static_expr(st, node.value, scope)
+          fn_name = scope.rsplit('.', 1)[-1]
+          if info and st.factory_h.get(fn_name) != info:
+            st.factory_h[fn_name] = info
+            changed = True
+
+
+# ----------------------------------------------------------------- taint
+
+def _strip_sanitized(expr: ast.AST, sanitizers) -> List[ast.AST]:
+  """Subtrees of ``expr`` minus anything under a sanitizing call."""
+  out = []
+  stack = [expr]
+  while stack:
+    node = stack.pop()
+    if isinstance(node, ast.Call) and \
+        astutil.last_segment(astutil.call_name(node)) in sanitizers:
+      continue
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+      continue
+    out.append(node)
+    stack.extend(ast.iter_child_nodes(node))
+  return out
+
+
+def _raw_sources(nodes) -> List[int]:
+  """Lines of len()/.shape/.size reads among ``nodes``."""
+  lines = []
+  for node in nodes:
+    if isinstance(node, ast.Call) and \
+        isinstance(node.func, ast.Name) and node.func.id == 'len':
+      lines.append(node.lineno)
+    elif isinstance(node, ast.Attribute) and \
+        node.attr in _SOURCE_ATTRS and isinstance(node.ctx, ast.Load):
+      lines.append(node.lineno)
+  return lines
+
+
+def _raw_reads(nodes) -> Set[str]:
+  out: Set[str] = set()
+  for node in nodes:
+    if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+      out.add(node.id)
+    elif isinstance(node, ast.Attribute) and \
+        isinstance(node.ctx, ast.Load):
+      d = flow.dotted(node)
+      if d:
+        out.add(d)
+  return out
+
+
+def _check_module(mod: ParsedModule, config: Config,
+                  registry) -> List[Finding]:
+  st = _ModuleState(mod, config, registry)
+  _seed_handles(st)
+  sanitizers = set(config.retrace_closure_fns)
+  out: List[Finding] = []
+  for fi in st.index.by_qual.values():
+    if fi.qualname in st.traced:
+      continue
+    out.extend(_check_function(st, fi, sanitizers))
+  return out
+
+
+def _sink_args(st: _ModuleState, call: ast.Call, scope: str):
+  """Static-slot argument expressions of ``call``, or []."""
+  info = _static_expr(st, call.func, scope)
+  if info is None:
+    seg = astutil.last_segment(astutil.call_name(call))
+    info = st.registry.get(seg) if seg else None
+  if info is None:
+    return []
+  names, pos = info
+  args = [call.args[p] for p in pos if p < len(call.args)]
+  args += [kw.value for kw in call.keywords if kw.arg in names]
+  return args
+
+
+def _check_function(st: _ModuleState, fi: astutil.FuncInfo,
+                    sanitizers) -> List[Finding]:
+  scope = fi.qualname
+  # cheap pre-pass: any sink call at all?
+  sinks = []
+  for node in st.index.own_nodes(fi):
+    if isinstance(node, ast.Call) and _sink_args(st, node, scope):
+      sinks.append(node)
+  if not sinks:
+    return []
+
+  cfg = flow.build_cfg(fi.node)
+
+  def transfer(n, stmt, state):
+    if stmt is None or not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                             ast.AnnAssign)):
+      return state
+    if stmt.value is None:
+      return state
+    kept = _strip_sanitized(stmt.value, sanitizers)
+    src_lines = _raw_sources(kept)
+    tainted_names = {e.split('|', 1)[0] for e in state}
+    reads = _raw_reads(kept) & tainted_names
+    writes = flow.stmt_writes(stmt)
+    state = frozenset(e for e in state
+                      if e.split('|', 1)[0] not in writes)
+    if src_lines or reads:
+      line = src_lines[0] if src_lines else stmt.lineno
+      state |= frozenset(f'{w}|{line}' for w in writes)
+    return state
+
+  in_s = flow.forward(cfg, frozenset(), transfer)
+
+  out: List[Finding] = []
+  seen = set()
+  for n in cfg.nodes():
+    stmt = cfg.stmt_of.get(n)
+    if stmt is None:
+      continue
+    tainted_names = {e.split('|', 1)[0] for e in in_s[n]}
+    for call in flow.stmt_calls(stmt):
+      for arg in _sink_args(st, call, scope):
+        kept = _strip_sanitized(arg, sanitizers)
+        hit = bool(_raw_sources(kept)) or \
+            bool(_raw_reads(kept) & tainted_names)
+        if hit and (call.lineno, call.col_offset) not in seen:
+          seen.add((call.lineno, call.col_offset))
+          fn_name = astutil.call_name(call) or '<handle>'
+          out.append(Finding(
+              RULE, st.mod.path, st.mod.relpath, call.lineno,
+              call.col_offset + 1,
+              f'dynamic size flows into a static argument of '
+              f'{fn_name}(...) without passing a registered closure '
+              'function (pow2_cap / capacity ladder) — every distinct '
+              'value mints a fresh executable; clamp it to the closed '
+              'set first (docs/capacity_plans.md)',
+              symbol=fi.qualname))
+  return out

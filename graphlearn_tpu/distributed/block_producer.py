@@ -230,6 +230,10 @@ class BlockSampleProducer:
     self._key_stride = ((1 if self._neg is not None else 0) +
                         self.plan.key_draws_per_batch) if hetero else 1
     self._order_cache: Optional[tuple] = None   # (epoch, order)
+    # staged frame cache shared between produce-ahead builder threads
+    # and fetch RPCs — every access holds _cache_lock (builds run
+    # outside it, under _build_lock, so hits never wait on a build)
+    # graftlint: shared[_cache_lock]
     self._frames: Dict[Tuple[int, int, int], dict] = {}
     # tenancy accounting seams (dist_server.create_block_producer):
     # on_stage(nbytes) as a frame lands in the cache, on_fetch(nbytes)

@@ -300,6 +300,7 @@ class MpDistNeighborLoader:
 
   def __iter__(self):
     from ..metrics import flight, spans
+    cfg = self.producer.config
     tok = flight.epoch_begin()
     # the epoch span is CURRENT while produce_all ships the epoch
     # commands, so worker spans (producer.epoch/batch) parent under it;
@@ -327,7 +328,6 @@ class MpDistNeighborLoader:
     finally:
       spans.end(sp, steps=received,
                 completed=received >= self._expected)
-      cfg = self.producer.config
       flight.end_for(
           self, tok, steps=received,
           completed=received >= self._expected,
@@ -669,6 +669,7 @@ class _RemoteLoaderBase:
     # new pullers.
     self.channel.stop(join=True)
     self._epoch += 1
+    cfg = self._config
     tok = flight.epoch_begin()
     # the epoch span stays current across _epoch_messages, so the
     # start_new_epoch_sampling RPCs (and through them the servers'
@@ -688,7 +689,8 @@ class _RemoteLoaderBase:
       # the flight record is the postmortem trail for THIS epoch:
       # failover/retry counter deltas, batches delivered, wall — one
       # JSONL line (docs/observability.md), nothing on the hot path
-      cfg = self._config
+      # (cfg resolved before the brackets opened: nothing between the
+      # span close above and the record below may raise)
       flight.end_for(
           self, tok, epoch=self._epoch, steps=received,
           completed=completed,

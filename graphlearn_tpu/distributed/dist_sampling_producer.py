@@ -125,94 +125,105 @@ def _sampling_worker_loop(rank, dataset_handle, sampling_config, seeds,
     epoch_seed_order, start_batch = payload[0], payload[1]
     span_ctx = payload[2] if len(payload) > 2 else None
     from graphlearn_tpu.metrics import spans
+    n = n_seeds
+    bs = cfg.batch_size
+    batch_no = 0
     epoch_ctx = spans.adopt(span_ctx)
     epoch_ctx.__enter__()
     epoch_span = spans.begin('producer.epoch', worker=rank,
                              start_batch=start_batch)
-    n = n_seeds
-    bs = cfg.batch_size
-    batch_no = 0
-    for i in range(0, n - (n % bs if cfg.drop_last else 0), bs):
-      idx = epoch_seed_order[i:i + bs]
-      if idx.shape[0] == 0:
-        continue
-      if batch_no < start_batch:
-        # replay fast-forward: these batches already landed in the
-        # channel before the previous incarnation died; the PRNG keys
-        # they consumed are covered by resume_calls, so skipping them
-        # does not shift the remaining batches' key stream
+    try:
+      for i in range(0, n - (n % bs if cfg.drop_last else 0), bs):
+        idx = epoch_seed_order[i:i + bs]
+        if idx.shape[0] == 0:
+          continue
+        if batch_no < start_batch:
+          # replay fast-forward: these batches already landed in the
+          # channel before the previous incarnation died; the PRNG keys
+          # they consumed are covered by resume_calls, so skipping them
+          # does not shift the remaining batches' key stream
+          batch_no += 1
+          continue
+        # chaos harness site: armed 'exit' here (before the sample/send)
+        # kills the worker at an exact batch index with nothing in flight
+        fault_point('producer.worker.batch')
+        batch_span = spans.begin('producer.batch', batch=batch_no)
+        try:
+          t_batch = _time.perf_counter()
+          if is_link:
+            if idx.shape[0] < bs:
+              # pad the final short batch cyclically so every batch keeps
+              # the compiled shape (a fresh length would retrace the whole
+              # chain per epoch); the few duplicated positives are slightly
+              # over-weighted in that one batch
+              idx = np.resize(idx, bs)
+            out = sampler.sample_from_edges(EdgeSamplerInput(
+                rows_[idx], cols_[idx],
+                label=(label_[idx] if label_ is not None else None),
+                input_type=input_type,
+                neg_sampling=neg))
+          else:
+            out = sampler.sample_from_nodes(
+                NodeSamplerInput(seeds[idx], input_type=input_type),
+                batch_cap=bs)
+          if hetero:
+            x_d = y_d = None
+            if cfg.collect_features and \
+                isinstance(dataset.node_features, dict):
+              x_d = {t: dataset.node_features[t].cpu_get(
+                  np.maximum(np.asarray(out.node[t]), 0))
+                  for t in out.node if t in dataset.node_features}
+            if isinstance(dataset.node_labels, dict):
+              y_d = {}
+              for t, lab in dataset.node_labels.items():
+                if t not in out.node:
+                  continue
+                lab = np.asarray(lab)
+                y_d[t] = lab[np.clip(np.asarray(out.node[t]), 0,
+                                     len(lab) - 1)]
+            msg = hetero_output_to_message(out, x_d, y_d)
+          else:
+            x = y = None
+            if cfg.collect_features and dataset.node_features is not None:
+              x = dataset.node_features.cpu_get(
+                  np.maximum(np.asarray(out.node), 0))
+            if dataset.node_labels is not None:
+              labels = np.asarray(dataset.node_labels)
+              y = labels[np.clip(np.asarray(out.node), 0,
+                                 len(labels) - 1)]
+            msg = output_to_message(out, x, y)
+          channel.send(msg)
+          # worker-local observability: this subprocess's own registry; it
+          # reaches the trainer through the metrics_q snapshot below (and
+          # DistServer.get_metrics / metrics.scrape_all from there)
+          metrics.inc('producer.batches')
+          metrics.observe('producer.sample_ms',
+                          (_time.perf_counter() - t_batch) * 1e3)
+        finally:
+          # a raising sample/send must not strand the batch span on this
+          # worker's context stack — later batches would parent under it
+          spans.end(batch_span)
         batch_no += 1
-        continue
-      # chaos harness site: armed 'exit' here (before the sample/send)
-      # kills the worker at an exact batch index with nothing in flight
-      fault_point('producer.worker.batch')
-      batch_span = spans.begin('producer.batch', batch=batch_no)
-      t_batch = _time.perf_counter()
-      if is_link:
-        if idx.shape[0] < bs:
-          # pad the final short batch cyclically so every batch keeps the
-          # compiled shape (a fresh length would retrace the whole chain
-          # per epoch); the few duplicated positives are slightly
-          # over-weighted in that one batch
-          idx = np.resize(idx, bs)
-        out = sampler.sample_from_edges(EdgeSamplerInput(
-            rows_[idx], cols_[idx],
-            label=(label_[idx] if label_ is not None else None),
-            input_type=input_type,
-            neg_sampling=neg))
-      else:
-        out = sampler.sample_from_nodes(
-            NodeSamplerInput(seeds[idx], input_type=input_type),
-            batch_cap=bs)
-      if hetero:
-        x_d = y_d = None
-        if cfg.collect_features and \
-            isinstance(dataset.node_features, dict):
-          x_d = {t: dataset.node_features[t].cpu_get(
-              np.maximum(np.asarray(out.node[t]), 0))
-              for t in out.node if t in dataset.node_features}
-        if isinstance(dataset.node_labels, dict):
-          y_d = {}
-          for t, lab in dataset.node_labels.items():
-            if t not in out.node:
-              continue
-            lab = np.asarray(lab)
-            y_d[t] = lab[np.clip(np.asarray(out.node[t]), 0,
-                                 len(lab) - 1)]
-        msg = hetero_output_to_message(out, x_d, y_d)
-      else:
-        x = y = None
-        if cfg.collect_features and dataset.node_features is not None:
-          x = dataset.node_features.cpu_get(
-              np.maximum(np.asarray(out.node), 0))
-        if dataset.node_labels is not None:
-          labels = np.asarray(dataset.node_labels)
-          y = labels[np.clip(np.asarray(out.node), 0, len(labels) - 1)]
-        msg = output_to_message(out, x, y)
-      channel.send(msg)
-      # worker-local observability: this subprocess's own registry; it
-      # reaches the trainer through the metrics_q snapshot below (and
-      # DistServer.get_metrics / metrics.scrape_all from there)
-      metrics.inc('producer.batches')
-      metrics.observe('producer.sample_ms',
-                      (_time.perf_counter() - t_batch) * 1e3)
-      spans.end(batch_span)
-      batch_no += 1
-      if progress is not None:
-        # published AFTER the send. Tradeoff for an UNCONTROLLED crash
-        # landing exactly between send and publish: the replay re-emits
-        # that one batch (a duplicate, which consumers counting toward
-        # expected will take in place of the true final batch) —
-        # publishing first would instead lose the batch outright.
-        # Exact replay is guaranteed when the crash point is before the
-        # send, which is where the chaos harness injects kills
-        # (docs/failure_model.md 'Limits').
-        sent_arr, calls_arr = progress
-        with sent_arr.get_lock():
-          sent_arr[rank] = batch_no
-          calls_arr[rank] = sampler._call_count
-    spans.end(epoch_span, batches=batch_no)
-    epoch_ctx.__exit__(None, None, None)
+        if progress is not None:
+          # published AFTER the send. Tradeoff for an UNCONTROLLED crash
+          # landing exactly between send and publish: the replay re-emits
+          # that one batch (a duplicate, which consumers counting toward
+          # expected will take in place of the true final batch) —
+          # publishing first would instead lose the batch outright.
+          # Exact replay is guaranteed when the crash point is before the
+          # send, which is where the chaos harness injects kills
+          # (docs/failure_model.md 'Limits').
+          sent_arr, calls_arr = progress
+          with sent_arr.get_lock():
+            sent_arr[rank] = batch_no
+            calls_arr[rank] = sampler._call_count
+    finally:
+      # the epoch span and adopted trace context close even when a
+      # batch raises out of the loop — the respawned incarnation's
+      # replay re-adopts the same ctx and must not nest under a stale
+      # leaked span
+      spans.end(epoch_span, batches=batch_no)
+      epoch_ctx.__exit__(None, None, None)
     with done_counter.get_lock():
       done_counter.value += 1
     if metrics_q is not None:

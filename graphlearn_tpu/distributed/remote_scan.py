@@ -873,7 +873,6 @@ class RemoteScanTrainer:
     if not self._hb_started:
       self._heartbeat.start()
       self._hb_started = True
-    flight_tok = flight.epoch_begin()
     epoch_no = self._epochs
     full_steps = len(self)
     steps = full_steps
@@ -889,10 +888,15 @@ class RemoteScanTrainer:
       if not 0 <= start_step < steps:
         raise ValueError(f'start_step={start_step} outside this '
                          f"epoch's {steps} steps")
-    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
-                             epoch=epoch_no)
+    # both brackets open after the step arithmetic (and the zero-step
+    # path's empty-result device work): a prologue raise must not
+    # leave a permanently-open flight record or a leaked attached span
+    # — see ScanTrainer.run_epoch
     if steps <= 0:
       empty = jnp.zeros((0,), jnp.float32)
+      flight_tok = flight.epoch_begin()
+      epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                               epoch=epoch_no)
       spans.end(epoch_span, steps=0, completed=True)
       flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
                        steps=0, config=self._flight_config(),
@@ -900,6 +904,9 @@ class RemoteScanTrainer:
                               'truncated': truncated})
       return state, empty, empty
 
+    flight_tok = flight.epoch_begin()
+    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                             epoch=epoch_no)
     completed = False
     self._steps_dispatched = start_step
     try:

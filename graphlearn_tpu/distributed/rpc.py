@@ -303,52 +303,60 @@ class RpcClient:
     # the server adopts it for the handler, so client and server spans
     # of one request join on the same id
     sp = spans.begin('rpc.client.request', rank=rank, func=func)
+    # the span closes in ONE place (the finally) so no raise — not even
+    # from _drop_conn or a malformed response frame — can leak it;
+    # each path records its outcome by rebinding end_kw first
+    end_kw = {'ok': False, 'error': 'client'}
     try:
-      fault_point('rpc.client.request')
-      sock = self._conn(rank, connect_timeout=timeout)
-      if timeout is not None:
-        sock.settimeout(timeout)
-      _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs,
-                         'ctx': {'trace': sp.trace, 'span': sp.span_id}})
-      resp = _recv_frame(sock)
-      fault_point('rpc.client.response')
-      if timeout is not None:
-        sock.settimeout(180)
-    except socket.timeout as e:
-      # normalize to TimeoutError so retry_on and callers see one type
-      self._drop_conn(rank)
-      spans.end(sp, ok=False, error='timeout')
-      raise TimeoutError(
-          f'rpc to rank {rank} func {func!r} timed out after '
-          f'{timeout}s') from e
-    except BaseException as e:
-      # a broken pooled connection must not poison the next attempt
-      if isinstance(e, (ConnectionError, EOFError, OSError)):
+      try:
+        fault_point('rpc.client.request')
+        sock = self._conn(rank, connect_timeout=timeout)
+        if timeout is not None:
+          sock.settimeout(timeout)
+        _send_frame(sock, {'func': func, 'args': args, 'kwargs': kwargs,
+                           'ctx': {'trace': sp.trace,
+                                   'span': sp.span_id}})
+        resp = _recv_frame(sock)
+        fault_point('rpc.client.response')
+        if timeout is not None:
+          sock.settimeout(180)
+      except socket.timeout as e:
+        # normalize to TimeoutError so retry_on and callers see one type
+        end_kw = {'ok': False, 'error': 'timeout'}
         self._drop_conn(rank)
-      spans.end(sp, ok=False, error=type(e).__name__)
-      raise
-    if not resp['ok']:
-      factory = _WIRE_ERRORS.get(resp.get('etype'))
-      if factory is not None:
-        # typed rejection: reconstruct it so callers can distinguish
-        # 'back off and retry' (tenancy throttle) from a remote fault.
-        # NOT in request_sync's retry_on — visible-backpressure layers
-        # (tenancy.with_backpressure) own the wait
-        spans.end(sp, ok=False, error=str(resp.get('etype')))
-        raise factory(resp.get('payload') or {})
-      spans.end(sp, ok=False, error='remote')
-      raise RuntimeError(
-          f'remote error from rank {rank}: {resp["error"]}')
-    spans.end(sp, ok=True)
-    # SUCCESSFUL round trips feed the control/stream-plane latency
-    # histogram — the p50/p99 every remote-batch consumer actually pays
-    # per RPC. Failures (including ok=False remote errors, often
-    # fast-failing) surface through resilience.* counters instead of
-    # dragging the latency distribution down
-    from .. import metrics
-    metrics.observe('rpc.client.request_ms',
-                    (_time.perf_counter() - t0) * 1e3)
-    return resp['result']
+        raise TimeoutError(
+            f'rpc to rank {rank} func {func!r} timed out after '
+            f'{timeout}s') from e
+      except BaseException as e:
+        end_kw = {'ok': False, 'error': type(e).__name__}
+        # a broken pooled connection must not poison the next attempt
+        if isinstance(e, (ConnectionError, EOFError, OSError)):
+          self._drop_conn(rank)
+        raise
+      if not resp['ok']:
+        end_kw = {'ok': False, 'error': 'remote'}
+        factory = _WIRE_ERRORS.get(resp.get('etype'))
+        if factory is not None:
+          # typed rejection: reconstruct it so callers can distinguish
+          # 'back off and retry' (tenancy throttle) from a remote fault.
+          # NOT in request_sync's retry_on — visible-backpressure layers
+          # (tenancy.with_backpressure) own the wait
+          end_kw = {'ok': False, 'error': str(resp.get('etype'))}
+          raise factory(resp.get('payload') or {})
+        raise RuntimeError(
+            f'remote error from rank {rank}: {resp["error"]}')
+      end_kw = {'ok': True}
+      # SUCCESSFUL round trips feed the control/stream-plane latency
+      # histogram — the p50/p99 every remote-batch consumer actually
+      # pays per RPC. Failures (including ok=False remote errors, often
+      # fast-failing) surface through resilience.* counters instead of
+      # dragging the latency distribution down
+      from .. import metrics
+      metrics.observe('rpc.client.request_ms',
+                      (_time.perf_counter() - t0) * 1e3)
+      return resp['result']
+    finally:
+      spans.end(sp, **end_kw)
 
   def request_sync(self, rank: int, func: str, *args,
                    timeout: Optional[float] = None,

@@ -267,9 +267,11 @@ class NodeLoader(OverflowGuardMixin):
     from ..metrics import flight
     from ..utils import step_annotation
     self._begin_epoch()
+    # overflow-policy resolve BEFORE the flight bracket opens: a config
+    # error raising here must not leave a permanently-open record
+    guarded, recompute = self._overflow_epoch_start()
     tok = flight.epoch_begin()
     steps, completed = 0, False
-    guarded, recompute = self._overflow_epoch_start()
     try:
       for i, idx in enumerate(self._batcher):
         with step_annotation('glt_batch', i):

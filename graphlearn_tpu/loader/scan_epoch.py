@@ -310,7 +310,6 @@ class ScanTrainer(FusedEpochTrainer):
     if recompute:
       raise ValueError(_RECOMPUTE_MSG)
     self.loader._begin_epoch()
-    flight_tok = flight.epoch_begin()
     epoch_no = self._epochs
     full_steps = self._epoch_steps()
     steps = full_steps
@@ -327,17 +326,20 @@ class ScanTrainer(FusedEpochTrainer):
         raise ValueError(f'start_step={start_step} outside this '
                          f"epoch's {steps} steps")
     # the epoch span is current for the whole program region: chunk
-    # spans (and any spans the model hooks open) parent under it.
-    # Begun AFTER the step arithmetic so every path below (zero-step
-    # return, try/finally) provably ends it — an attached span leaked
-    # by a prologue exception would mis-parent the thread's spans for
-    # the rest of the process
-    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
-                             epoch=epoch_no)
+    # spans (and any spans the model hooks open) parent under it. Both
+    # brackets open AFTER the step arithmetic (and, on the zero-step
+    # path, after the empty-result device work) so nothing between
+    # open and close can raise — a flight record opened before the
+    # resume-argument raises above would stay permanently open, and an
+    # attached span leaked by a prologue exception would mis-parent
+    # the thread's spans for the rest of the process
     if steps <= 0:
       # zero-batch epochs still record (the per-step loop writes a
       # steps=0 line) so flight epoch counts line up across drivers
       empty = jnp.zeros((0,), jnp.float32)
+      flight_tok = flight.epoch_begin()
+      epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                               epoch=epoch_no)
       spans.end(epoch_span, steps=0, completed=True)
       flight.epoch_end(flight_tok, emitter=self._NAME, epoch=epoch_no,
                        steps=0, config=self._flight_config(),
@@ -345,6 +347,9 @@ class ScanTrainer(FusedEpochTrainer):
                               'truncated': truncated})
       return state, empty, empty
 
+    flight_tok = flight.epoch_begin()
+    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                             epoch=epoch_no)
     completed = False
     # reset BEFORE the body: a failure in its staging prologue (fused
     # args rebuild, carry device_puts) must read as the resume point,
@@ -762,7 +767,6 @@ class DistScanTrainer(DistFusedEpochTrainer):
     guarded, recompute = self.loader._overflow_epoch_start()
     if recompute:   # unreachable after __init__'s check; kept for parity
       raise ValueError(_RECOMPUTE_MSG)
-    flight_tok = flight.epoch_begin()
     epoch_no = self._epochs
     full_steps = len(self.loader)
     steps = full_steps
@@ -776,16 +780,19 @@ class DistScanTrainer(DistFusedEpochTrainer):
       if not 0 <= start_step < steps:
         raise ValueError(f'start_step={start_step} outside this '
                          f"epoch's {steps} steps")
-    # begun after the step arithmetic: every path below ends the span
-    # (zero-step finally, main finally) — see ScanTrainer.run_epoch
-    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
-                             epoch=epoch_no)
+    # both brackets open after the step arithmetic (and the zero-step
+    # path's empty-result device work): every statement between open
+    # and close is a try/finally body or a bracket call, so every path
+    # provably ends them — see ScanTrainer.run_epoch
     if steps <= 0:
       # mirror the per-step loop's zero-batch epoch (DistLoader.__iter__
       # closes the overflow guard and STILL publishes in its finally):
       # the feature-stats accumulators a prior template iteration left
       # on device must drain this epoch too, or they eventually wrap
       empty = jnp.zeros((0,), jnp.float32)
+      flight_tok = flight.epoch_begin()
+      epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                               epoch=epoch_no)
       try:
         if guarded and not truncated:
           self.loader._finish_epoch_overflow()
@@ -808,6 +815,9 @@ class DistScanTrainer(DistFusedEpochTrainer):
                                   'truncated': truncated})
       return state, empty, empty
 
+    flight_tok = flight.epoch_begin()
+    epoch_span = spans.begin('epoch.run', emitter=self._NAME,
+                             epoch=epoch_no)
     completed = False
     # reset BEFORE the body: a failure in its staging prologue (the
     # replicated-carry device_puts, program retraces) must read as the

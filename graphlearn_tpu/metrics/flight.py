@@ -56,7 +56,11 @@ class JsonlAppender:
     self._env_var = env_var
     self._keep_open = keep_open
     self._lock = threading.Lock()
+    # keep-open file handle shared by every thread that appends a
+    # record — open/write/reset all hold _lock
+    # graftlint: shared[_lock]
     self._path: Optional[str] = None
+    # graftlint: shared[_lock]
     self._fh = None
 
   def append(self, path: str, rec: dict) -> bool:

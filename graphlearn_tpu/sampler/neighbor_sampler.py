@@ -1225,6 +1225,11 @@ class NeighborSampler(BaseSampler):
     if neg is not None:
       num_neg = neg.num_negatives(b)
       sorted_idx, _ = self._neg_sorted()
+      # num_neg is exact by contract (the label layout below indexes by
+      # it), so it cannot be pow2-clamped without changing the drawn
+      # negatives; batch shape is held constant by the producers'
+      # cyclic padding, and retrace_budget guards ragged ad-hoc callers
+      # graftlint: allow[retrace-hazard] num_samples is an exact contract; producer-side padding keeps b constant
       nr, nc, nmask = ops.random_negative_sample(
           g.indptr, sorted_idx, g.num_nodes, g.num_nodes, num_neg,
           kneg, padding=True)
@@ -1294,6 +1299,8 @@ class NeighborSampler(BaseSampler):
     if neg is not None:
       num_neg = neg.num_negatives(b)
       sorted_idx, _ = self._neg_sorted(etype)
+      # same contract as the homogeneous branch: num_neg is exact
+      # graftlint: allow[retrace-hazard] num_samples is an exact contract; producer-side padding keeps b constant
       nr, nc, _ = ops.random_negative_sample(
           g.indptr, jnp.asarray(sorted_idx), num_key, num_other, num_neg,
           self._next_key(), padding=True)
@@ -1417,6 +1424,10 @@ class NeighborSampler(BaseSampler):
     if bucketed:
       deg_small, dmax = self._degree_buckets()
       cap = cap_large or max(8, node_buf.shape[0] // 8)
+      # node_buf is the padded node buffer: its shape is a closed
+      # capacity-plan value (pow2-capped upstream), so cap takes one
+      # value per compiled configuration — not a fresh-executable mint
+      # graftlint: allow[retrace-hazard] node_buf.shape is a closed capacity-plan shape, constant per config
       sub = ops.node_subgraph_bucketed(
           g.indptr, g.indices, node_buf, nmask, deg_small=deg_small,
           cap_large=cap, max_degree=max_degree or dmax)

@@ -130,6 +130,9 @@ class ServingEngine:
     self.max_wait_s = float(max_wait_ms) / 1e3
     self._refresh_fn = refresh_fn
     self._q: 'queue.Queue[_Request]' = queue.Queue()
+    # stale-id set shared between caller threads (mark_stale) and the
+    # serving thread (_refresh_stale) — every access holds _stale_lock
+    # graftlint: shared[_stale_lock]
     self._stale: set = set()
     self._stale_lock = threading.Lock()
     self._stop = threading.Event()
@@ -307,32 +310,37 @@ class ServingEngine:
     # requests, so it parents under the FIRST request's span (reachable
     # from that request's tree); the other requests link to it via the
     # batch attr stamped on their request spans at respond time.
+    flat = np.concatenate([r.ids for r in batch])
     batch_span = spans.begin('serving.batch', attach=False,
                              trace=batch[0].span.trace,
                              parent=batch[0].span.span_id,
                              requests=len(batch))
-    flat = np.concatenate([r.ids for r in batch])
-    self._refresh_stale(flat)
-    outs = []
-    pos = 0
-    while pos < flat.size:
-      take = min(flat.size - pos, self.buckets[-1])
-      cap = self._bucket_for(take)
-      padded = np.full((cap,), -1, np.int32)
-      padded[:take] = flat[pos:pos + take]
-      mask = padded >= 0
-      metrics.observe('serving.batch_fill', take / cap)
-      rows = self.store.fetch(self.store.lookup(padded, mask))
-      outs.append(rows[:take])
-      metrics.inc('serving.batches')
-      pos += take
-    rows_all = outs[0] if len(outs) == 1 else np.concatenate(outs)
-    compute_s = time.perf_counter() - t_batch
-    metrics.observe('serving.compute_ms', compute_s * 1e3)
-    spans.emit('serving.compute', trace=batch_span.trace,
-               parent=batch_span.span_id, t0_unix=t_batch_unix,
-               dur_ms=compute_s * 1e3, ids=int(flat.size))
-    spans.end(batch_span, fill=int(flat.size))
+    try:
+      self._refresh_stale(flat)
+      outs = []
+      pos = 0
+      while pos < flat.size:
+        take = min(flat.size - pos, self.buckets[-1])
+        cap = self._bucket_for(take)
+        padded = np.full((cap,), -1, np.int32)
+        padded[:take] = flat[pos:pos + take]
+        mask = padded >= 0
+        metrics.observe('serving.batch_fill', take / cap)
+        rows = self.store.fetch(self.store.lookup(padded, mask))
+        outs.append(rows[:take])
+        metrics.inc('serving.batches')
+        pos += take
+      rows_all = outs[0] if len(outs) == 1 else np.concatenate(outs)
+      compute_s = time.perf_counter() - t_batch
+      metrics.observe('serving.compute_ms', compute_s * 1e3)
+      spans.emit('serving.compute', trace=batch_span.trace,
+                 parent=batch_span.span_id, t0_unix=t_batch_unix,
+                 dur_ms=compute_s * 1e3, ids=int(flat.size))
+    finally:
+      # a raising refresh/fetch must not strand the batch span open —
+      # it would simply never be emitted (attach=False), hiding the
+      # failed batch from the trace it belongs to
+      spans.end(batch_span, fill=int(flat.size))
     o = 0
     for r in batch:
       res = rows_all[o:o + r.ids.size]

@@ -89,12 +89,17 @@ class ChunkStager:
     self.store = store
     self.max_ahead = int(max_ahead)
     self.timeout_s = float(timeout_s)
-    self._plan: List[np.ndarray] = []
-    self._slabs: Dict[int, _Slab] = {}
     self._lock = threading.Lock()
+    # ring state shared between the dispatch thread (begin_epoch/take/
+    # ack) and the stager worker (_loop) — every access holds _lock
+    # graftlint: shared[_lock]
+    self._plan: List[np.ndarray] = []
+    # graftlint: shared[_lock]
+    self._slabs: Dict[int, _Slab] = {}
     self._q: 'queue.Queue' = queue.Queue()
     self._worker: Optional[threading.Thread] = None
     self._stop = False
+    # graftlint: shared[_lock]
     self._next_submit = 0
     self.degraded = False   # a worker gather failed this epoch
     # perf_counter marks per chunk, kept for the whole epoch — the
@@ -125,8 +130,11 @@ class ChunkStager:
       self.stage_done_t = {}
       self.ack_t = {}
     self._ensure_worker()
+    # sized from the argument, not self._plan — the worker owns the
+    # ring state once _ensure_worker starts it, so reads go through
+    # the lock (or, like here, never touch the shared field at all)
     for _ in range(min(self.max_ahead,
-                       len(self._plan) - int(start_chunk))):
+                       len(chunk_rows) - int(start_chunk))):
       self._submit_next()
 
   def watermarks(self) -> Dict[str, int]:

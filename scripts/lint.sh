@@ -21,6 +21,19 @@ echo "== graftlint =="
 # trainers
 python -m graphlearn_tpu.analysis.lint graphlearn_tpu/ || rc=1
 
+echo "== graftlint (bench profile) =="
+# relaxed profile over the benchmark tier: the registry rules, bracket
+# discipline and donation safety stay enforced — a benchmark that
+# leaks spans or reads donated buffers measures garbage — while the
+# hot-path scoping rules (host-sync/dispatch/prng/retrace/lock) are
+# exempt: benchmarks host-sync on purpose and probe shapes off the
+# ladder. The registry modules ride along so the name checks see the
+# REGISTERED_* frozensets.
+python -m graphlearn_tpu.analysis.lint --profile bench --no-baseline \
+  benchmarks/ bench.py \
+  graphlearn_tpu/metrics/registry_names.py \
+  graphlearn_tpu/utils/faults.py || rc=1
+
 echo "== ruff =="
 if python -m ruff --version >/dev/null 2>&1; then
   python -m ruff check graphlearn_tpu/ tests/ bench.py || rc=1

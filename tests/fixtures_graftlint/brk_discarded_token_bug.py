@@ -1,0 +1,12 @@
+"""bracket-discipline BUG fixture: opener token discarded.
+
+A bare ``spans.begin(...)`` statement binds nothing — the span can
+never be closed. (The with-only context managers have the same
+bare-call trap and are flagged the same way.)
+"""
+from graphlearn_tpu.metrics import spans
+
+
+def timed_step(fn):
+  spans.begin('epoch.run')   # BUG: token discarded, unclosable
+  return fn()

@@ -1,0 +1,10 @@
+"""bracket-discipline FIXED twin of brk_discarded_token_bug.py.
+
+The with-form closes structurally — no token to manage.
+"""
+from graphlearn_tpu.metrics import spans
+
+
+def timed_step(fn):
+  with spans.span('epoch.run'):
+    return fn()

@@ -1,0 +1,28 @@
+"""bracket-discipline BUG fixture (PR 8 span leak 2/3: flight record).
+
+Transcribed from the per-step loader's __iter__: the overflow-policy
+resolve ran INSIDE the flight bracket, so a config error turned into a
+permanently-open flight record.
+"""
+from graphlearn_tpu.metrics import flight
+
+
+class Loader:
+
+  def _overflow_epoch_start(self):
+    raise NotImplementedError
+
+  def _batches(self):
+    raise NotImplementedError
+
+  def __iter__(self):
+    tok = flight.epoch_begin()
+    guarded, recompute = self._overflow_epoch_start()  # BUG: can raise
+    steps = 0
+    try:
+      for batch in self._batches():
+        yield batch
+        steps += 1
+    finally:
+      flight.end_for(self, tok, steps=steps, guarded=guarded,
+                     recompute=recompute)

@@ -1,0 +1,27 @@
+"""bracket-discipline FIXED twin of brk_overflow_flight_bug.py.
+
+The overflow-policy resolve moves BEFORE the flight bracket opens — a
+config error raises with no record in flight.
+"""
+from graphlearn_tpu.metrics import flight
+
+
+class Loader:
+
+  def _overflow_epoch_start(self):
+    raise NotImplementedError
+
+  def _batches(self):
+    raise NotImplementedError
+
+  def __iter__(self):
+    guarded, recompute = self._overflow_epoch_start()
+    tok = flight.epoch_begin()
+    steps = 0
+    try:
+      for batch in self._batches():
+        yield batch
+        steps += 1
+    finally:
+      flight.end_for(self, tok, steps=steps, guarded=guarded,
+                     recompute=recompute)

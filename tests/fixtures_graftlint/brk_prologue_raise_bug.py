@@ -1,0 +1,19 @@
+"""bracket-discipline BUG fixture (PR 8 span leak 1/3: prologue raise).
+
+Transcribed from the scanned trainer's run_epoch: the epoch span was
+begun before the resume-argument validation, so a bad ``start_step``
+raised with the span still attached — mis-parenting every later span
+on the thread for the rest of the process.
+"""
+from graphlearn_tpu.metrics import spans
+
+
+def run_epoch(loader, steps, start_step=0):
+  sp = spans.begin('epoch.run', emitter='Fixture')
+  if start_step % 8 != 0:
+    raise ValueError('start_step is not a chunk boundary')  # BUG: leaks
+  try:
+    for _ in range(start_step, steps):
+      loader.step()
+  finally:
+    spans.end(sp, steps=steps)

@@ -1,0 +1,18 @@
+"""bracket-discipline FIXED twin of brk_prologue_raise_bug.py.
+
+Validation happens BEFORE the span opens; from the opener to the
+try/finally nothing can raise, so the span provably closes on every
+path.
+"""
+from graphlearn_tpu.metrics import spans
+
+
+def run_epoch(loader, steps, start_step=0):
+  if start_step % 8 != 0:
+    raise ValueError('start_step is not a chunk boundary')
+  sp = spans.begin('epoch.run', emitter='Fixture')
+  try:
+    for _ in range(start_step, steps):
+      loader.step()
+  finally:
+    spans.end(sp, steps=steps)

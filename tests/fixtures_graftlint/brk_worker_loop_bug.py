@@ -1,0 +1,19 @@
+"""bracket-discipline BUG fixture (PR 8 span leak 3/3: worker loop).
+
+Transcribed from the sampling producer's worker loop: the per-batch
+span closed only on the straight-line path, so a raising sample or a
+failed channel send left it open on the worker's context stack — every
+later batch span parented under the dead one.
+"""
+from graphlearn_tpu.metrics import spans
+
+
+def worker_loop(batches, sampler, channel):
+  done = 0
+  for i, batch in enumerate(batches):
+    bsp = spans.begin('producer.batch', batch=i)
+    msg = sampler.sample(batch)   # BUG: a raise leaks the batch span
+    channel.send(msg)
+    spans.end(bsp)
+    done += 1
+  return done

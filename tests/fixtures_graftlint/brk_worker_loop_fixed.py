@@ -1,0 +1,19 @@
+"""bracket-discipline FIXED twin of brk_worker_loop_bug.py.
+
+The sample/send body sits in a try/finally: the batch span closes on
+every path out of the iteration, raising or not.
+"""
+from graphlearn_tpu.metrics import spans
+
+
+def worker_loop(batches, sampler, channel):
+  done = 0
+  for i, batch in enumerate(batches):
+    bsp = spans.begin('producer.batch', batch=i)
+    try:
+      msg = sampler.sample(batch)
+      channel.send(msg)
+    finally:
+      spans.end(bsp)
+    done += 1
+  return done

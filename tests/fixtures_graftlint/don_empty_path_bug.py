@@ -1,0 +1,28 @@
+"""donation-safety BUG fixture (PR 7, donated-table read, empty path).
+
+Transcribed from the serving store's scatter-update: the jitted scatter
+donates its first operand, and the empty-batch early return read the
+OLD handle — garbage from the moment the call dispatched, whether or
+not the batch was empty.
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter(emb, idx, vals):
+  return emb.at[idx].set(vals)
+
+
+class Store:
+
+  def __init__(self, emb):
+    self._emb = emb
+
+  def update(self, idx, vals):
+    out = _scatter(self._emb, idx, vals)
+    if idx.shape[0] == 0:
+      return self._emb   # BUG: read after donation, never rebound
+    self._emb = out
+    return self._emb

@@ -1,0 +1,26 @@
+"""donation-safety FIXED twin of don_empty_path_bug.py.
+
+The empty-batch check moves BEFORE the donating dispatch, and the hot
+path uses the rebind idiom — the donated name is rebound by the very
+statement that donates it.
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter(emb, idx, vals):
+  return emb.at[idx].set(vals)
+
+
+class Store:
+
+  def __init__(self, emb):
+    self._emb = emb
+
+  def update(self, idx, vals):
+    if idx.shape[0] == 0:
+      return self._emb   # nothing donated yet: safe
+    self._emb = _scatter(self._emb, idx, vals)
+    return self._emb

@@ -1,0 +1,32 @@
+"""donation-safety BUG fixture (PR 7, failed-refresh re-mark).
+
+Second PR 7 shape: the refresh handler caught the dispatch failure and
+re-marked stale rows by READING the donated table — but donation
+invalidates at dispatch, so on the exception path the buffer is gone
+AND the rebind never happened.
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _refresh(emb, idx, vals):
+  return emb.at[idx].set(vals)
+
+
+class Cache:
+
+  def __init__(self, emb):
+    self._emb = emb
+    self._stale = set()
+
+  def refresh(self, idx, vals):
+    try:
+      self._emb = _refresh(self._emb, idx, vals)
+    except RuntimeError:
+      self._mark_stale(self._emb)   # BUG: donated even though it raised
+      raise
+
+  def _mark_stale(self, rows):
+    self._stale.add(id(rows))

@@ -1,0 +1,31 @@
+"""donation-safety FIXED twin of don_failed_refresh_bug.py.
+
+The failure handler re-marks rows by INDEX — it never touches the
+donated buffer, which is invalid on the exception path by donation's
+dispatch-time contract.
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _refresh(emb, idx, vals):
+  return emb.at[idx].set(vals)
+
+
+class Cache:
+
+  def __init__(self, emb):
+    self._emb = emb
+    self._stale = set()
+
+  def refresh(self, idx, vals):
+    try:
+      self._emb = _refresh(self._emb, idx, vals)
+    except RuntimeError:
+      self._mark_stale(idx)   # indices, not the dead buffer
+      raise
+
+  def _mark_stale(self, idx):
+    self._stale.update(int(i) for i in idx)

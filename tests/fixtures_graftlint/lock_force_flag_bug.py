@@ -1,0 +1,26 @@
+"""lock-discipline BUG fixture (PR 15, rotate_now force-flag path).
+
+Transcribed from the rotation scheduler: ``rotate_now`` set the force
+flag OUTSIDE the scheduler lock while the rotation thread read and
+cleared it under the lock — a racing write the annotation makes a lint
+error.
+"""
+import threading
+
+
+class RotationScheduler:
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    # graftlint: shared[_lock]
+    self._force = False
+
+  def rotate_now(self):
+    self._force = True   # BUG: racing write outside self._lock
+
+  def maybe_rotate(self):
+    with self._lock:
+      if self._force:
+        self._force = False
+        return True
+    return False

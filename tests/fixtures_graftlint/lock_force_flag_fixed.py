@@ -1,0 +1,24 @@
+"""lock-discipline FIXED twin of lock_force_flag_bug.py.
+
+Every access to the shared flag holds the scheduler lock.
+"""
+import threading
+
+
+class RotationScheduler:
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    # graftlint: shared[_lock]
+    self._force = False
+
+  def rotate_now(self):
+    with self._lock:
+      self._force = True
+
+  def maybe_rotate(self):
+    with self._lock:
+      if self._force:
+        self._force = False
+        return True
+    return False

@@ -1,0 +1,24 @@
+"""lock-discipline BUG fixture: ABBA lock-order cycle.
+
+Two paths acquire the same pair of locks in opposite orders — the
+classic deadlock the cross-module cycle detection exists for.
+"""
+import threading
+
+
+class Pools:
+
+  def __init__(self):
+    self._alloc = threading.Lock()
+    self._flush = threading.Lock()
+    self._live = []
+
+  def acquire(self, n):
+    with self._alloc:
+      with self._flush:   # alloc -> flush
+        self._live.append(n)
+
+  def drain(self):
+    with self._flush:
+      with self._alloc:   # BUG: flush -> alloc closes the cycle
+        self._live.clear()

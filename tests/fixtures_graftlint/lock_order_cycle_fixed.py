@@ -1,0 +1,24 @@
+"""lock-discipline FIXED twin of lock_order_cycle_bug.py.
+
+Both paths take the pair in the same order — the acquisition graph is
+acyclic.
+"""
+import threading
+
+
+class Pools:
+
+  def __init__(self):
+    self._alloc = threading.Lock()
+    self._flush = threading.Lock()
+    self._live = []
+
+  def acquire(self, n):
+    with self._alloc:
+      with self._flush:   # alloc -> flush
+        self._live.append(n)
+
+  def drain(self):
+    with self._alloc:
+      with self._flush:   # same order: no cycle
+        self._live.clear()

@@ -1,0 +1,26 @@
+"""lock-discipline BUG fixture (PR 10, snapshot prefix path).
+
+Transcribed from the chunk checkpointer: the resumed-epoch loss prefix
+is stitched by the bounded writer thread under the write lock, but the
+resume path stashed a fresh prefix with a bare store — racing a
+capture in flight.
+"""
+import threading
+
+
+class Checkpointer:
+
+  def __init__(self):
+    self._wlock = threading.Lock()   # serializes writes + prefix stash
+    # graftlint: shared[_wlock]
+    self._prefix = None
+
+  def stash_prefix(self, losses):
+    self._prefix = {'losses': losses}   # BUG: races the writer thread
+
+  def capture(self, losses):
+    with self._wlock:
+      if self._prefix is not None:
+        losses = self._prefix['losses'] + losses
+        self._prefix = None
+      return losses

@@ -1,0 +1,24 @@
+"""lock-discipline FIXED twin of lock_prefix_bug.py.
+
+The prefix stash takes the write lock like every other access.
+"""
+import threading
+
+
+class Checkpointer:
+
+  def __init__(self):
+    self._wlock = threading.Lock()   # serializes writes + prefix stash
+    # graftlint: shared[_wlock]
+    self._prefix = None
+
+  def stash_prefix(self, losses):
+    with self._wlock:
+      self._prefix = {'losses': losses}
+
+  def capture(self, losses):
+    with self._wlock:
+      if self._prefix is not None:
+        losses = self._prefix['losses'] + losses
+        self._prefix = None
+      return losses

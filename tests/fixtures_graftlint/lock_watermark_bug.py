@@ -1,0 +1,22 @@
+"""lock-discipline BUG fixture (PR 8, staging watermark race).
+
+Transcribed from the chunk stager: the dispatch thread read the
+staging watermark with a bare load while the stager thread advanced it
+under the state lock — a torn read that over- or under-reported lag.
+"""
+import threading
+
+
+class ChunkStager:
+
+  def __init__(self):
+    self._state_lock = threading.Lock()
+    # graftlint: shared[_state_lock]
+    self._watermark = 0
+
+  def advance(self, n):
+    with self._state_lock:
+      self._watermark += n
+
+  def lag(self, dispatched):
+    return dispatched - self._watermark   # BUG: unlocked cross-thread read

@@ -1,0 +1,26 @@
+"""lock-discipline FIXED twin of lock_watermark_bug.py.
+
+The read moves into a ``locked[...]``-annotated helper whose call site
+holds the lock — both annotation forms exercised.
+"""
+import threading
+
+
+class ChunkStager:
+
+  def __init__(self):
+    self._state_lock = threading.Lock()
+    # graftlint: shared[_state_lock]
+    self._watermark = 0
+
+  def advance(self, n):
+    with self._state_lock:
+      self._watermark += n
+
+  # graftlint: locked[_state_lock]
+  def _lag_locked(self, dispatched):
+    return dispatched - self._watermark
+
+  def lag(self, dispatched):
+    with self._state_lock:
+      return self._lag_locked(dispatched)

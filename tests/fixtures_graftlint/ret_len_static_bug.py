@@ -1,0 +1,19 @@
+"""retrace-hazard BUG fixture: raw len() into a static jit argument.
+
+Every distinct index-list length mints a fresh executable — the silent
+compile storm the runtime retrace_budget guard catches in production
+and this rule catches at lint time.
+"""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=('cap',))
+def gather_capped(table, idx, cap: int):
+  return table[:cap]
+
+
+def step(table, idx):
+  k = len(idx)
+  return gather_capped(table, idx, cap=k)   # BUG: one executable per k

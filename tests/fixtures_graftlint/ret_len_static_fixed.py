@@ -1,0 +1,21 @@
+"""retrace-hazard FIXED twin of ret_len_static_bug.py.
+
+The dynamic length passes through the registered pow2 closure before
+reaching the static argument, so the executable set is the closed
+capacity ladder.
+"""
+import functools
+
+import jax
+
+from graphlearn_tpu.serving.store import pow2_cap
+
+
+@functools.partial(jax.jit, static_argnames=('cap',))
+def gather_capped(table, idx, cap: int):
+  return table[:cap]
+
+
+def step(table, idx):
+  k = pow2_cap(len(idx))
+  return gather_capped(table, idx, cap=k)

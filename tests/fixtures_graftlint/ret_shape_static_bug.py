@@ -1,0 +1,19 @@
+"""retrace-hazard BUG fixture: .shape-derived value into a static arg.
+
+A padded-buffer shape read feeds the static pad width directly — when
+callers pass ragged inputs, each width compiles its own program.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=('pad',))
+def pad_to(x, pad: int):
+  return jnp.pad(x, (0, pad - x.shape[0]))
+
+
+def pack(x):
+  n = x.shape[0]
+  return pad_to(x, pad=n + 1)   # BUG: fresh executable per input shape

@@ -1,0 +1,20 @@
+"""retrace-hazard FIXED twin of ret_shape_static_bug.py.
+
+The shape-derived width is clamped onto the pow2 ladder first.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from graphlearn_tpu.serving.store import pow2_cap
+
+
+@functools.partial(jax.jit, static_argnames=('pad',))
+def pad_to(x, pad: int):
+  return jnp.pad(x, (0, pad - x.shape[0]))
+
+
+def pack(x):
+  n = pow2_cap(x.shape[0] + 1)
+  return pad_to(x, pad=n)

@@ -645,6 +645,9 @@ def test_metric_rule_pragma_suppression(tmp_path):
   assert [f for f in out if f.relpath == 'code.py'] == []
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): redundant package walk —
+# test_analysis.py::TestPackageClean runs ALL rules (this one included)
+# over the same tree as the tier-1 zero-findings gate
 def test_metric_rule_package_is_clean():
   """The real package passes its own rule (the tier-1 zero-findings
   gate in test_analysis covers all rules; this pins the new one)."""

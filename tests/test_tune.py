@@ -123,6 +123,10 @@ def test_tune_artifact_roundtrip_and_zero_retrace(tmp_path):
   assert eng.buckets == tuple(sorted(art2.choices['serving_buckets']))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): second full local tune()
+# run — test_tune_artifact_roundtrip_and_zero_retrace stays the tier-1
+# rep; the disqualify-on-retrace contract also rides tier-1 through
+# test_topology_tune's screen/tune.rejected path
 def test_tune_rejects_retracing_candidate_with_diff():
   """Acceptance: a deliberately retracing candidate is rejected BY
   CONSTRUCTION, and the artifact's evidence log carries the signature
@@ -164,6 +168,10 @@ def test_tune_exact_pins_exact_set():
   assert art2.choices['exact'] is False
 
 
+@pytest.mark.slow  # tier-1 budget (PR 20): third full local tune()
+# run — fingerprint/drift refusal keeps tier-1 reps in
+# test_topology_tune (tampered + cross-topology artifacts) and
+# test_capacity_plans (hetero fingerprint drift raises)
 def test_config_fingerprint_refuses_drifted_dataset(tmp_path):
   """Acceptance: the ``config=`` constructors refuse an artifact tuned
   for a DIFFERENT graph by dataset fingerprint; a hand-edited artifact
